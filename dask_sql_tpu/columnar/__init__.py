@@ -1,0 +1,15 @@
+from .column import Column
+from .dtypes import SqlType, np_to_sql, parse_sql_type, promote, python_to_sql_type, similar_type, sql_to_np
+from .table import Table
+
+__all__ = [
+    "Column",
+    "Table",
+    "SqlType",
+    "np_to_sql",
+    "parse_sql_type",
+    "promote",
+    "python_to_sql_type",
+    "similar_type",
+    "sql_to_np",
+]

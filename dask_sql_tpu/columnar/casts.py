@@ -1,0 +1,154 @@
+"""Column casting between SQL types (reference mappings.py:309 cast_column_type)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from .column import Column
+from .dtypes import (
+    DATETIME_TYPES,
+    FLOAT_TYPES,
+    INTEGER_TYPES,
+    INTERVAL_TYPES,
+    NUMERIC_TYPES,
+    STRING_TYPES,
+    SqlType,
+    sql_to_np,
+)
+
+_NS_PER_DAY = 86_400_000_000_000
+
+
+def cast_column(col: Column, target: SqlType) -> Column:
+    src = col.sql_type
+    if src == target:
+        return col
+    # string -> anything: decode on host (dictionary is small)
+    if src in STRING_TYPES:
+        if target in STRING_TYPES:
+            return Column(col.data, target, col.validity, col.dictionary)
+        return _cast_from_string(col, target)
+    if target in STRING_TYPES:
+        return _cast_to_string(col, target)
+    if src in DATETIME_TYPES and target in DATETIME_TYPES:
+        if target == SqlType.DATE:
+            # truncate to midnight
+            days = col.data // _NS_PER_DAY
+            return Column(days * _NS_PER_DAY, SqlType.DATE, col.validity)
+        return Column(col.data, target, col.validity)
+    if src in DATETIME_TYPES and target in NUMERIC_TYPES:
+        np_t = sql_to_np(target)
+        return Column(col.data.astype(np_t), target, col.validity)
+    if src in NUMERIC_TYPES and target in DATETIME_TYPES:
+        return Column(col.data.astype(jnp.int64), target, col.validity)
+    if src in INTERVAL_TYPES and target in NUMERIC_TYPES:
+        return Column(col.data.astype(sql_to_np(target)), target, col.validity)
+    if src == SqlType.BOOLEAN and target in NUMERIC_TYPES:
+        return Column(col.data.astype(sql_to_np(target)), target, col.validity)
+    if src in NUMERIC_TYPES and target == SqlType.BOOLEAN:
+        return Column(col.data != 0, target, col.validity)
+    if src in NUMERIC_TYPES and target in NUMERIC_TYPES:
+        data = col.data
+        if src in FLOAT_TYPES and target in INTEGER_TYPES:
+            # SQL CAST truncates toward zero; guard NaN under the validity mask
+            data = jnp.nan_to_num(jnp.trunc(data))
+        return Column(data.astype(sql_to_np(target)), target, col.validity)
+    if src == SqlType.NULL:
+        return Column(
+            jnp.zeros(len(col), dtype=sql_to_np(target)),
+            target,
+            jnp.zeros(len(col), dtype=bool),
+            np.array([""], dtype=object) if target in STRING_TYPES else None,
+        )
+    raise NotImplementedError(f"cast {src} -> {target}")
+
+
+def _cast_from_string(col: Column, target: SqlType) -> Column:
+    """Cast via the (small) host dictionary, then gather on device."""
+    d = col.dictionary if col.dictionary is not None and len(col.dictionary) else np.array([""], dtype=object)
+    strs = d.astype(str)
+    bad = None
+    if target in INTEGER_TYPES:
+        vals = np.zeros(len(strs), dtype=np.int64)
+        bad = np.zeros(len(strs), dtype=bool)
+        for i, s in enumerate(strs):
+            try:
+                vals[i] = int(float(s)) if s.strip() else 0
+                bad[i] = not s.strip()
+            except ValueError:
+                bad[i] = True
+        vals = vals.astype(sql_to_np(target))
+    elif target in FLOAT_TYPES:
+        vals = np.zeros(len(strs), dtype=np.float64)
+        bad = np.zeros(len(strs), dtype=bool)
+        for i, s in enumerate(strs):
+            try:
+                vals[i] = float(s) if s.strip() else 0.0
+                bad[i] = not s.strip()
+            except ValueError:
+                bad[i] = True
+        vals = vals.astype(sql_to_np(target))
+    elif target in DATETIME_TYPES:
+        vals = np.zeros(len(strs), dtype=np.int64)
+        bad = np.zeros(len(strs), dtype=bool)
+        for i, s in enumerate(strs):
+            try:
+                vals[i] = np.datetime64(s.strip(), "ns").astype(np.int64)
+            except ValueError:
+                bad[i] = True
+        if target == SqlType.DATE:
+            vals = (vals // _NS_PER_DAY) * _NS_PER_DAY
+    elif target == SqlType.BOOLEAN:
+        low = np.char.lower(np.char.strip(strs.astype(str)))
+        vals = np.isin(low, ("true", "t", "1", "yes"))
+        bad = ~np.isin(low, ("true", "t", "1", "yes", "false", "f", "0", "no"))
+    else:
+        raise NotImplementedError(f"cast VARCHAR -> {target}")
+    lut = jnp.asarray(vals)
+    codes = jnp.clip(col.data, 0, len(strs) - 1)
+    data = lut[codes]
+    validity = col.validity
+    if bad is not None and bad.any():
+        ok = jnp.asarray(~bad)[codes]
+        validity = ok if validity is None else (validity & ok)
+    return Column(data, target, validity)
+
+
+def _cast_to_string(col: Column, target: SqlType) -> Column:
+    """Numeric/datetime -> string: factorize on device, format uniques on host."""
+    vals = np.asarray(col.data)
+    uniq, codes = np.unique(vals, return_inverse=True)
+    if col.sql_type in DATETIME_TYPES:
+        if col.sql_type == SqlType.DATE:
+            strs = np.array([str(np.datetime64(int(v), "ns").astype("datetime64[D]")) for v in uniq], dtype=object)
+        else:
+            strs = np.array([_fmt_ts(int(v)) for v in uniq], dtype=object)
+    elif col.sql_type == SqlType.BOOLEAN:
+        strs = np.array(["false", "true"], dtype=object)
+        codes = vals.astype(np.int32)
+        return Column(jnp.asarray(codes), target, col.validity, strs)
+    elif uniq.dtype.kind == "f":
+        strs = np.array([_fmt_float(v) for v in uniq], dtype=object)
+    else:
+        strs = np.array([str(v) for v in uniq], dtype=object)
+    if len(strs) == 0:
+        strs = np.array([""], dtype=object)
+        codes = np.zeros(len(vals), dtype=np.int32)
+    return Column(jnp.asarray(codes.astype(np.int32)), target, col.validity, strs)
+
+
+def _fmt_ts(ns: int) -> str:
+    dt = np.datetime64(ns, "ns")
+    s = str(dt.astype("datetime64[s]")).replace("T", " ")
+    frac = ns % 1_000_000_000
+    if frac:
+        s += f".{frac:09d}".rstrip("0")
+    return s
+
+
+def _fmt_float(v: float) -> str:
+    if np.isnan(v):
+        return "NaN"
+    if v == int(v) and abs(v) < 1e16:
+        return f"{v:.1f}"
+    return repr(float(v))

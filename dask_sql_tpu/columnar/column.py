@@ -1,0 +1,220 @@
+"""Device-resident column: the unit of data in the TPU backend.
+
+Role parity: a single pandas Series inside a dask partition (reference
+`dask_sql/datacontainer.py` works over `dd.Series`).  TPU-first re-design:
+
+- the value buffer is a flat jax array in HBM (numeric / encoded),
+- NULLs are an explicit boolean validity mask (pandas nullable dtypes don't exist on
+  device — SURVEY.md §7 "NULL semantics"),
+- strings are dictionary-encoded: an int32 code array on device plus a host-side
+  numpy object array of unique values.  All string *equality/hashing/grouping* then
+  runs on the MXU/VPU as integer ops; only regex-ish ops (LIKE) touch the host
+  dictionary (which is tiny compared to the data).
+- datetimes are int64 nanoseconds since epoch.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from .dtypes import (
+    DATETIME_TYPES,
+    INTERVAL_TYPES,
+    STRING_TYPES,
+    SqlType,
+    np_to_sql,
+    sql_to_np,
+)
+
+_NS_PER_DAY = 86_400_000_000_000
+
+
+@dataclass(frozen=True)
+class Column:
+    data: jnp.ndarray  # 1-D device buffer
+    sql_type: SqlType
+    validity: Optional[jnp.ndarray] = None  # bool, True = valid; None = all-valid
+    dictionary: Optional[np.ndarray] = None  # host uniques for STRING_TYPES
+
+    # -- construction -------------------------------------------------------
+    @staticmethod
+    def from_numpy(arr: np.ndarray, mask: Optional[np.ndarray] = None) -> "Column":
+        """Build a Column from a host numpy array (+ optional validity mask)."""
+        kind = arr.dtype.kind
+        if kind == "M":  # datetime64 -> ns int64
+            ns = arr.astype("datetime64[ns]").view("int64")
+            nat = ns == np.iinfo(np.int64).min
+            mask = _merge_mask(mask, ~nat)
+            return Column(jnp.asarray(ns), SqlType.TIMESTAMP, _dev_mask(mask))
+        if kind == "m":  # timedelta64 -> ns int64
+            ns = arr.astype("timedelta64[ns]").view("int64")
+            nat = ns == np.iinfo(np.int64).min
+            mask = _merge_mask(mask, ~nat)
+            return Column(jnp.asarray(ns), SqlType.INTERVAL_DAY_TIME, _dev_mask(mask))
+        if kind in ("O", "U", "S"):
+            return Column._encode_strings(arr, mask)
+        if kind == "f":
+            nan = np.isnan(arr)
+            if nan.any():
+                mask = _merge_mask(mask, ~nan)
+        sql_type = np_to_sql(arr.dtype)
+        return Column(jnp.asarray(arr), sql_type, _dev_mask(mask))
+
+    @staticmethod
+    def _encode_strings(arr: np.ndarray, mask: Optional[np.ndarray]) -> "Column":
+        obj = np.asarray(arr, dtype=object)
+        isnull = np.array([v is None or (isinstance(v, float) and np.isnan(v)) for v in obj])
+        mask = _merge_mask(mask, ~isnull)
+        filled = obj.copy()
+        filled[isnull] = ""
+        uniques, codes = np.unique(filled.astype(str), return_inverse=True)
+        return Column(
+            jnp.asarray(codes.astype(np.int32)),
+            SqlType.VARCHAR,
+            _dev_mask(mask),
+            uniques.astype(object),
+        )
+
+    @staticmethod
+    def from_scalar(value, length: int, sql_type: Optional[SqlType] = None) -> "Column":
+        """Broadcast a python scalar to a column of the given length."""
+        from .dtypes import python_to_sql_type
+
+        if value is None:
+            st = sql_type or SqlType.DOUBLE
+            data = jnp.zeros(length, dtype=sql_to_np(st))
+            return Column(data, st, jnp.zeros(length, dtype=bool),
+                          np.array([""], dtype=object) if st in STRING_TYPES else None)
+        if isinstance(value, str):
+            return Column(
+                jnp.zeros(length, dtype=jnp.int32), SqlType.VARCHAR, None,
+                np.array([value], dtype=object),
+            )
+        if isinstance(value, np.datetime64):
+            ns = value.astype("datetime64[ns]").astype(np.int64)
+            return Column(jnp.full(length, ns, dtype=jnp.int64), SqlType.TIMESTAMP)
+        if isinstance(value, np.timedelta64):
+            ns = value.astype("timedelta64[ns]").astype(np.int64)
+            return Column(jnp.full(length, ns, dtype=jnp.int64), SqlType.INTERVAL_DAY_TIME)
+        st = sql_type or python_to_sql_type(value)
+        return Column(jnp.full(length, value, dtype=sql_to_np(st)), st)
+
+    # -- basic properties ---------------------------------------------------
+    def __len__(self) -> int:
+        return int(self.data.shape[0])
+
+    @property
+    def has_nulls(self) -> bool:
+        return self.validity is not None and not bool(jnp.all(self.validity))
+
+    def valid_mask(self) -> jnp.ndarray:
+        """Always-materialized validity mask."""
+        if self.validity is None:
+            return jnp.ones(len(self), dtype=bool)
+        return self.validity
+
+    # -- transformations ----------------------------------------------------
+    def with_data(self, data: jnp.ndarray, sql_type: Optional[SqlType] = None) -> "Column":
+        return replace(self, data=data, sql_type=sql_type or self.sql_type)
+
+    def take(self, indices: jnp.ndarray) -> "Column":
+        """Row gather (join/materialize/sort primitive)."""
+        validity = None if self.validity is None else self.validity[indices]
+        return replace(self, data=self.data[indices], validity=validity)
+
+    def filter(self, mask) -> "Column":
+        """Keep rows where mask is True (eager, data-dependent shape)."""
+        mask = jnp.asarray(mask)
+        validity = None if self.validity is None else self.validity[mask]
+        return replace(self, data=self.data[mask], validity=validity)
+
+    def slice(self, start: int, stop: int) -> "Column":
+        validity = None if self.validity is None else self.validity[start:stop]
+        return replace(self, data=self.data[start:stop], validity=validity)
+
+    def compact_dictionary(self) -> "Column":
+        """Re-encode so the dictionary contains only referenced values, sorted.
+
+        Sorted dictionaries make string ORDER BY / comparisons pure integer ops.
+        """
+        if self.dictionary is None:
+            return self
+        codes = np.asarray(self.data)
+        used = np.unique(codes)
+        used = used[(used >= 0) & (used < len(self.dictionary))]
+        sub = self.dictionary[used].astype(str)
+        order = np.argsort(sub, kind="stable")
+        new_dict = sub[order].astype(object)
+        remap = np.zeros(max(len(self.dictionary), 1), dtype=np.int32)
+        remap[used[order]] = np.arange(len(used), dtype=np.int32)
+        new_codes = remap[np.clip(codes, 0, len(remap) - 1)]
+        return Column(jnp.asarray(new_codes), self.sql_type, self.validity, new_dict)
+
+    def cast(self, target: SqlType) -> "Column":
+        from . import casts
+
+        return casts.cast_column(self, target)
+
+    # -- host materialization ----------------------------------------------
+    def to_numpy(self) -> np.ndarray:
+        """Materialize to a host numpy array with NULLs as None/NaN/NaT."""
+        data = np.asarray(self.data)
+        mask = None if self.validity is None else ~np.asarray(self.validity)
+        if self.sql_type in STRING_TYPES:
+            codes = np.clip(data, 0, max(len(self.dictionary) - 1, 0))
+            out = self.dictionary[codes].astype(object) if len(self.dictionary) else np.full(len(data), "", dtype=object)
+            if mask is not None:
+                out[mask] = None
+            return out
+        if self.sql_type in DATETIME_TYPES:
+            out = data.view("datetime64[ns]") if data.dtype == np.int64 else data.astype("datetime64[ns]")
+            out = out.copy()
+            if self.sql_type == SqlType.DATE:
+                pass  # stored as ns at midnight; keep datetime64 for pandas parity
+            if mask is not None:
+                out[mask] = np.datetime64("NaT")
+            return out
+        if self.sql_type == SqlType.INTERVAL_DAY_TIME:
+            out = data.view("timedelta64[ns]").copy()
+            if mask is not None:
+                out[mask] = np.timedelta64("NaT")
+            return out
+        if mask is not None and mask.any():
+            if data.dtype.kind == "f":
+                out = data.copy()
+                out[mask] = np.nan
+                return out
+            if data.dtype.kind == "b":
+                out = data.astype(object)
+                out[mask] = None
+                return out
+            # int with NULLs -> float64 + NaN (pandas behaviour)
+            out = data.astype(np.float64)
+            out[mask] = np.nan
+            return out
+        return data
+
+    def to_pandas(self, name: str = "col"):
+        import pandas as pd
+
+        return pd.Series(self.to_numpy(), name=name)
+
+
+def _merge_mask(a: Optional[np.ndarray], b: Optional[np.ndarray]) -> Optional[np.ndarray]:
+    if a is None:
+        return b
+    if b is None:
+        return a
+    return a & b
+
+
+def _dev_mask(mask: Optional[np.ndarray]) -> Optional[jnp.ndarray]:
+    if mask is None:
+        return None
+    mask = np.asarray(mask, dtype=bool)
+    if mask.all():
+        return None
+    return jnp.asarray(mask)

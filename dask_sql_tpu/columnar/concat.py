@@ -1,0 +1,56 @@
+"""Vertical concatenation of Tables (UNION ALL / multi-file scan primitive)."""
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from .column import Column
+from .dtypes import STRING_TYPES, promote
+from .table import Table
+
+
+def concat_columns(cols: Sequence[Column]) -> Column:
+    """Concatenate columns, promoting types and merging string dictionaries."""
+    target = cols[0].sql_type
+    for c in cols[1:]:
+        target = promote(target, c.sql_type)
+    cols = [c.cast(target) for c in cols]
+    total = sum(len(c) for c in cols)
+    if target in STRING_TYPES:
+        # merge dictionaries: build a combined dictionary, remap each code block
+        dicts = [c.dictionary if c.dictionary is not None else np.array([], dtype=object) for c in cols]
+        merged = np.unique(np.concatenate([d.astype(str) for d in dicts]) if dicts else np.array([], dtype=str))
+        if len(merged) == 0:
+            merged = np.array([""], dtype=str)
+        parts = []
+        for c, d in zip(cols, dicts):
+            if len(d) == 0:
+                parts.append(jnp.zeros(len(c), dtype=jnp.int32))
+                continue
+            remap = jnp.asarray(np.searchsorted(merged, d.astype(str)).astype(np.int32))
+            parts.append(remap[jnp.clip(c.data, 0, len(d) - 1)])
+        data = jnp.concatenate(parts) if parts else jnp.zeros(0, dtype=jnp.int32)
+        validity = _concat_validity(cols)
+        return Column(data, target, validity, merged.astype(object))
+    data = jnp.concatenate([c.data for c in cols]) if cols else jnp.zeros(0)
+    return Column(data, target, _concat_validity(cols))
+
+
+def _concat_validity(cols: Sequence[Column]):
+    if all(c.validity is None for c in cols):
+        return None
+    return jnp.concatenate([c.valid_mask() for c in cols])
+
+
+def concat_tables(tables: Sequence[Table]) -> Table:
+    if len(tables) == 1:
+        return tables[0]
+    names = tables[0].column_names
+    out = {}
+    for i, name in enumerate(names):
+        # positional alignment (SQL UNION semantics), names from the first table
+        cols = [t.columns[t.column_names[i]] for t in tables]
+        out[name] = concat_columns(cols)
+    return Table(out, sum(t.num_rows for t in tables))

@@ -1,0 +1,126 @@
+"""Device-resident columnar table: the unit a plan node produces/consumes.
+
+Role parity: one dask DataFrame in the reference (SURVEY.md §1 layer 3).  Here a
+table is an ordered mapping of backend column names to `Column`s, all of equal
+length, resident in device HBM.  Distribution is handled above this layer
+(`dask_sql_tpu.parallel`): a distributed table is this same structure with jax
+arrays sharded over a `Mesh` via NamedSharding.
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from .column import Column
+from .dtypes import SqlType
+
+
+class Table:
+    __slots__ = ("columns", "_num_rows")
+
+    def __init__(self, columns: Dict[str, Column], num_rows: Optional[int] = None):
+        self.columns: Dict[str, Column] = dict(columns)
+        if num_rows is None:
+            num_rows = len(next(iter(self.columns.values()))) if self.columns else 0
+        self._num_rows = num_rows
+        for name, col in self.columns.items():
+            assert len(col) == num_rows, f"column {name}: {len(col)} != {num_rows}"
+
+    # -- construction -------------------------------------------------------
+    @staticmethod
+    def from_pandas(df) -> "Table":
+        cols = {}
+        for name in df.columns:
+            ser = df[name]
+            mask = None
+            values = ser.to_numpy()
+            if ser.isna().any():
+                mask = ~ser.isna().to_numpy()
+                if values.dtype.kind in ("i", "u", "b"):
+                    pass  # no NaN possible; mask already captured
+            if str(ser.dtype) in ("string", "str") or ser.dtype == object:
+                values = ser.astype(object).to_numpy()
+            elif values.dtype.kind not in ("O", "U", "S", "M", "m", "f", "i", "u", "b"):
+                values = ser.astype(object).to_numpy()
+            cols[str(name)] = Column.from_numpy(values, mask)
+        return Table(cols, len(df))
+
+    @staticmethod
+    def from_arrow(arrow_table) -> "Table":
+        from . import interop
+
+        return interop.arrow_to_table(arrow_table)
+
+    # -- basic properties ---------------------------------------------------
+    @property
+    def num_rows(self) -> int:
+        return self._num_rows
+
+    @property
+    def column_names(self) -> List[str]:
+        return list(self.columns.keys())
+
+    def __len__(self) -> int:
+        return self._num_rows
+
+    def __contains__(self, name: str) -> bool:
+        return name in self.columns
+
+    def __getitem__(self, name: str) -> Column:
+        return self.columns[name]
+
+    # -- transformations (all return new Tables; columns are immutable) -----
+    def select(self, names: Sequence[str]) -> "Table":
+        return Table({n: self.columns[n] for n in names}, self._num_rows)
+
+    def assign(self, **new_cols: Column) -> "Table":
+        cols = dict(self.columns)
+        cols.update(new_cols)
+        return Table(cols, self._num_rows)
+
+    def rename(self, mapping: Dict[str, str]) -> "Table":
+        return Table({mapping.get(n, n): c for n, c in self.columns.items()}, self._num_rows)
+
+    def filter(self, mask) -> "Table":
+        cols = {n: c.filter(mask) for n, c in self.columns.items()}
+        n = len(next(iter(cols.values()))) if cols else int(np.asarray(mask).sum())
+        return Table(cols, n)
+
+    def take(self, indices) -> "Table":
+        indices = jnp.asarray(indices)
+        return Table({n: c.take(indices) for n, c in self.columns.items()}, int(indices.shape[0]))
+
+    def slice(self, start: int, stop: int) -> "Table":
+        stop = min(stop, self._num_rows)
+        start = min(start, stop)
+        return Table({n: c.slice(start, stop) for n, c in self.columns.items()}, stop - start)
+
+    def head(self, n: int) -> "Table":
+        return self.slice(0, n)
+
+    @staticmethod
+    def concat(tables: Sequence["Table"]) -> "Table":
+        """Vertical concatenation (UNION ALL primitive)."""
+        from .concat import concat_tables
+
+        return concat_tables(tables)
+
+    # -- host materialization ----------------------------------------------
+    def to_pandas(self):
+        import pandas as pd
+
+        data = {n: c.to_numpy() for n, c in self.columns.items()}
+        if not data:
+            return pd.DataFrame(index=range(self._num_rows))
+        return pd.DataFrame(data)
+
+    def to_arrow(self):
+        from . import interop
+
+        return interop.table_to_arrow(self)
+
+    def __repr__(self) -> str:
+        cols = ", ".join(f"{n}:{c.sql_type.value}" for n, c in self.columns.items())
+        return f"Table[{self._num_rows} rows]({cols})"
