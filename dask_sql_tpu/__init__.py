@@ -1,0 +1,29 @@
+"""dask_sql_tpu: a TPU-native distributed SQL engine.
+
+Public surface parity with the reference dask-sql package
+(dask_sql/__init__.py there exports Context, run_server, cmd_loop,
+Statistics).
+"""
+import jax as _jax
+
+# SQL needs 64-bit ints/floats end-to-end; enable before any array is made.
+_jax.config.update("jax_enable_x64", True)
+
+from .context import Context, TpuFrame  # noqa: E402
+from .datacontainer import Statistics  # noqa: E402
+
+
+def run_server(context=None, **kwargs):  # pragma: no cover - thin wrapper
+    from .server.app import run_server as _run
+
+    return _run(context=context, **kwargs)
+
+
+def cmd_loop(context=None, **kwargs):  # pragma: no cover - thin wrapper
+    from .cmd import cmd_loop as _loop
+
+    return _loop(context=context, **kwargs)
+
+
+__version__ = "0.1.0"
+__all__ = ["Context", "TpuFrame", "Statistics", "run_server", "cmd_loop", "__version__"]

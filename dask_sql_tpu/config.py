@@ -1,0 +1,77 @@
+"""Configuration system.
+
+Role parity: reference piggybacks on dask.config with `sql.yaml` defaults +
+`sql-schema.yaml` docs (config.py:1-12 there).  Self-contained here: a
+process-global nested config with the same `sql.*` keys, `set()` context
+manager for per-query overrides (Context.sql(config_options=...)).
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Any, Dict, Optional
+
+DEFAULTS: Dict[str, Any] = {
+    # parity: dask_sql/sql.yaml keys
+    "sql.aggregate.split_out": 1,
+    "sql.aggregate.split_every": None,
+    "sql.identifier.case_sensitive": True,
+    "sql.join.broadcast": None,  # None=auto, False=never, number=row threshold
+    "sql.limit.check-first-partition": True,
+    "sql.optimize": True,
+    "sql.predicate_pushdown": True,
+    "sql.dynamic_partition_pruning": True,
+    "sql.optimizer.verbose": False,
+    "sql.optimizer.fact_dimension_ratio": 0.7,
+    "sql.optimizer.max_fact_tables": 2,
+    "sql.optimizer.preserve_user_order": True,
+    "sql.optimizer.filter_selectivity": 1.0,
+    "sql.sort.topk-nelem-limit": 1000000,
+    "sql.mappings.decimal_support": "float64",
+    # TPU-native additions
+    "sql.backend.default": "tpu",
+    "sql.shuffle.num_buckets": None,  # None = number of devices
+}
+
+
+class Config:
+    def __init__(self):
+        self._values: Dict[str, Any] = dict(DEFAULTS)
+        self._lock = threading.RLock()
+
+    def get(self, key: str, default: Any = None) -> Any:
+        with self._lock:
+            if key in self._values:
+                return self._values[key]
+            return DEFAULTS.get(key, default)
+
+    def update(self, options: Optional[Dict[str, Any]]) -> None:
+        if not options:
+            return
+        with self._lock:
+            self._values.update(options)
+
+    @contextlib.contextmanager
+    def set(self, options: Optional[Dict[str, Any]] = None, **kwargs):
+        options = dict(options or {})
+        options.update(kwargs)
+        with self._lock:
+            saved = {k: self._values.get(k, DEFAULTS.get(k)) for k in options}
+            self._values.update(options)
+        try:
+            yield self
+        finally:
+            with self._lock:
+                self._values.update(saved)
+
+
+#: process-global config (parity: dask.config global)
+config = Config()
+
+
+def get(key: str, default: Any = None) -> Any:
+    return config.get(key, default)
+
+
+def set(options: Optional[Dict[str, Any]] = None, **kwargs):
+    return config.set(options, **kwargs)

@@ -1,0 +1,434 @@
+"""The Context: the main user-facing object of the framework.
+
+Role parity: reference `Context` (context.py:51 there) — create_table
+(context.py:168), sql (context.py:482), explain (context.py:535),
+register_function (context.py:324), register_aggregation (context.py:415),
+register_model (context.py:626), schema DDL (context.py:580-613), run_server
+(context.py:704), ipython magic (context.py:651), plus the per-query catalog
+sync of _prepare_schemas (context.py:749-817) and plan driving of _get_ral
+(context.py:819) / _compute_table_from_rel (context.py:874).
+
+TPU-native differences: tables live in device HBM as columnar Tables
+(`backend='tpu'`, with a CPU/pandas ingest path preserved); the planner is
+in-process (planner/) instead of a PyO3 Rust module; execution lowers to
+jax/XLA kernels through the physical plugin registries.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Any, Callable, Dict, List, Optional, Tuple, Union
+
+import numpy as np
+
+from . import config as config_module
+from .columnar.dtypes import SqlType, np_to_sql
+from .columnar.table import Table
+from .datacontainer import (
+    ColumnContainer,
+    DataContainer,
+    FunctionDescription,
+    SchemaContainer,
+    Statistics,
+)
+from .input_utils import InputUtil
+from .planner.binder import Binder, BindError
+from .planner.catalog import Catalog, CatalogSchema, CatalogTable
+from .planner.expressions import Field
+from .planner.parser import ParsingException, parse_sql
+from .planner import plan as plan_nodes
+from .planner.optimizer import optimize_plan
+
+logger = logging.getLogger(__name__)
+
+
+class TpuFrame:
+    """Lazy query result: holds the optimized plan; executes on `.compute()`.
+
+    Parity: the lazy dask DataFrame the reference returns from Context.sql
+    (return_futures=True default, context.py:508).
+    """
+
+    def __init__(self, context: "Context", plan, field_names: List[str]):
+        self._context = context
+        self._plan = plan
+        self._field_names = field_names
+        self._result: Optional[Table] = None
+
+    @property
+    def plan(self):
+        return self._plan
+
+    @property
+    def columns(self) -> List[str]:
+        return list(self._field_names)
+
+    def execute(self) -> Table:
+        """Run the plan to a device Table (cached)."""
+        if self._result is None:
+            from .physical.executor import Executor
+
+            executor = Executor(self._context)
+            self._result = executor.execute(self._plan)
+        return self._result
+
+    def compute(self):
+        """Materialize to a pandas DataFrame with the SQL output names."""
+        table = self.execute()
+        df = table.to_pandas()
+        df.columns = self._disambiguated_names()
+        return df
+
+    def _disambiguated_names(self) -> List[str]:
+        # parity: reference renames duplicate output fields with FQN hints
+        # (context.py:890-906); we suffix duplicates positionally
+        seen: Dict[str, int] = {}
+        out = []
+        for n in self._field_names:
+            if n in seen:
+                seen[n] += 1
+                out.append(f"{n}{seen[n]}")
+            else:
+                seen[n] = 0
+                out.append(n)
+        return out
+
+    def persist(self) -> "TpuFrame":
+        self.execute()
+        return self
+
+    def head(self, n: int = 5):
+        return self.compute().head(n)
+
+    def __len__(self) -> int:
+        return self.execute().num_rows
+
+    def explain_str(self) -> str:
+        return self._plan.explain()
+
+
+class Context:
+    DEFAULT_SCHEMA_NAME = "root"
+
+    def __init__(self, logging_level=logging.INFO):
+        self.schema_name = self.DEFAULT_SCHEMA_NAME
+        self.schema: Dict[str, SchemaContainer] = {
+            self.DEFAULT_SCHEMA_NAME: SchemaContainer(self.DEFAULT_SCHEMA_NAME)
+        }
+        self._views: Dict[str, Dict[str, Any]] = {self.DEFAULT_SCHEMA_NAME: {}}
+        self.config = config_module.config
+        self.server = None
+        logging.basicConfig(level=logging_level)
+
+    # ------------------------------------------------------------ tables
+    def create_table(
+        self,
+        table_name: str,
+        input_table: Any,
+        format: Optional[str] = None,
+        persist: bool = False,
+        schema_name: Optional[str] = None,
+        statistics: Optional[Statistics] = None,
+        backend: Optional[str] = None,
+        gpu: bool = False,
+        **kwargs,
+    ) -> None:
+        """Register a table (parity: context.py:168).  `backend='tpu'`
+        (default) lands columns in device HBM; the reference's `gpu=` flag is
+        accepted and treated as a backend hint."""
+        schema_name = schema_name or self.schema_name
+        if schema_name not in self.schema:
+            raise KeyError(f"Schema {schema_name} not found")
+        dc = InputUtil.to_dc(input_table, table_name, format=format,
+                             persist=persist, **kwargs)
+        self.schema[schema_name].tables[table_name] = dc
+        if statistics is None and dc.table.num_rows:
+            statistics = Statistics(float(dc.table.num_rows))
+        if statistics is not None:
+            self.schema[schema_name].statistics[table_name] = statistics
+        filepath = getattr(dc, "filepath", None)
+        if filepath:
+            self.schema[schema_name].filepaths[table_name] = filepath
+        self._views.setdefault(schema_name, {}).pop(table_name, None)
+
+    def drop_table(self, table_name: str, schema_name: Optional[str] = None) -> None:
+        schema_name = schema_name or self.schema_name
+        self.schema[schema_name].tables.pop(table_name, None)
+        self.schema[schema_name].statistics.pop(table_name, None)
+        self._views.get(schema_name, {}).pop(table_name, None)
+
+    def alter_table(self, old_name: str, new_name: str,
+                    schema_name: Optional[str] = None) -> None:
+        schema_name = schema_name or self.schema_name
+        tables = self.schema[schema_name].tables
+        if old_name in tables:
+            tables[new_name] = tables.pop(old_name)
+        stats = self.schema[schema_name].statistics
+        if old_name in stats:
+            stats[new_name] = stats.pop(old_name)
+
+    # ------------------------------------------------------------ schemas
+    def create_schema(self, schema_name: str) -> None:
+        self.schema[schema_name] = SchemaContainer(schema_name)
+        self._views.setdefault(schema_name, {})
+
+    def drop_schema(self, schema_name: str) -> None:
+        if schema_name == self.schema_name:
+            self.schema_name = self.DEFAULT_SCHEMA_NAME
+        self.schema.pop(schema_name, None)
+        self._views.pop(schema_name, None)
+
+    def alter_schema(self, old_name: str, new_name: str) -> None:
+        if old_name in self.schema:
+            container = self.schema.pop(old_name)
+            container.name = new_name
+            self.schema[new_name] = container
+            self._views[new_name] = self._views.pop(old_name, {})
+            if self.schema_name == old_name:
+                self.schema_name = new_name
+
+    # ------------------------------------------------------------ functions
+    def register_function(
+        self,
+        f: Callable,
+        name: str,
+        parameters: List[Tuple[str, Any]],
+        return_type: Any,
+        replace: bool = False,
+        schema_name: Optional[str] = None,
+        row_udf: bool = False,
+    ) -> None:
+        """Scalar UDF registration (parity: context.py:324).  Non-row UDFs
+        receive jax arrays and should be jax-traceable for fusion."""
+        self._register_callable(f, name, parameters, return_type, False,
+                                replace, schema_name, row_udf)
+
+    def register_aggregation(
+        self,
+        f: Callable,
+        name: str,
+        parameters: List[Tuple[str, Any]],
+        return_type: Any,
+        replace: bool = False,
+        schema_name: Optional[str] = None,
+    ) -> None:
+        """Custom aggregation (parity: context.py:415): `f` is applied to a
+        pandas GroupBy on the host fallback path."""
+        self._register_callable(f, name, parameters, return_type, True,
+                                replace, schema_name, False)
+
+    def _register_callable(self, f, name, parameters, return_type, aggregation,
+                           replace, schema_name, row_udf):
+        schema_name = schema_name or self.schema_name
+        schema = self.schema[schema_name]
+        params = [(pname, _to_sql_type(ptype)) for pname, ptype in (parameters or [])]
+        fd = FunctionDescription(name, f, params, _to_sql_type(return_type),
+                                 aggregation, row_udf)
+        lower = name.lower()
+        existing = schema.function_lists.get(lower)
+        if existing and not replace:
+            # overload check (parity: context.py overload logic)
+            for other in existing:
+                if [t for _, t in other.parameters] == [t for _, t in params]:
+                    raise ValueError(
+                        f"Function {name} with signature already registered; "
+                        f"use replace=True")
+            existing.append(fd)
+        else:
+            schema.function_lists[lower] = [fd]
+        schema.functions[lower] = fd
+
+    # ------------------------------------------------------------ models
+    def register_model(self, model_name: str, model: Any,
+                       training_columns: List[str],
+                       schema_name: Optional[str] = None) -> None:
+        """Parity: context.py:626."""
+        schema_name = schema_name or self.schema_name
+        self.schema[schema_name].models[model_name] = (model, list(training_columns))
+
+    # ------------------------------------------------------------ queries
+    def sql(
+        self,
+        sql: Union[str, Any],
+        return_futures: bool = True,
+        dataframes: Optional[Dict[str, Any]] = None,
+        config_options: Optional[Dict[str, Any]] = None,
+    ):
+        """Parse, plan, optimize and (lazily) execute a SQL string
+        (parity: context.py:482)."""
+        if dataframes is not None:
+            for df_name, df in dataframes.items():
+                self.create_table(df_name, df)
+        with self.config.set(config_options or {}):
+            if not isinstance(sql, str):
+                raise ValueError("sql must be a string (plans are internal here)")
+            statements = parse_sql(sql)
+            result = None
+            for stmt in statements:
+                result = self._run_statement(stmt)
+            if result is None:
+                return None
+            if return_futures:
+                return result
+            return result.compute()
+
+    def _run_statement(self, stmt) -> Optional[TpuFrame]:
+        plan = self._get_ral(stmt)
+        frame = TpuFrame(self, plan, [f.name for f in plan.schema])
+        if isinstance(plan, plan_nodes.CustomNode) and not isinstance(
+                plan, (plan_nodes.PredictModelNode,)):
+            # DDL / side-effecting statements run eagerly (parity: reference
+            # converts them immediately, create_memory_table.py etc.)
+            table = frame.execute()
+            if not plan.schema:
+                return None
+            return frame
+        return frame
+
+    def explain(self, sql: str, dataframes: Optional[Dict[str, Any]] = None) -> str:
+        """Return the optimized logical plan as a string (parity context.py:535)."""
+        if dataframes is not None:
+            for df_name, df in dataframes.items():
+                self.create_table(df_name, df)
+        stmt = parse_sql(sql)[0]
+        plan = self._get_ral(stmt)
+        if isinstance(plan, plan_nodes.Explain):
+            plan = plan.input
+        return plan.explain()
+
+    def visualize(self, sql: str, filename: str = "mydask.png") -> None:
+        """Parity: context.py:573 — renders the plan tree (text fallback)."""
+        text = self.explain(sql)
+        with open(filename + ".txt" if not filename.endswith(".txt") else filename, "w") as f:
+            f.write(text)
+
+    # ------------------------------------------------------------ internals
+    def _get_ral(self, stmt):
+        """AST -> bound plan -> optimized plan (parity: context.py:819
+        _get_ral driving parse/bind/optimize in the Rust planner)."""
+        catalog = self._prepare_catalog()
+        case_sensitive = bool(self.config.get("sql.identifier.case_sensitive", True))
+        catalog.case_sensitive = case_sensitive
+        binder = Binder(catalog, case_sensitive=case_sensitive)
+        try:
+            plan = binder.bind_statement(stmt)
+        except BindError:
+            raise
+        if self.config.get("sql.optimize", True):
+            try:
+                plan = optimize_plan(plan, self.config, catalog)
+            except Exception:
+                # parity: optimizer failure falls back to the unoptimized plan
+                # (context.py:857-864)
+                logger.warning("Optimization failed; using unoptimized plan",
+                               exc_info=True)
+        return plan
+
+    def _prepare_catalog(self) -> Catalog:
+        """Sync python-side schema containers into a planner catalog
+        (parity: _prepare_schemas, context.py:749)."""
+        catalog = Catalog(self.schema_name)
+        catalog.current_schema = self.schema_name
+        for schema_name, container in self.schema.items():
+            catalog.add_schema(schema_name)
+            cschema = catalog.schemas[schema_name]
+            for table_name, dc in container.tables.items():
+                fields = [
+                    Field(name, col.sql_type, col.validity is not None or
+                          col.sql_type in (SqlType.FLOAT, SqlType.DOUBLE))
+                    for name, col in dc.table.columns.items()
+                ]
+                stats = container.statistics.get(table_name)
+                from .planner.catalog import Statistics as PStats
+
+                cschema.tables[table_name] = CatalogTable(
+                    table_name, schema_name, fields,
+                    PStats(stats.row_count if stats else None),
+                    container.filepaths.get(table_name),
+                )
+            for view_name, view_plan in self._views.get(schema_name, {}).items():
+                fields = list(view_plan.schema)
+                ct = CatalogTable(view_name, schema_name, fields)
+                ct.view_plan = view_plan
+                cschema.tables[view_name] = ct
+            for fname, fds in container.function_lists.items():
+                cschema.functions[fname] = list(fds)
+            cschema.models = container.models
+        return catalog
+
+    def _register_view(self, name: str, plan, schema_name: str) -> None:
+        self._views.setdefault(schema_name, {})[name] = plan
+
+    def _table_schema_name(self, parts: List[str]) -> Tuple[str, str]:
+        if len(parts) >= 2:
+            return parts[-2], parts[-1]
+        return self.schema_name, parts[0]
+
+    def _table_fields(self, schema_name: str, table_name: str):
+        dc = self.schema[schema_name].tables.get(table_name)
+        if dc is not None:
+            return [Field(n, c.sql_type, True) for n, c in dc.table.columns.items()]
+        view = self._views.get(schema_name, {}).get(table_name)
+        if view is not None:
+            return list(view.schema)
+        raise KeyError(f"Table {table_name} not found")
+
+    # -- executor services ---------------------------------------------------
+    def get_table_data(self, schema_name: str, table_name: str) -> Table:
+        dc = self.schema[schema_name].tables.get(table_name)
+        if dc is not None:
+            return dc.assign()
+        view = self._views.get(schema_name, {}).get(table_name)
+        if view is not None:
+            from .physical.executor import Executor
+
+            return Executor(self).execute(view)
+        raise KeyError(f"Table {schema_name}.{table_name} not found")
+
+    def lookup_function(self, name: str) -> Optional[FunctionDescription]:
+        schema = self.schema[self.schema_name]
+        return schema.functions.get(name.lower()) or schema.functions.get(name)
+
+    def get_model(self, schema_name: str, model_name: str):
+        models = self.schema[schema_name].models
+        if model_name not in models:
+            raise KeyError(f"A model with the name {model_name} is not present.")
+        return models[model_name]
+
+    # ------------------------------------------------------------ front-ends
+    def run_server(self, **kwargs):  # pragma: no cover - thin wrapper
+        """Presto-protocol HTTP server (parity: context.py:704)."""
+        from .server.app import run_server as _run
+
+        return _run(context=self, **kwargs)
+
+    def stop_server(self):  # pragma: no cover
+        if self.server is not None:
+            self.server.shutdown()
+        self.server = None
+
+    def ipython_magic(self, auto_include: bool = False):  # pragma: no cover
+        from .integrations.ipython import ipython_integration
+
+        ipython_integration(self, auto_include=auto_include)
+
+    def fqn(self, parts) -> Tuple[str, str]:
+        """Fully-qualified (schema, table) from a name (parity context helper)."""
+        return self._table_schema_name(list(parts))
+
+
+def _to_sql_type(t) -> SqlType:
+    if isinstance(t, SqlType):
+        return t
+    if isinstance(t, str):
+        from .columnar.dtypes import parse_sql_type
+
+        return parse_sql_type(t)
+    try:
+        return np_to_sql(np.dtype(t))
+    except Exception:
+        pass
+    mapping = {int: SqlType.BIGINT, float: SqlType.DOUBLE, str: SqlType.VARCHAR,
+               bool: SqlType.BOOLEAN}
+    if t in mapping:
+        return mapping[t]
+    raise NotImplementedError(f"Cannot map {t!r} to a SQL type")

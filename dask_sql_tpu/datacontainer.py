@@ -1,0 +1,109 @@
+"""Data containers: the objects the Context registers and returns.
+
+Role parity: reference datacontainer.py — ColumnContainer front/backend
+mapping with zero-copy renames (datacontainer.py:53-171), DataContainer.assign
+(datacontainer.py:217), SchemaContainer (datacontainer.py:281), Statistics
+(datacontainer.py:174), FunctionDescription (datacontainer.py:9), UDF wrapper
+(datacontainer.py:234-270).  Here the backend is a device `Table`; renames are
+dictionary-key rewrites (no data movement, like the reference's mapping).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+from .columnar.table import Table
+from .planner.catalog import FunctionDescription, Statistics  # re-export parity names
+
+__all__ = [
+    "ColumnContainer",
+    "DataContainer",
+    "SchemaContainer",
+    "Statistics",
+    "FunctionDescription",
+]
+
+
+class ColumnContainer:
+    """Frontend->backend column mapping: renames/reorders without touching data."""
+
+    def __init__(self, frontend_columns: List[str],
+                 frontend_backend_mapping: Optional[Dict[str, str]] = None):
+        self._frontend_columns = list(frontend_columns)
+        self._mapping = dict(frontend_backend_mapping or {c: c for c in frontend_columns})
+
+    @property
+    def columns(self) -> List[str]:
+        return list(self._frontend_columns)
+
+    def get_backend_by_frontend_name(self, name: str) -> str:
+        return self._mapping[name]
+
+    def get_backend_by_frontend_index(self, index: int) -> str:
+        return self._mapping[self._frontend_columns[index]]
+
+    def limit_to(self, frontend_columns: List[str]) -> "ColumnContainer":
+        return ColumnContainer(list(frontend_columns),
+                               {c: self._mapping[c] for c in frontend_columns})
+
+    def rename(self, columns: Dict[str, str]) -> "ColumnContainer":
+        new_front = [columns.get(c, c) for c in self._frontend_columns]
+        new_map = {}
+        for old, new in zip(self._frontend_columns, new_front):
+            new_map[new] = self._mapping[old]
+        return ColumnContainer(new_front, new_map)
+
+    def rename_handle_duplicates(self, from_columns: List[str],
+                                 to_columns: List[str]) -> "ColumnContainer":
+        new_map = {t: self._mapping[f] for f, t in zip(from_columns, to_columns)}
+        return ColumnContainer(list(to_columns), new_map)
+
+    def add(self, frontend_name: str, backend_name: Optional[str] = None) -> "ColumnContainer":
+        backend_name = backend_name if backend_name is not None else frontend_name
+        cc = ColumnContainer(self._frontend_columns, self._mapping)
+        if frontend_name not in cc._frontend_columns:
+            cc._frontend_columns.append(frontend_name)
+        cc._mapping[frontend_name] = backend_name
+        return cc
+
+    def make_unique(self, prefix: str = "col") -> "ColumnContainer":
+        new_names = [f"{prefix}_{i}" for i in range(len(self._frontend_columns))]
+        return self.rename_handle_duplicates(self._frontend_columns, new_names)
+
+
+class DataContainer:
+    """A device Table + its frontend column view."""
+
+    def __init__(self, table: Table, column_container: Optional[ColumnContainer] = None):
+        self.table = table
+        self.column_container = column_container or ColumnContainer(table.column_names)
+
+    @property
+    def df(self) -> Table:  # parity name: reference stores the dask df as .df
+        return self.table
+
+    def assign(self) -> Table:
+        """Materialize the frontend view as a concrete Table (parity
+        datacontainer.py:217)."""
+        cols = {}
+        for front in self.column_container.columns:
+            back = self.column_container.get_backend_by_frontend_name(front)
+            cols[front] = self.table.columns[back]
+        return Table(cols, self.table.num_rows)
+
+    def to_pandas(self):
+        return self.assign().to_pandas()
+
+
+@dataclass
+class SchemaContainer:
+    """Parity: reference SchemaContainer (datacontainer.py:281)."""
+
+    name: str
+    tables: Dict[str, DataContainer] = field(default_factory=dict)
+    statistics: Dict[str, Statistics] = field(default_factory=dict)
+    functions: Dict[str, FunctionDescription] = field(default_factory=dict)
+    function_lists: Dict[str, List[FunctionDescription]] = field(default_factory=dict)
+    models: Dict[str, Tuple[object, List[str]]] = field(default_factory=dict)
+    experiments: Dict[str, object] = field(default_factory=dict)
+    filepaths: Dict[str, str] = field(default_factory=dict)

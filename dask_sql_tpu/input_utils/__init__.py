@@ -1,0 +1,4 @@
+from .convert import InputUtil
+from .base import BaseInputPlugin
+
+__all__ = ["InputUtil", "BaseInputPlugin"]

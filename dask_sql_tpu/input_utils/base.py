@@ -1,0 +1,12 @@
+"""Input plugin base (parity: reference input_utils/base.py:4)."""
+from __future__ import annotations
+
+
+class BaseInputPlugin:
+    """Converts one kind of user input into a device-backed DataContainer."""
+
+    def is_correct_input(self, input_item, table_name: str, format: str = None, **kwargs) -> bool:
+        raise NotImplementedError
+
+    def to_dc(self, input_item, table_name: str, format: str = None, **kwargs):
+        raise NotImplementedError

@@ -1,0 +1,49 @@
+"""Input conversion driver (parity: reference input_utils/convert.py:43-92)."""
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+from ..datacontainer import DataContainer
+from .base import BaseInputPlugin
+from .plugins import (
+    ArrowInputPlugin,
+    DeviceTableInputPlugin,
+    DictInputPlugin,
+    HiveInputPlugin,
+    IntakeCatalogInputPlugin,
+    LocationInputPlugin,
+    PandasLikeInputPlugin,
+    SqlalchemyInputPlugin,
+)
+
+
+class InputUtil:
+    _plugins: List[BaseInputPlugin] = [
+        DeviceTableInputPlugin(),
+        ArrowInputPlugin(),
+        PandasLikeInputPlugin(),
+        DictInputPlugin(),
+        HiveInputPlugin(),
+        IntakeCatalogInputPlugin(),
+        SqlalchemyInputPlugin(),
+        LocationInputPlugin(),  # last: strings are the most generic
+    ]
+
+    @classmethod
+    def add_plugin_class(cls, plugin_class) -> None:
+        cls._plugins.insert(0, plugin_class())
+
+    @classmethod
+    def to_dc(cls, input_item: Any, table_name: str, format: Optional[str] = None,
+              persist: bool = False, **kwargs) -> DataContainer:
+        filepath = input_item if isinstance(input_item, str) else None
+        for plugin in cls._plugins:
+            try:
+                matches = plugin.is_correct_input(input_item, table_name, format=format, **kwargs)
+            except Exception:
+                matches = False
+            if matches:
+                dc = plugin.to_dc(input_item, table_name, format=format, **kwargs)
+                dc.filepath = filepath  # plan-time pruning hook (DaskTable.filepath parity)
+                return dc
+        raise ValueError(f"Do not understand the input type {type(input_item)}")

@@ -1,0 +1,182 @@
+"""Input plugins: heterogeneous inputs -> device DataContainer.
+
+Role parity (reference input_utils/): PandasLikeInputPlugin (pandaslike.py:7),
+LocationInputPlugin (location.py:11-54: paths -> read_<format>, memory format),
+DaskInputPlugin, HiveInputPlugin, IntakeCatalogInputPlugin, Sqlalchemy plugin.
+TPU-native: ingestion lands in Arrow then device HBM (columnar/interop.py);
+hive/intake/sqlalchemy are gated on their optional deps just like the
+reference.
+"""
+from __future__ import annotations
+
+import glob
+import os
+from typing import Any
+
+import numpy as np
+
+from ..columnar.table import Table
+from ..datacontainer import ColumnContainer, DataContainer
+from .base import BaseInputPlugin
+
+#: published "memory" datasets (parity: dask publish, location.py:27-34 there)
+_PUBLISHED: dict = {}
+
+
+def publish_dataset(name: str, dc: DataContainer) -> None:
+    _PUBLISHED[name] = dc
+
+
+def unpublish_dataset(name: str) -> None:
+    _PUBLISHED.pop(name, None)
+
+
+class PandasLikeInputPlugin(BaseInputPlugin):
+    """pandas (or any __dataframe__-ish) frame -> device table."""
+
+    def is_correct_input(self, input_item, table_name, format=None, **kwargs):
+        import pandas as pd
+
+        return isinstance(input_item, pd.DataFrame)
+
+    def to_dc(self, input_item, table_name, format=None, **kwargs):
+        return DataContainer(Table.from_pandas(input_item))
+
+
+class ArrowInputPlugin(BaseInputPlugin):
+    def is_correct_input(self, input_item, table_name, format=None, **kwargs):
+        try:
+            import pyarrow as pa
+        except ImportError:
+            return False
+        return isinstance(input_item, pa.Table)
+
+    def to_dc(self, input_item, table_name, format=None, **kwargs):
+        return DataContainer(Table.from_arrow(input_item))
+
+
+class DeviceTableInputPlugin(BaseInputPlugin):
+    """Already-device-resident Table / DataContainer (parity: DaskInputPlugin)."""
+
+    def is_correct_input(self, input_item, table_name, format=None, **kwargs):
+        return isinstance(input_item, (Table, DataContainer))
+
+    def to_dc(self, input_item, table_name, format=None, **kwargs):
+        if isinstance(input_item, DataContainer):
+            return input_item
+        return DataContainer(input_item)
+
+
+class DictInputPlugin(BaseInputPlugin):
+    def is_correct_input(self, input_item, table_name, format=None, **kwargs):
+        return isinstance(input_item, dict)
+
+    def to_dc(self, input_item, table_name, format=None, **kwargs):
+        import pandas as pd
+
+        return DataContainer(Table.from_pandas(pd.DataFrame(input_item)))
+
+
+class LocationInputPlugin(BaseInputPlugin):
+    """String locations: parquet/csv/json paths, globs, and format='memory'."""
+
+    def is_correct_input(self, input_item, table_name, format=None, **kwargs):
+        return isinstance(input_item, str)
+
+    def to_dc(self, input_item, table_name, format=None, **kwargs):
+        if format == "memory":
+            if input_item not in _PUBLISHED:
+                raise KeyError(f"No published dataset {input_item!r}")
+            return _PUBLISHED[input_item]
+        fmt = format
+        if not fmt:
+            ext = os.path.splitext(input_item.split("*")[0].rstrip("/"))[-1].lstrip(".")
+            fmt = ext or "parquet"
+        paths = sorted(glob.glob(input_item)) if any(ch in input_item for ch in "*?[") else [input_item]
+        if not paths:
+            raise FileNotFoundError(input_item)
+        if fmt in ("parquet", "pq"):
+            return self._read_parquet(paths, **kwargs)
+        if fmt == "csv":
+            return self._read_csv(paths, **kwargs)
+        if fmt == "json":
+            return self._read_json(paths, **kwargs)
+        raise NotImplementedError(f"Input format {fmt!r}")
+
+    def _read_parquet(self, paths, columns=None, filters=None, **kwargs):
+        import pyarrow.parquet as pq
+        import pyarrow as pa
+
+        tables = []
+        for path in paths:
+            if os.path.isdir(path):
+                inner = sorted(glob.glob(os.path.join(path, "**", "*.parquet"), recursive=True))
+                for f in inner:
+                    tables.append(pq.read_table(f, columns=columns, filters=filters))
+            else:
+                tables.append(pq.read_table(path, columns=columns, filters=filters))
+        at = pa.concat_tables(tables) if len(tables) > 1 else tables[0]
+        return DataContainer(Table.from_arrow(at))
+
+    def _read_csv(self, paths, **kwargs):
+        import pandas as pd
+
+        frames = [pd.read_csv(p, **{k: v for k, v in kwargs.items()
+                                    if k not in ("persist", "backend", "gpu", "statistics")})
+                  for p in paths]
+        df = pd.concat(frames, ignore_index=True) if len(frames) > 1 else frames[0]
+        return DataContainer(Table.from_pandas(df))
+
+    def _read_json(self, paths, **kwargs):
+        import pandas as pd
+
+        frames = [pd.read_json(p, lines=kwargs.get("lines", True)) for p in paths]
+        df = pd.concat(frames, ignore_index=True) if len(frames) > 1 else frames[0]
+        return DataContainer(Table.from_pandas(df))
+
+
+class HiveInputPlugin(BaseInputPlugin):
+    """Hive cursor input (parity: reference hive.py:27).  Gated on pyhive."""
+
+    def is_correct_input(self, input_item, table_name, format=None, **kwargs):
+        type_name = ".".join([type(input_item).__module__, type(input_item).__name__])
+        return "pyhive" in type_name or "hive" in type_name.lower() and hasattr(input_item, "execute")
+
+    def to_dc(self, input_item, table_name, format=None, **kwargs):
+        cursor = input_item
+        hive_table = kwargs.get("hive_table_name", table_name)
+        schema = kwargs.get("hive_schema_name", "default")
+        cursor.execute(f"DESCRIBE FORMATTED {schema}.{hive_table}")
+        raise NotImplementedError(
+            "Hive metastore ingestion requires pyhive at runtime; register the "
+            "underlying files directly (parquet/csv locations) instead."
+        )
+
+
+class IntakeCatalogInputPlugin(BaseInputPlugin):
+    """Intake catalog input (parity: reference intake.py:11).  Gated on intake."""
+
+    def is_correct_input(self, input_item, table_name, format=None, **kwargs):
+        type_name = ".".join([type(input_item).__module__, type(input_item).__name__])
+        return type_name.startswith("intake.")
+
+    def to_dc(self, input_item, table_name, format=None, **kwargs):
+        intake_table = kwargs.get("intake_table_name", table_name)
+        source = getattr(input_item, intake_table)
+        df = source.read()
+        return DataContainer(Table.from_pandas(df))
+
+
+class SqlalchemyInputPlugin(BaseInputPlugin):
+    """sqlalchemy connection/engine input (parity: reference sqlalchemy.py:6)."""
+
+    def is_correct_input(self, input_item, table_name, format=None, **kwargs):
+        type_name = type(input_item).__module__
+        return type_name.startswith("sqlalchemy")
+
+    def to_dc(self, input_item, table_name, format=None, **kwargs):
+        import pandas as pd
+
+        query = kwargs.get("query", f"SELECT * FROM {table_name}")
+        df = pd.read_sql(query, input_item)
+        return DataContainer(Table.from_pandas(df))

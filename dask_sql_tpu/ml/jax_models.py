@@ -1,0 +1,162 @@
+"""JAX-native estimators: the TPU-first model family for CREATE MODEL.
+
+Where the reference defers to sklearn/cuML/XGBoost classes (ml_classes.py
+there), this module provides device-resident equivalents trained with jitted
+full-batch gradient steps — the natural fit for columns already in HBM.
+sklearn-compatible API (fit/predict/get_params) so the same SQL surface and
+wrappers drive either family.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class _JaxEstimator:
+    def get_params(self, deep: bool = True):
+        return {k: v for k, v in self.__dict__.items() if not k.startswith("_")}
+
+    def set_params(self, **params):
+        for k, v in params.items():
+            setattr(self, k, v)
+        return self
+
+
+class LinearRegression(_JaxEstimator):
+    """Closed-form / gradient linear regression on device (bf16-friendly matmuls)."""
+
+    def __init__(self, fit_intercept: bool = True, l2: float = 0.0):
+        self.fit_intercept = fit_intercept
+        self.l2 = l2
+        self._w = None
+
+    def fit(self, X, y, **kwargs):
+        X = jnp.asarray(np.asarray(X, dtype=np.float32))
+        y = jnp.asarray(np.asarray(y, dtype=np.float32)).reshape(-1)
+        if self.fit_intercept:
+            X = jnp.concatenate([X, jnp.ones((X.shape[0], 1), dtype=X.dtype)], axis=1)
+        # normal equations via MXU matmuls: (X^T X + λI) w = X^T y
+        xtx = X.T @ X + self.l2 * jnp.eye(X.shape[1], dtype=X.dtype)
+        xty = X.T @ y
+        self._w = jnp.linalg.solve(xtx, xty)
+        return self
+
+    def predict(self, X):
+        X = jnp.asarray(np.asarray(X, dtype=np.float32))
+        if self.fit_intercept:
+            X = jnp.concatenate([X, jnp.ones((X.shape[0], 1), dtype=X.dtype)], axis=1)
+        return np.asarray(X @ self._w)
+
+    def score(self, X, y):
+        pred = self.predict(X)
+        y = np.asarray(y, dtype=np.float64)
+        ss_res = float(((y - pred) ** 2).sum())
+        ss_tot = float(((y - y.mean()) ** 2).sum())
+        return 1.0 - ss_res / ss_tot if ss_tot else 0.0
+
+
+class LogisticRegression(_JaxEstimator):
+    """Full-batch jitted gradient descent logistic regression."""
+
+    def __init__(self, lr: float = 0.1, n_iter: int = 200, fit_intercept: bool = True):
+        self.lr = lr
+        self.n_iter = n_iter
+        self.fit_intercept = fit_intercept
+        self._w = None
+        self.classes_ = None
+
+    def fit(self, X, y, **kwargs):
+        X = jnp.asarray(np.asarray(X, dtype=np.float32))
+        y_np = np.asarray(y)
+        self.classes_ = np.unique(y_np)
+        y01 = jnp.asarray((y_np == self.classes_[-1]).astype(np.float32))
+        if self.fit_intercept:
+            X = jnp.concatenate([X, jnp.ones((X.shape[0], 1), dtype=X.dtype)], axis=1)
+        w0 = jnp.zeros(X.shape[1], dtype=X.dtype)
+        lr = self.lr
+
+        @jax.jit
+        def train(w):
+            def step(w, _):
+                logits = X @ w
+                p = jax.nn.sigmoid(logits)
+                grad = X.T @ (p - y01) / X.shape[0]
+                return w - lr * grad, None
+
+            w, _ = jax.lax.scan(step, w, None, length=self.n_iter)
+            return w
+
+        self._w = train(w0)
+        return self
+
+    def _proba1(self, X):
+        X = jnp.asarray(np.asarray(X, dtype=np.float32))
+        if self.fit_intercept:
+            X = jnp.concatenate([X, jnp.ones((X.shape[0], 1), dtype=X.dtype)], axis=1)
+        return jax.nn.sigmoid(X @ self._w)
+
+    def predict(self, X):
+        p = np.asarray(self._proba1(X))
+        return np.where(p > 0.5, self.classes_[-1], self.classes_[0])
+
+    def predict_proba(self, X):
+        p = np.asarray(self._proba1(X))
+        return np.stack([1 - p, p], axis=1)
+
+    def score(self, X, y):
+        return float((self.predict(X) == np.asarray(y)).mean())
+
+
+class KMeans(_JaxEstimator):
+    """Lloyd's iterations as jitted matmul + argmin (MXU-heavy)."""
+
+    def __init__(self, n_clusters: int = 8, n_iter: int = 50, seed: int = 0):
+        self.n_clusters = n_clusters
+        self.n_iter = n_iter
+        self.seed = seed
+        self.cluster_centers_ = None
+
+    def fit(self, X, y=None, **kwargs):
+        X = jnp.asarray(np.asarray(X, dtype=np.float32))
+        key = jax.random.PRNGKey(self.seed)
+        idx = jax.random.choice(key, X.shape[0], (self.n_clusters,), replace=False)
+        centers = X[idx]
+
+        @jax.jit
+        def run(centers):
+            def step(c, _):
+                d = ((X[:, None, :] - c[None, :, :]) ** 2).sum(-1)
+                assign = jnp.argmin(d, axis=1)
+                one_hot = jax.nn.one_hot(assign, self.n_clusters, dtype=X.dtype)
+                counts = one_hot.sum(0)
+                sums = one_hot.T @ X
+                new_c = sums / jnp.maximum(counts[:, None], 1)
+                new_c = jnp.where(counts[:, None] > 0, new_c, c)
+                return new_c, None
+
+            c, _ = jax.lax.scan(step, centers, None, length=self.n_iter)
+            return c
+
+        self.cluster_centers_ = run(centers)
+        return self
+
+    def predict(self, X):
+        X = jnp.asarray(np.asarray(X, dtype=np.float32))
+        d = ((X[:, None, :] - self.cluster_centers_[None, :, :]) ** 2).sum(-1)
+        return np.asarray(jnp.argmin(d, axis=1))
+
+    def fit_predict(self, X, y=None):
+        self.fit(X)
+        return self.predict(X)
+
+
+class GradientBoostedTreesStub(_JaxEstimator):  # pragma: no cover
+    """Placeholder slot so GBDT names resolve with a clear error."""
+
+    def __init__(self, **kwargs):
+        raise NotImplementedError(
+            "Gradient boosted trees are not yet TPU-native; use a sklearn class"
+        )

@@ -1,0 +1,83 @@
+"""Estimator wrappers for partitioned/device data.
+
+Role parity: reference wrappers.py (vendored dask-ml): ParallelPostFit
+(wrappers.py:51) — train once, predict/transform/score partition-wise;
+Incremental (wrappers.py:425) — stream partial_fit across partitions.
+Here "partitions" are device-table row blocks; predictions run blockwise on
+host (sklearn) or on device (ml/jax_models.py).
+"""
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import numpy as np
+
+
+class ParallelPostFit:
+    """Meta-estimator: fit on (sub)sampled data, apply blockwise."""
+
+    def __init__(self, estimator: Any = None, predict_meta=None, predict_proba_meta=None,
+                 transform_meta=None, block_rows: int = 1_000_000):
+        self.estimator = estimator
+        self.block_rows = block_rows
+
+    def fit(self, X, y=None, **kwargs):
+        self.estimator.fit(X, y, **kwargs) if y is not None else self.estimator.fit(X, **kwargs)
+        return self
+
+    def _blockwise(self, method, X):
+        n = len(X)
+        outs = []
+        for start in range(0, n, self.block_rows):
+            block = X[start : start + self.block_rows]
+            outs.append(np.asarray(method(block)))
+        if not outs:
+            return np.array([])
+        return np.concatenate(outs) if outs[0].ndim == 1 else np.vstack(outs)
+
+    def predict(self, X):
+        return self._blockwise(self.estimator.predict, np.asarray(X))
+
+    def predict_proba(self, X):
+        return self._blockwise(self.estimator.predict_proba, np.asarray(X))
+
+    def transform(self, X):
+        return self._blockwise(self.estimator.transform, np.asarray(X))
+
+    def score(self, X, y):
+        return self.estimator.score(np.asarray(X), np.asarray(y))
+
+    def get_params(self, deep: bool = True):
+        return self.estimator.get_params(deep) if hasattr(self.estimator, "get_params") else {}
+
+    def __getattr__(self, item):
+        return getattr(self.estimator, item)
+
+
+class Incremental(ParallelPostFit):
+    """Streamed training via partial_fit over row blocks (parity:
+    wrappers.py:718-760 fit loop)."""
+
+    def __init__(self, estimator: Any = None, scoring=None, shuffle_blocks: bool = True,
+                 block_rows: int = 100_000, **kwargs):
+        super().__init__(estimator, block_rows=block_rows)
+        self.shuffle_blocks = shuffle_blocks
+
+    def fit(self, X, y=None, classes=None, **kwargs):
+        X = np.asarray(X)
+        y_arr = np.asarray(y) if y is not None else None
+        n = len(X)
+        starts = list(range(0, n, self.block_rows))
+        if classes is None and y_arr is not None and hasattr(self.estimator, "partial_fit"):
+            classes = np.unique(y_arr)
+        for start in starts:
+            xb = X[start : start + self.block_rows]
+            yb = y_arr[start : start + self.block_rows] if y_arr is not None else None
+            if yb is not None:
+                try:
+                    self.estimator.partial_fit(xb, yb, classes=classes, **kwargs)
+                except TypeError:
+                    self.estimator.partial_fit(xb, yb, **kwargs)
+            else:
+                self.estimator.partial_fit(xb, **kwargs)
+        return self

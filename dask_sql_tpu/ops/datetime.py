@@ -1,0 +1,234 @@
+"""Datetime kernels: pure-jnp civil-calendar math over epoch nanoseconds.
+
+The reference leans on pandas `.dt` accessors (call.py datetime ops there);
+on TPU we keep timestamps as int64 ns and compute calendar fields with
+branch-free integer arithmetic (Howard Hinnant's civil-from-days algorithm),
+so EXTRACT/CEIL/FLOOR/TIMESTAMPADD all stay on device and fuse with
+neighbouring kernels.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+NS_PER_SECOND = 1_000_000_000
+NS_PER_MINUTE = 60 * NS_PER_SECOND
+NS_PER_HOUR = 3600 * NS_PER_SECOND
+NS_PER_DAY = 86_400 * NS_PER_SECOND
+
+
+def _floordiv(a, b):
+    return jnp.floor_divide(a, b)
+
+
+def days_from_ns(ns):
+    return _floordiv(ns, NS_PER_DAY)
+
+
+def civil_from_days(days):
+    """(year, month, day) from days since 1970-01-01 (proleptic Gregorian)."""
+    z = days + 719468
+    era = _floordiv(z, 146097)
+    doe = z - era * 146097
+    yoe = _floordiv(doe - _floordiv(doe, 1460) + _floordiv(doe, 36524) - _floordiv(doe, 146096), 365)
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + _floordiv(yoe, 4) - _floordiv(yoe, 100))
+    mp = _floordiv(5 * doy + 2, 153)
+    d = doy - _floordiv(153 * mp + 2, 5) + 1
+    m = mp + jnp.where(mp < 10, 3, -9)
+    y = y + (m <= 2)
+    return y, m, d
+
+
+def days_from_civil(y, m, d):
+    """Inverse of civil_from_days."""
+    y = y - (m <= 2)
+    era = _floordiv(y, 400)
+    yoe = y - era * 400
+    mp = m + jnp.where(m > 2, -3, 9)
+    doy = _floordiv(153 * mp + 2, 5) + d - 1
+    doe = yoe * 365 + _floordiv(yoe, 4) - _floordiv(yoe, 100) + doy
+    return era * 146097 + doe - 719468
+
+
+def extract(unit: str, ns):
+    ns = ns.astype(jnp.int64)
+    days = days_from_ns(ns)
+    tod = ns - days * NS_PER_DAY  # time of day in ns, always >= 0
+    if unit == "epoch":
+        return _floordiv(ns, NS_PER_SECOND)
+    if unit == "hour":
+        return _floordiv(tod, NS_PER_HOUR)
+    if unit == "minute":
+        return _floordiv(tod, NS_PER_MINUTE) % 60
+    if unit == "second":
+        return _floordiv(tod, NS_PER_SECOND) % 60
+    if unit == "millisecond":
+        return _floordiv(tod, 1_000_000) % 1000
+    if unit == "microsecond":
+        return _floordiv(tod, 1000) % 1_000_000
+    if unit == "nanosecond":
+        return tod % NS_PER_SECOND
+    y, m, d = civil_from_days(days)
+    if unit == "year" or unit == "isoyear":
+        return y
+    if unit == "month":
+        return m
+    if unit == "day":
+        return d
+    if unit == "quarter":
+        return _floordiv(m - 1, 3) + 1
+    if unit == "week":
+        # ISO week number
+        doy = days - days_from_civil(y, jnp.ones_like(m), jnp.ones_like(d)) + 1
+        dow_iso = _iso_dow(days)
+        week = _floordiv(doy - dow_iso + 10, 7)
+        # clamp weeks 0 / 53 edge cases to neighbouring years' counts
+        week = jnp.where(week < 1, 52 + ((_is_long_year(y - 1))).astype(week.dtype), week)
+        week = jnp.where(week > 52 + (_is_long_year(y)).astype(week.dtype),
+                         1, week)
+        return week
+    if unit == "dow":
+        # Calcite/reference convention: 1 = Sunday ... 7 = Saturday
+        return (days + 4) % 7 + 1
+    if unit == "isodow":
+        return _iso_dow(days)
+    if unit == "doy":
+        jan1 = days_from_civil(y, jnp.ones_like(m), jnp.ones_like(d))
+        return days - jan1 + 1
+    if unit == "century":
+        return _floordiv(y - 1, 100) + 1
+    if unit == "decade":
+        return _floordiv(y, 10)
+    if unit == "millennium":
+        return _floordiv(y - 1, 1000) + 1
+    raise NotImplementedError(f"EXTRACT unit {unit}")
+
+
+def _iso_dow(days):
+    return (days + 3) % 7 + 1  # 1 = Monday ... 7 = Sunday
+
+
+def _is_long_year(y):
+    jan1 = days_from_civil(y, jnp.asarray(1), jnp.asarray(1))
+    dec31 = days_from_civil(y, jnp.asarray(12), jnp.asarray(31))
+    return (_iso_dow(jan1) == 4) | (_iso_dow(dec31) == 4)
+
+
+_TRUNC_UNITS = ("YEAR", "QUARTER", "MONTH", "WEEK", "DAY", "HOUR", "MINUTE", "SECOND",
+                "MILLISECOND", "MICROSECOND")
+
+
+def truncate(unit: str, ns):
+    """FLOOR(ts TO unit) (reference dialect.rs CEIL/FLOOR TO rewrites)."""
+    unit = unit.upper()
+    ns = ns.astype(jnp.int64)
+    if unit == "SECOND":
+        return _floordiv(ns, NS_PER_SECOND) * NS_PER_SECOND
+    if unit == "MINUTE":
+        return _floordiv(ns, NS_PER_MINUTE) * NS_PER_MINUTE
+    if unit == "HOUR":
+        return _floordiv(ns, NS_PER_HOUR) * NS_PER_HOUR
+    if unit == "DAY":
+        return _floordiv(ns, NS_PER_DAY) * NS_PER_DAY
+    if unit == "MILLISECOND":
+        return _floordiv(ns, 1_000_000) * 1_000_000
+    if unit == "MICROSECOND":
+        return _floordiv(ns, 1000) * 1000
+    days = days_from_ns(ns)
+    y, m, d = civil_from_days(days)
+    one = jnp.ones_like(d)
+    if unit == "WEEK":
+        start = days - (_iso_dow(days) - 1)
+        return start * NS_PER_DAY
+    if unit == "MONTH":
+        return days_from_civil(y, m, one) * NS_PER_DAY
+    if unit == "QUARTER":
+        qm = (_floordiv(m - 1, 3)) * 3 + 1
+        return days_from_civil(y, qm, one) * NS_PER_DAY
+    if unit == "YEAR":
+        return days_from_civil(y, jnp.ones_like(m), one) * NS_PER_DAY
+    raise NotImplementedError(f"truncate unit {unit}")
+
+
+def ceil_to(unit: str, ns):
+    ns = ns.astype(jnp.int64)
+    fl = truncate(unit, ns)
+    unit_u = unit.upper()
+    if unit_u in ("SECOND", "MINUTE", "HOUR", "DAY", "WEEK", "MILLISECOND", "MICROSECOND"):
+        step = {"SECOND": NS_PER_SECOND, "MINUTE": NS_PER_MINUTE, "HOUR": NS_PER_HOUR,
+                "DAY": NS_PER_DAY, "WEEK": 7 * NS_PER_DAY,
+                "MILLISECOND": 1_000_000, "MICROSECOND": 1000}[unit_u]
+        return jnp.where(fl == ns, ns, fl + step)
+    # month-based units: advance to next boundary
+    nxt = add_months(fl, {"MONTH": 1, "QUARTER": 3, "YEAR": 12}[unit_u])
+    return jnp.where(fl == ns, ns, nxt)
+
+
+def add_months(ns, months):
+    ns = ns.astype(jnp.int64)
+    days = days_from_ns(ns)
+    rem = ns - days * NS_PER_DAY
+    y, m, d = civil_from_days(days)
+    tot = y * 12 + (m - 1) + months
+    ny = _floordiv(tot, 12)
+    nm = tot - ny * 12 + 1
+    # clamp day to target month length
+    ml = month_length(ny, nm)
+    nd = jnp.minimum(d, ml)
+    return days_from_civil(ny, nm, nd) * NS_PER_DAY + rem
+
+
+def month_length(y, m):
+    lengths = jnp.asarray([31, 28, 31, 30, 31, 30, 31, 31, 30, 31, 30, 31], dtype=jnp.int64)
+    base = lengths[jnp.clip(m - 1, 0, 11)]
+    leap = ((y % 4 == 0) & (y % 100 != 0)) | (y % 400 == 0)
+    return jnp.where((m == 2) & leap, 29, base)
+
+
+def last_day(ns):
+    days = days_from_ns(ns.astype(jnp.int64))
+    y, m, _ = civil_from_days(days)
+    return days_from_civil(y, m, month_length(y, m)) * NS_PER_DAY
+
+
+def timestampadd(unit: str, n, ns):
+    unit = unit.upper().rstrip("S")
+    if unit in ("YEAR", "QUARTER", "MONTH"):
+        mult = {"YEAR": 12, "QUARTER": 3, "MONTH": 1}[unit]
+        return add_months(ns, n * mult)
+    step = {"WEEK": 7 * NS_PER_DAY, "DAY": NS_PER_DAY, "HOUR": NS_PER_HOUR,
+            "MINUTE": NS_PER_MINUTE, "SECOND": NS_PER_SECOND,
+            "MILLISECOND": 1_000_000, "MICROSECOND": 1000, "NANOSECOND": 1}[unit]
+    return ns.astype(jnp.int64) + n.astype(jnp.int64) * step
+
+
+def timestampdiff(unit: str, a, b):
+    """Full units from a to b (SQL TIMESTAMPDIFF argument order)."""
+    unit = unit.upper().rstrip("S")
+    a = a.astype(jnp.int64)
+    b = b.astype(jnp.int64)
+    if unit in ("YEAR", "QUARTER", "MONTH"):
+        ya, ma, da = civil_from_days(days_from_ns(a))
+        yb, mb, db = civil_from_days(days_from_ns(b))
+        months = (yb * 12 + mb) - (ya * 12 + ma)
+        # partial month does not count
+        toda = a - days_from_ns(a) * NS_PER_DAY
+        todb = b - days_from_ns(b) * NS_PER_DAY
+        adjust = ((db < da) | ((db == da) & (todb < toda))) & (months > 0)
+        adjust_neg = ((db > da) | ((db == da) & (todb > toda))) & (months < 0)
+        months = months - adjust.astype(jnp.int64) + adjust_neg.astype(jnp.int64)
+        if unit == "MONTH":
+            return months
+        if unit == "QUARTER":
+            return _div_trunc(months, 3)
+        return _div_trunc(months, 12)
+    step = {"WEEK": 7 * NS_PER_DAY, "DAY": NS_PER_DAY, "HOUR": NS_PER_HOUR,
+            "MINUTE": NS_PER_MINUTE, "SECOND": NS_PER_SECOND,
+            "MILLISECOND": 1_000_000, "MICROSECOND": 1000, "NANOSECOND": 1}[unit]
+    return _div_trunc(b - a, step)
+
+
+def _div_trunc(a, b):
+    """Integer division truncating toward zero (SQL semantics)."""
+    q = jnp.floor_divide(jnp.abs(a), b)
+    return jnp.where(a < 0, -q, q)

@@ -1,0 +1,185 @@
+"""Group-id factorization and segment aggregation kernels.
+
+TPU-first replacement for the reference's pandas `groupby().agg()` tree
+(aggregate.py:575-581 there): keys are factorized to dense integer group ids
+with a single device lexsort, and every aggregate lowers to an XLA segment
+reduction (`jax.ops.segment_sum`/`_min`/`_max`) — embarrassingly parallel on
+the VPU, and the same kernels serve as the partial-aggregation stage of the
+distributed partial→final tree (see `parallel/collectives.py`).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..columnar.column import Column
+from ..columnar.dtypes import STRING_TYPES, SqlType
+
+
+def key_arrays(cols: Sequence[Column]) -> List[jnp.ndarray]:
+    """Device sort/group keys for columns: ints stay, floats stay, strings use
+    *sorted-dictionary* codes so code order == lexicographic order."""
+    out = []
+    for c in cols:
+        if c.sql_type in STRING_TYPES:
+            c = c.compact_dictionary()
+            out.append(c.data)
+        elif c.data.dtype == jnp.bool_:
+            out.append(c.data.astype(jnp.int32))
+        else:
+            out.append(c.data)
+        if c.validity is not None:
+            # validity participates: NULL forms its own group (dropna=False
+            # semantics, reference aggregate.py:575-577)
+            out.append(c.valid_mask().astype(jnp.int32))
+    return out
+
+
+def factorize(keys: Sequence[jnp.ndarray]) -> Tuple[jnp.ndarray, jnp.ndarray, int]:
+    """Dense group ids for multi-column keys.
+
+    Returns (group_ids per row, sorted-order permutation, num_groups).
+    Group ids number the distinct keys in ascending lexicographic order.
+    """
+    n = int(keys[0].shape[0])
+    if n == 0:
+        return jnp.zeros(0, dtype=jnp.int32), jnp.zeros(0, dtype=jnp.int32), 0
+    order = jnp.lexsort(tuple(reversed([k for k in keys])))
+    changed = jnp.zeros(n, dtype=bool).at[0].set(True)
+    for k in keys:
+        ks = k[order]
+        changed = changed.at[1:].set(changed[1:] | (ks[1:] != ks[:-1]))
+    gid_sorted = jnp.cumsum(changed.astype(jnp.int32)) - 1
+    gid = jnp.zeros(n, dtype=jnp.int32).at[order].set(gid_sorted)
+    num_groups = int(gid_sorted[-1]) + 1
+    return gid, order, num_groups
+
+
+def group_first_indices(gid: jnp.ndarray, num_groups: int) -> jnp.ndarray:
+    """Row index of the first occurrence of each group (for key materialization)."""
+    n = gid.shape[0]
+    big = jnp.full(num_groups, n, dtype=jnp.int64)
+    first = big.at[gid].min(jnp.arange(n, dtype=jnp.int64))
+    return first
+
+
+# ---------------------------------------------------------------------------
+# Segment aggregation kernels.  All take (values, valid, gid, num_groups) and
+# return (agg_values, agg_valid).  `valid` is a bool mask; aggregates skip
+# NULLs per SQL semantics (reference sum min_count=1, aggregate.py:486-493).
+# ---------------------------------------------------------------------------
+def seg_count(valid: jnp.ndarray, gid: jnp.ndarray, num_groups: int) -> jnp.ndarray:
+    return jax.ops.segment_sum(valid.astype(jnp.int64), gid, num_groups)
+
+
+def seg_sum(values, valid, gid, num_groups):
+    contrib = jnp.where(valid, values, jnp.zeros_like(values))
+    s = jax.ops.segment_sum(contrib, gid, num_groups)
+    cnt = seg_count(valid, gid, num_groups)
+    return s, cnt > 0
+
+
+def seg_min(values, valid, gid, num_groups):
+    fill = _extreme(values.dtype, maximum=True)
+    contrib = jnp.where(valid, values, fill)
+    m = jax.ops.segment_min(contrib, gid, num_groups)
+    cnt = seg_count(valid, gid, num_groups)
+    return jnp.where(cnt > 0, m, jnp.zeros_like(m)), cnt > 0
+
+
+def seg_max(values, valid, gid, num_groups):
+    fill = _extreme(values.dtype, maximum=False)
+    contrib = jnp.where(valid, values, fill)
+    m = jax.ops.segment_max(contrib, gid, num_groups)
+    cnt = seg_count(valid, gid, num_groups)
+    return jnp.where(cnt > 0, m, jnp.zeros_like(m)), cnt > 0
+
+
+def seg_avg(values, valid, gid, num_groups):
+    s, _ = seg_sum(values.astype(jnp.float64), valid, gid, num_groups)
+    cnt = seg_count(valid, gid, num_groups)
+    return s / jnp.maximum(cnt, 1), cnt > 0
+
+
+def seg_var(values, valid, gid, num_groups, ddof: int):
+    """Variance via the (count, sum, sumsq) triple — the same shape as the
+    reference's tree-aggregation triple (aggregate.py:117-160)."""
+    x = values.astype(jnp.float64)
+    s, _ = seg_sum(x, valid, gid, num_groups)
+    s2, _ = seg_sum(x * x, valid, gid, num_groups)
+    cnt = seg_count(valid, gid, num_groups)
+    denom = jnp.maximum(cnt - ddof, 1)
+    mean = s / jnp.maximum(cnt, 1)
+    var = (s2 - cnt * mean * mean) / denom
+    var = jnp.maximum(var, 0.0)
+    return var, cnt > ddof
+
+
+def seg_bool_and(values, valid, gid, num_groups):
+    contrib = jnp.where(valid, values.astype(jnp.int32), 1)
+    m = jax.ops.segment_min(contrib, gid, num_groups)
+    cnt = seg_count(valid, gid, num_groups)
+    return m.astype(bool), cnt > 0
+
+
+def seg_bool_or(values, valid, gid, num_groups):
+    contrib = jnp.where(valid, values.astype(jnp.int32), 0)
+    m = jax.ops.segment_max(contrib, gid, num_groups)
+    cnt = seg_count(valid, gid, num_groups)
+    return m.astype(bool), cnt > 0
+
+
+def seg_bitwise(values, valid, gid, num_groups, op: str):
+    """bit_and/bit_or/bit_xor per group via per-bit segment reductions.
+
+    64 segment reductions over the bit planes — rarely-used ops, so clarity
+    beats peak efficiency here (reference ReduceAggregation parity).
+    """
+    x = values.astype(jnp.int64)
+    nbits = 64
+    bits = (x[:, None] >> jnp.arange(nbits, dtype=jnp.int64)[None, :]) & 1
+    if op == "bit_and":
+        contrib = jnp.where(valid[:, None], bits, 1)
+        red = jax.ops.segment_min(contrib, gid, num_groups)
+    elif op == "bit_or":
+        contrib = jnp.where(valid[:, None], bits, 0)
+        red = jax.ops.segment_max(contrib, gid, num_groups)
+    else:  # bit_xor
+        contrib = jnp.where(valid[:, None], bits, 0)
+        red = jax.ops.segment_sum(contrib, gid, num_groups) & 1
+    out = jnp.sum(red << jnp.arange(nbits, dtype=jnp.int64)[None, :], axis=1)
+    cnt = seg_count(valid, gid, num_groups)
+    return out, cnt > 0
+
+
+def seg_first(values, valid, gid, num_groups):
+    """Value at the smallest row index with a valid value per group."""
+    n = values.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int64)
+    big = jnp.full(num_groups, n, dtype=jnp.int64)
+    first = big.at[gid].min(jnp.where(valid, idx, n))
+    cnt = seg_count(valid, gid, num_groups)
+    safe = jnp.clip(first, 0, max(n - 1, 0))
+    return values[safe], cnt > 0
+
+
+def seg_last(values, valid, gid, num_groups):
+    n = values.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int64)
+    small = jnp.full(num_groups, -1, dtype=jnp.int64)
+    last = small.at[gid].max(jnp.where(valid, idx, -1))
+    cnt = seg_count(valid, gid, num_groups)
+    safe = jnp.clip(last, 0, max(n - 1, 0))
+    return values[safe], cnt > 0
+
+
+def _extreme(dtype, maximum: bool):
+    if jnp.issubdtype(dtype, jnp.floating):
+        return jnp.array(jnp.inf if maximum else -jnp.inf, dtype=dtype)
+    if dtype == jnp.bool_:
+        return jnp.array(maximum, dtype=dtype)
+    info = jnp.iinfo(dtype)
+    return jnp.array(info.max if maximum else info.min, dtype=dtype)

@@ -1,0 +1,74 @@
+"""Sort and top-k kernels (parity: reference physical/utils/sort.py).
+
+Multi-key mixed-order sort is a single device `lexsort` over transformed keys
+(descending = negated/flipped key; NULL ordering = a leading validity key) —
+no per-partition mergesort tricks needed (reference sort_partition_func,
+utils/sort.py:90-117 there).  Top-k uses `jax.lax.top_k` on the dominant key
+when eligible (reference topk_sort utils/sort.py:78).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..columnar.column import Column
+from ..columnar.dtypes import STRING_TYPES
+from ..planner.expressions import SortKey
+
+
+def sort_permutation(cols: Sequence[Column], ascendings: Sequence[bool],
+                     nulls_firsts: Sequence[bool]) -> jnp.ndarray:
+    """Stable permutation ordering rows by the given keys."""
+    keys: List[jnp.ndarray] = []
+    for col, asc, nf in zip(cols, ascendings, nulls_firsts):
+        if col.sql_type in STRING_TYPES:
+            col = col.compact_dictionary()  # sorted dict => code order == lex order
+        data = col.data
+        if data.dtype == jnp.bool_:
+            data = data.astype(jnp.int32)
+        if jnp.issubdtype(data.dtype, jnp.floating):
+            # make NaN sort last consistently, then handle direction
+            nan = jnp.isnan(data)
+            data = jnp.where(nan, jnp.inf, data)
+        if not asc:
+            data = -data
+        valid = col.valid_mask() if col.validity is not None else None
+        if valid is not None:
+            # nulls-first => invalid key 0 sorts before valid 1
+            nullkey = jnp.where(valid, 1, 0) if nf else jnp.where(valid, 0, 1)
+            keys.append(data)
+            keys.append(nullkey)
+        else:
+            keys.append(data)
+    # lexsort: last key is primary
+    return jnp.lexsort(tuple(reversed(keys)))
+
+
+def sort_table(table, keys: Sequence[SortKey], eval_key):
+    """Sort a Table by SortKeys. `eval_key(expr) -> Column`."""
+    cols = [eval_key(k.expr) for k in keys]
+    perm = sort_permutation(
+        cols,
+        [k.ascending for k in keys],
+        [k.nulls_first_resolved() for k in keys],
+    )
+    return table.take(perm)
+
+
+def topk_permutation(col: Column, ascending: bool, k: int) -> Optional[jnp.ndarray]:
+    """Top-k on a single numeric/ordered key via lax.top_k; None if ineligible."""
+    if col.sql_type in STRING_TYPES and col.dictionary is not None:
+        col = col.compact_dictionary()
+    data = col.data
+    if data.dtype == jnp.bool_:
+        data = data.astype(jnp.int32)
+    if col.validity is not None:
+        return None  # nulls need full ordering semantics
+    vals = data.astype(jnp.float64) if not jnp.issubdtype(data.dtype, jnp.floating) else data
+    if ascending:
+        vals = -vals
+    k = min(k, int(data.shape[0]))
+    _, idx = jax.lax.top_k(vals, k)
+    return idx

@@ -1,0 +1,117 @@
+"""String kernels over dictionary-encoded columns.
+
+TPU-first design: the data-sized arrays on device are int32 codes; string
+transforms run on the (small) host dictionary of uniques and re-enter the
+device as a code gather / lookup table.  LIKE/regex therefore costs
+O(|dictionary|) host work + one device gather, instead of O(rows) host work
+(reference does pandas `.str` over every row, call.py:1114-1135 there).
+Binary string+string ops factorize code *pairs* on device first, so the host
+only formats distinct combinations.
+"""
+from __future__ import annotations
+
+import re
+from typing import Callable, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..columnar.column import Column
+from ..columnar.dtypes import SqlType
+from .grouping import factorize
+
+
+def _dict(col: Column) -> np.ndarray:
+    d = col.dictionary
+    if d is None or len(d) == 0:
+        return np.array([""], dtype=object)
+    return d
+
+
+def map_unary(col: Column, fn: Callable[[str], str]) -> Column:
+    """Apply a python string->string function via the dictionary."""
+    d = _dict(col)
+    new_dict = np.array([fn(str(v)) for v in d], dtype=object)
+    return Column(col.data, SqlType.VARCHAR, col.validity, new_dict)
+
+
+def map_unary_value(col: Column, fn: Callable[[str], float], dtype) -> Column:
+    """Apply a python string->scalar function via a device lookup table."""
+    d = _dict(col)
+    lut = jnp.asarray(np.array([fn(str(v)) for v in d], dtype=dtype))
+    codes = jnp.clip(col.data, 0, len(d) - 1)
+    from ..columnar.dtypes import np_to_sql
+
+    return Column(lut[codes], np_to_sql(np.dtype(dtype)), col.validity)
+
+
+def map_predicate(col: Column, fn: Callable[[str], bool]) -> Column:
+    """String predicate as a boolean LUT gather (LIKE and friends)."""
+    return map_unary_value(col, fn, np.bool_)
+
+
+def like_to_regex(pattern: str, escape: Optional[str] = None) -> str:
+    """Translate SQL LIKE pattern to an anchored python regex."""
+    out = []
+    i = 0
+    esc = escape if escape else None
+    while i < len(pattern):
+        ch = pattern[i]
+        if esc and ch == esc and i + 1 < len(pattern):
+            out.append(re.escape(pattern[i + 1]))
+            i += 2
+            continue
+        if ch == "%":
+            out.append(".*")
+        elif ch == "_":
+            out.append(".")
+        else:
+            out.append(re.escape(ch))
+        i += 1
+    return "^" + "".join(out) + "$"
+
+
+def similar_to_regex(pattern: str, escape: Optional[str] = None) -> str:
+    """SQL SIMILAR TO: regex-ish with %/_ wildcards kept as SQL."""
+    out = []
+    i = 0
+    esc = escape if escape else None
+    while i < len(pattern):
+        ch = pattern[i]
+        if esc and ch == esc and i + 1 < len(pattern):
+            out.append(re.escape(pattern[i + 1]))
+            i += 2
+            continue
+        if ch == "%":
+            out.append(".*")
+        elif ch == "_":
+            out.append(".")
+        else:
+            out.append(ch)  # keep regex metacharacters
+        i += 1
+    return "^" + "".join(out) + "$"
+
+
+def binary_string_op(a: Column, b: Column, fn: Callable[[str, str], str]) -> Column:
+    """String op over two dict columns: factorize code pairs, format uniques."""
+    da, db = _dict(a), _dict(b)
+    ca = jnp.clip(a.data, 0, len(da) - 1)
+    cb = jnp.clip(b.data, 0, len(db) - 1)
+    gid, order, num = factorize([ca, cb])
+    # first occurrence of each pair
+    n = ca.shape[0]
+    first = jnp.full(num, n, dtype=jnp.int64).at[gid].min(jnp.arange(n, dtype=jnp.int64))
+    fa = np.asarray(ca[first])
+    fb = np.asarray(cb[first])
+    new_dict = np.array([fn(str(da[i]), str(db[j])) for i, j in zip(fa, fb)], dtype=object)
+    validity = None
+    if a.validity is not None or b.validity is not None:
+        validity = a.valid_mask() & b.valid_mask()
+    return Column(gid.astype(jnp.int32), SqlType.VARCHAR, validity, new_dict)
+
+
+def concat_columns_str(cols) -> Column:
+    out = cols[0]
+    for c in cols[1:]:
+        out = binary_string_op(out, c, lambda x, y: x + y)
+    return out

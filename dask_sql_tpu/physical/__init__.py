@@ -1,0 +1,12 @@
+from .executor import Executor
+
+# importing the plugin modules registers them (parity: the reference's
+# explicit plugin registration in context.py:118-166)
+from .rel.logical import basic as _basic  # noqa: F401,E402
+from .rel.logical import join as _join  # noqa: F401,E402
+from .rel.logical import aggregate as _aggregate  # noqa: F401,E402
+from .rel.logical import window as _window  # noqa: F401,E402
+from .rel.custom import ddl as _ddl  # noqa: F401,E402
+from .rel.custom import ml as _ml  # noqa: F401,E402
+
+__all__ = ["Executor"]

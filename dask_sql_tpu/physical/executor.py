@@ -1,0 +1,60 @@
+"""Physical executor: walks the logical plan and produces device Tables.
+
+Role parity: reference RelConverter.convert (physical/rel/convert.py:39
+there) driven by Context._compute_table_from_rel (context.py:874).  The
+registry maps node-type strings to plugins exactly like the reference's
+Pluggable registries; execution is eager per node (XLA async dispatch under
+the hood), with the distributed path swapping sharded kernels in via
+`parallel/`.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from ..columnar.table import Table
+from ..planner.plan import LogicalPlan
+from .rel.base import BaseRelPlugin
+from .rex.convert import RexConverter
+
+
+class Executor:
+    _plugins: Dict[str, BaseRelPlugin] = {}
+
+    def __init__(self, context):
+        self.context = context
+        self.rex = RexConverter(self)
+        self._memo: Dict[int, Table] = {}
+
+    @classmethod
+    def add_plugin_class(cls, plugin_class):
+        plugin = plugin_class()
+        cls._plugins[plugin.class_name] = plugin
+        return plugin_class
+
+    def execute(self, rel: LogicalPlan) -> Table:
+        key = id(rel)
+        if key in self._memo:
+            return self._memo[key]
+        plugin = self._plugins.get(rel.node_type)
+        if plugin is None:
+            raise NotImplementedError(f"No rel plugin for node type {rel.node_type!r}")
+        out = plugin.convert(rel, self)
+        self._memo[key] = out
+        return out
+
+    # -- services for plugins ----------------------------------------------
+    def eval_expr(self, expr, table: Table):
+        return self.rex.convert(expr, table)
+
+    def lookup_function(self, name: str):
+        fd = self.context.lookup_function(name)
+        if fd is None:
+            raise KeyError(f"Function {name!r} not registered")
+        return fd
+
+    def get_table(self, schema_name: str, table_name: str) -> Table:
+        return self.context.get_table_data(schema_name, table_name)
+
+    @property
+    def config(self):
+        return self.context.config

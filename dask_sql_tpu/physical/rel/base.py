@@ -1,0 +1,59 @@
+"""Base plugin for relational converters.
+
+Role parity: reference BaseRelPlugin (physical/rel/base.py there):
+`assert_inputs` recursive child conversion (base.py:67-86), schema/dtype
+fix-up helpers (fix_column_to_row_type base.py:32, fix_dtype_to_row_type
+base.py:89).
+"""
+from __future__ import annotations
+
+from typing import List
+
+from ...columnar.table import Table
+from ...planner.expressions import Schema
+from ...planner.plan import LogicalPlan
+
+
+class BaseRelPlugin:
+    class_name: str = ""
+
+    def convert(self, rel: LogicalPlan, executor) -> Table:
+        raise NotImplementedError
+
+    @staticmethod
+    def assert_inputs(rel: LogicalPlan, n: int, executor) -> List[Table]:
+        inputs = rel.inputs()
+        assert len(inputs) == n, f"{rel.node_type} expects {n} inputs"
+        return [executor.execute(i) for i in inputs]
+
+    @staticmethod
+    def fix_column_to_row_type(table: Table, schema: Schema) -> Table:
+        """Rename positional columns to the plan's field names (made unique)."""
+        names = unique_names([f.name for f in schema])
+        cols = {}
+        for new, old in zip(names, table.column_names):
+            cols[new] = table.columns[old]
+        return Table(cols, table.num_rows)
+
+    @staticmethod
+    def fix_dtype_to_row_type(table: Table, schema: Schema) -> Table:
+        cols = {}
+        for name, f in zip(table.column_names, schema):
+            col = table.columns[name]
+            if col.sql_type != f.sql_type:
+                col = col.cast(f.sql_type)
+            cols[name] = col
+        return Table(cols, table.num_rows)
+
+
+def unique_names(names: List[str]) -> List[str]:
+    seen = {}
+    out = []
+    for n in names:
+        if n not in seen:
+            seen[n] = 0
+            out.append(n)
+        else:
+            seen[n] += 1
+            out.append(f"{n}__{seen[n]}")
+    return out

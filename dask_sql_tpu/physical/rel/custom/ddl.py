@@ -1,0 +1,239 @@
+"""DDL + introspection converters.
+
+Role parity (reference physical/rel/custom/): create_table.py,
+create_memory_table.py, drop_table.py, create_catalog_schema.py, alter.py,
+show_schemas.py, show_tables.py, show_columns.py, show_models.py,
+analyze_table.py, describe_model.py.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ....columnar.column import Column
+from ....columnar.table import Table
+from ....planner import plan as p
+from ..base import BaseRelPlugin
+from ...executor import Executor
+
+
+def _string_table(cols: dict) -> Table:
+    n = len(next(iter(cols.values()))) if cols else 0
+    return Table({k: Column.from_numpy(np.array(v, dtype=object)) for k, v in cols.items()}, n)
+
+
+_EMPTY = Table({}, 0)
+
+
+@Executor.add_plugin_class
+class CreateTablePlugin(BaseRelPlugin):
+    """CREATE TABLE ... WITH (...) (parity: create_table.py)."""
+
+    class_name = "CreateTableNode"
+
+    def convert(self, rel: p.CreateTableNode, executor) -> Table:
+        ctx = executor.context
+        schema_name, name = ctx._table_schema_name(rel.name)
+        if name in ctx.schema[schema_name].tables:
+            if rel.if_not_exists:
+                return _EMPTY
+            if not rel.or_replace:
+                raise RuntimeError(f"A table with the name {name} is already present.")
+        kwargs = dict(rel.kwargs)
+        location = kwargs.pop("location", None)
+        fmt = kwargs.pop("format", None)
+        persist = bool(kwargs.pop("persist", False))
+        kwargs.pop("gpu", None)
+        backend = kwargs.pop("backend", None)
+        ctx.create_table(name, location, format=fmt, persist=persist,
+                         schema_name=schema_name, backend=backend, **kwargs)
+        return _EMPTY
+
+
+@Executor.add_plugin_class
+class CreateMemoryTablePlugin(BaseRelPlugin):
+    """CREATE TABLE/VIEW AS (parity: create_memory_table.py:15 — a TABLE is
+    materialized, a VIEW keeps the lazy plan)."""
+
+    class_name = "CreateMemoryTableNode"
+
+    def convert(self, rel: p.CreateMemoryTableNode, executor) -> Table:
+        ctx = executor.context
+        schema_name, name = ctx._table_schema_name(rel.name)
+        if name in ctx.schema[schema_name].tables:
+            if rel.if_not_exists:
+                return _EMPTY
+            if not rel.or_replace:
+                raise RuntimeError(f"A table with the name {name} is already present.")
+        if rel.persist:
+            table = executor.execute(rel.input)
+            ctx.create_table(name, table, schema_name=schema_name)
+        else:
+            ctx._register_view(name, rel.input, schema_name)
+        return _EMPTY
+
+
+@Executor.add_plugin_class
+class DropTablePlugin(BaseRelPlugin):
+    class_name = "DropTableNode"
+
+    def convert(self, rel: p.DropTableNode, executor) -> Table:
+        ctx = executor.context
+        schema_name, name = ctx._table_schema_name(rel.name)
+        if name not in ctx.schema[schema_name].tables and name not in ctx._views.get(schema_name, {}):
+            if rel.if_exists:
+                return _EMPTY
+            raise RuntimeError(f"A table with the name {name} is not present.")
+        ctx.drop_table(name, schema_name=schema_name)
+        return _EMPTY
+
+
+@Executor.add_plugin_class
+class CreateSchemaPlugin(BaseRelPlugin):
+    class_name = "CreateSchemaNode"
+
+    def convert(self, rel: p.CreateSchemaNode, executor) -> Table:
+        ctx = executor.context
+        if rel.schema_name in ctx.schema:
+            if rel.if_not_exists:
+                return _EMPTY
+            if not rel.or_replace:
+                raise RuntimeError(f"A schema with the name {rel.schema_name} is already present.")
+        ctx.create_schema(rel.schema_name)
+        return _EMPTY
+
+
+@Executor.add_plugin_class
+class DropSchemaPlugin(BaseRelPlugin):
+    class_name = "DropSchemaNode"
+
+    def convert(self, rel: p.DropSchemaNode, executor) -> Table:
+        ctx = executor.context
+        if rel.schema_name not in ctx.schema:
+            if rel.if_exists:
+                return _EMPTY
+            raise RuntimeError(f"A schema with the name {rel.schema_name} is not present.")
+        ctx.drop_schema(rel.schema_name)
+        return _EMPTY
+
+
+@Executor.add_plugin_class
+class UseSchemaPlugin(BaseRelPlugin):
+    class_name = "UseSchemaNode"
+
+    def convert(self, rel: p.UseSchemaNode, executor) -> Table:
+        ctx = executor.context
+        if rel.schema_name not in ctx.schema:
+            raise RuntimeError(f"A schema with the name {rel.schema_name} is not present.")
+        ctx.schema_name = rel.schema_name
+        return _EMPTY
+
+
+@Executor.add_plugin_class
+class AlterSchemaPlugin(BaseRelPlugin):
+    class_name = "AlterSchemaNode"
+
+    def convert(self, rel: p.AlterSchemaNode, executor) -> Table:
+        executor.context.alter_schema(rel.old_name, rel.new_name)
+        return _EMPTY
+
+
+@Executor.add_plugin_class
+class AlterTablePlugin(BaseRelPlugin):
+    class_name = "AlterTableNode"
+
+    def convert(self, rel: p.AlterTableNode, executor) -> Table:
+        ctx = executor.context
+        schema_name, old = ctx._table_schema_name(rel.old_name)
+        if old not in ctx.schema[schema_name].tables:
+            if rel.if_exists:
+                return _EMPTY
+            raise RuntimeError(f"A table with the name {old} is not present.")
+        ctx.alter_table(old, rel.new_name, schema_name=schema_name)
+        return _EMPTY
+
+
+@Executor.add_plugin_class
+class ShowSchemasPlugin(BaseRelPlugin):
+    """Parity: show_schemas.py (catalog + like filter)."""
+
+    class_name = "ShowSchemasNode"
+
+    def convert(self, rel: p.ShowSchemasNode, executor) -> Table:
+        ctx = executor.context
+        names = list(ctx.schema.keys())
+        if rel.like:
+            names = [n for n in names if rel.like in n]
+        return _string_table({"Schema": names})
+
+
+@Executor.add_plugin_class
+class ShowTablesPlugin(BaseRelPlugin):
+    class_name = "ShowTablesNode"
+
+    def convert(self, rel: p.ShowTablesNode, executor) -> Table:
+        ctx = executor.context
+        schema = rel.schema_name or ctx.schema_name
+        if schema not in ctx.schema:
+            raise RuntimeError(f"A schema with the name {schema} is not present.")
+        names = list(ctx.schema[schema].tables.keys()) + list(ctx._views.get(schema, {}).keys())
+        return _string_table({"Table": names})
+
+
+@Executor.add_plugin_class
+class ShowColumnsPlugin(BaseRelPlugin):
+    class_name = "ShowColumnsNode"
+
+    def convert(self, rel: p.ShowColumnsNode, executor) -> Table:
+        ctx = executor.context
+        schema_name, name = ctx._table_schema_name(rel.table)
+        fields = ctx._table_fields(schema_name, name)
+        return _string_table({
+            "Column": [f.name for f in fields],
+            "Type": [str(f.sql_type).lower() for f in fields],
+            "Extra": ["" for _ in fields],
+            "Comment": ["" for _ in fields],
+        })
+
+
+@Executor.add_plugin_class
+class ShowModelsPlugin(BaseRelPlugin):
+    class_name = "ShowModelsNode"
+
+    def convert(self, rel: p.ShowModelsNode, executor) -> Table:
+        ctx = executor.context
+        schema = rel.schema_name or ctx.schema_name
+        return _string_table({"Model": list(ctx.schema[schema].models.keys())})
+
+
+@Executor.add_plugin_class
+class AnalyzeTablePlugin(BaseRelPlugin):
+    """ANALYZE TABLE ... COMPUTE STATISTICS (parity: analyze_table.py:15 —
+    describe-style stats as a queryable frame, NOT fed to the optimizer)."""
+
+    class_name = "AnalyzeTableNode"
+
+    def convert(self, rel: p.AnalyzeTableNode, executor) -> Table:
+        import pandas as pd
+
+        ctx = executor.context
+        schema_name, name = ctx._table_schema_name(rel.table)
+        table = ctx.get_table_data(schema_name, name)
+        df = table.to_pandas()
+        if rel.columns:
+            df = df[rel.columns]
+        num = df.select_dtypes("number")
+        stats = num.describe() if len(num.columns) else pd.DataFrame()
+        mapping = {"25%": "percentile_25", "50%": "percentile_50", "75%": "percentile_75"}
+        stats = stats.rename(index=mapping)
+        rows = {"col_name": list(stats.index) + ["data_type", "col_name"]}
+        out = {}
+        for col in df.columns:
+            vals = []
+            for stat in stats.index:
+                vals.append(str(stats[col][stat]) if col in stats.columns else "")
+            vals.append(str(df[col].dtype))
+            vals.append(col)
+            out[col] = vals
+        combined = {"col_name": rows["col_name"]}
+        combined.update(out)
+        return _string_table(combined)

@@ -1,0 +1,210 @@
+"""ML statement converters: CREATE MODEL / PREDICT / EXPERIMENT / EXPORT.
+
+Role parity (reference physical/rel/custom/): create_model.py:23 (WITH
+options: model_class, target_column, wrap_predict, wrap_fit, fit_kwargs),
+predict_model.py:15 (PREDICT(MODEL m, <select>) appends a `target` column),
+create_experiment.py:22 (GridSearchCV-style tuning), export_model.py:15
+(pickle/joblib/mlflow/onnx), describe_model.py, drop_model.py.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ....columnar.column import Column
+from ....columnar.table import Table
+from ....planner import plan as p
+from ..base import BaseRelPlugin, unique_names
+from ...executor import Executor
+
+_EMPTY = Table({}, 0)
+
+
+def _split_xy(df, target_column):
+    if target_column:
+        X = df.drop(columns=[target_column])
+        y = df[target_column]
+    else:
+        X, y = df, None
+    return X, y
+
+
+@Executor.add_plugin_class
+class CreateModelPlugin(BaseRelPlugin):
+    class_name = "CreateModelNode"
+
+    def convert(self, rel: p.CreateModelNode, executor) -> Table:
+        from ....ml.ml_classes import get_model_class
+        from ....ml.wrappers import Incremental, ParallelPostFit
+
+        ctx = executor.context
+        schema_name, name = ctx._table_schema_name(rel.name)
+        if name in ctx.schema[schema_name].models:
+            if rel.if_not_exists:
+                return _EMPTY
+            if not rel.or_replace:
+                raise RuntimeError(f"A model with the name {name} is already present.")
+        kwargs = dict(rel.kwargs)
+        model_class = kwargs.pop("model_class", None)
+        if model_class is None:
+            raise ValueError("CREATE MODEL requires a model_class parameter")
+        experiment_class = kwargs.pop("experiment_class", None)
+        target_column = kwargs.pop("target_column", "")
+        wrap_predict = _boolish(kwargs.pop("wrap_predict", False))
+        wrap_fit = _boolish(kwargs.pop("wrap_fit", False))
+        fit_kwargs = kwargs.pop("fit_kwargs", {}) or {}
+        backend = kwargs.pop("backend", "tpu")
+        kwargs.pop("gpu", None)
+
+        training_table = executor.execute(rel.input)
+        df = training_table.to_pandas()
+        X, y = _split_xy(df, target_column)
+
+        ModelClass = get_model_class(str(model_class), backend=str(backend))
+        model = ModelClass(**kwargs)
+        if wrap_fit:
+            model = Incremental(model)
+        if y is not None:
+            model.fit(X.to_numpy(), y.to_numpy(), **fit_kwargs)
+        else:
+            model.fit(X.to_numpy(), **fit_kwargs)
+        if wrap_predict and not isinstance(model, (ParallelPostFit, Incremental)):
+            model = ParallelPostFit(model)
+        ctx.register_model(name, model, list(X.columns), schema_name=schema_name)
+        return _EMPTY
+
+
+@Executor.add_plugin_class
+class PredictModelPlugin(BaseRelPlugin):
+    class_name = "PredictModelNode"
+
+    def convert(self, rel: p.PredictModelNode, executor) -> Table:
+        ctx = executor.context
+        schema_name, name = ctx._table_schema_name(rel.model_name)
+        model, training_columns = ctx.get_model(schema_name, name)
+        inp = executor.execute(rel.input)
+        df = inp.to_pandas()
+        pred = model.predict(df[training_columns].to_numpy())
+        names = unique_names([f.name for f in rel.schema])
+        cols = dict(zip(names[:-1], [inp.columns[c] for c in inp.column_names]))
+        cols[names[-1]] = Column.from_numpy(np.asarray(pred))
+        return Table(cols, inp.num_rows)
+
+
+@Executor.add_plugin_class
+class DropModelPlugin(BaseRelPlugin):
+    class_name = "DropModelNode"
+
+    def convert(self, rel: p.DropModelNode, executor) -> Table:
+        ctx = executor.context
+        schema_name, name = ctx._table_schema_name(rel.name)
+        if name not in ctx.schema[schema_name].models:
+            if rel.if_exists:
+                return _EMPTY
+            raise RuntimeError(f"A model with the name {name} is not present.")
+        del ctx.schema[schema_name].models[name]
+        return _EMPTY
+
+
+@Executor.add_plugin_class
+class DescribeModelPlugin(BaseRelPlugin):
+    class_name = "DescribeModelNode"
+
+    def convert(self, rel: p.DescribeModelNode, executor) -> Table:
+        ctx = executor.context
+        schema_name, name = ctx._table_schema_name(rel.name)
+        model, training_columns = ctx.get_model(schema_name, name)
+        params = model.get_params() if hasattr(model, "get_params") else {}
+        params["training_columns"] = training_columns
+        keys = np.array([str(k) for k in params.keys()], dtype=object)
+        vals = np.array([str(v) for v in params.values()], dtype=object)
+        return Table({"Params": Column.from_numpy(keys),
+                      "Value": Column.from_numpy(vals)}, len(keys))
+
+
+@Executor.add_plugin_class
+class ExportModelPlugin(BaseRelPlugin):
+    class_name = "ExportModelNode"
+
+    def convert(self, rel: p.ExportModelNode, executor) -> Table:
+        ctx = executor.context
+        schema_name, name = ctx._table_schema_name(rel.name)
+        model, training_columns = ctx.get_model(schema_name, name)
+        kwargs = dict(rel.kwargs)
+        fmt = str(kwargs.pop("format", "pickle")).lower()
+        location = kwargs.pop("location", "tmp.pkl")
+        if fmt in ("pickle", "pkl"):
+            import pickle
+
+            with open(location, "wb") as f:
+                pickle.dump(model, f, **kwargs)
+        elif fmt == "joblib":
+            import joblib
+
+            joblib.dump(model, location, **kwargs)
+        elif fmt == "mlflow":
+            try:
+                import mlflow
+            except ImportError as e:  # pragma: no cover
+                raise RuntimeError("mlflow is not installed") from e
+            mlflow.sklearn.save_model(model, location, **kwargs)
+        elif fmt == "onnx":
+            raise RuntimeError(
+                "ONNX export requires skl2onnx, which is not installed here")
+        else:
+            raise NotImplementedError(f"EXPORT MODEL format {fmt!r}")
+        return _EMPTY
+
+
+@Executor.add_plugin_class
+class CreateExperimentPlugin(BaseRelPlugin):
+    class_name = "CreateExperimentNode"
+
+    def convert(self, rel: p.CreateExperimentNode, executor) -> Table:
+        from ....ml.ml_classes import get_model_class
+
+        ctx = executor.context
+        schema_name, name = ctx._table_schema_name(rel.name)
+        if name in ctx.schema[schema_name].experiments:
+            if rel.if_not_exists:
+                return _EMPTY
+            if not rel.or_replace:
+                raise RuntimeError(f"An experiment with the name {name} is already present.")
+        kwargs = dict(rel.kwargs)
+        model_class = kwargs.pop("model_class", None)
+        experiment_class = kwargs.pop("experiment_class", "sklearn.model_selection.GridSearchCV")
+        tune_parameters = kwargs.pop("tune_parameters", {}) or {}
+        target_column = kwargs.pop("target_column", "")
+        automl_class = kwargs.pop("automl_class", None)
+        experiment_kwargs = kwargs.pop("experiment_kwargs", {}) or {}
+        kwargs.pop("gpu", None)
+
+        training_table = executor.execute(rel.input)
+        df = training_table.to_pandas()
+        X, y = _split_xy(df, target_column)
+
+        if automl_class:
+            raise NotImplementedError(
+                "AutoML (TPOT-style) experiments need the automl package installed")
+        if model_class is None:
+            raise ValueError("CREATE EXPERIMENT requires a model_class")
+        ModelClass = get_model_class(str(model_class), backend="cpu")
+        base = ModelClass()
+        ExperimentClass = get_model_class(str(experiment_class), backend="cpu")
+        tuner = ExperimentClass(base, {k: list(v) if isinstance(v, (list, tuple)) else [v]
+                                       for k, v in tune_parameters.items()},
+                                **experiment_kwargs)
+        tuner.fit(X.to_numpy(), y.to_numpy() if y is not None else None)
+        import pandas as pd
+
+        results = pd.DataFrame(tuner.cv_results_)
+        ctx.schema[schema_name].experiments[name] = results
+        ctx.register_model(name, tuner.best_estimator_, list(X.columns),
+                           schema_name=schema_name)
+        out = Table.from_pandas(results.astype(str))
+        return out
+
+
+def _boolish(v) -> bool:
+    if isinstance(v, bool):
+        return v
+    return str(v).lower() in ("true", "1", "yes")

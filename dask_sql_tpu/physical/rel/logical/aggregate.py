@@ -1,0 +1,226 @@
+"""Groupby-aggregate converter.
+
+Role parity: reference aggregate.py:91 (AGGREGATION_MAPPING aggregate.py:117-231,
+FILTER clauses aggregate.py:377-520, DISTINCT via pre-dedup aggregate.py:562-568,
+NULL-preserving sum min_count=1 aggregate.py:486-493, dropna=False groupby
+aggregate.py:575-577, no-groupby constant column aggregate.py:253-258).
+
+TPU-first mechanism: one lexsort factorizes the keys to dense group ids, then
+every aggregate is a masked XLA segment reduction (ops/grouping.py).  The
+same (count,sum,sumsq)-style states serve as the *partial* stage of the
+distributed partial->final tree (parallel/collectives.py), mirroring the
+reference's dd.Aggregation chunk/agg/finalize triples.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+import jax.numpy as jnp
+import numpy as np
+
+from ....columnar.column import Column
+from ....columnar.dtypes import SqlType, sql_to_np
+from ....columnar.table import Table
+from ....ops import grouping as g
+from ....planner import plan as p
+from ....planner.expressions import AggExpr
+from ..base import BaseRelPlugin, unique_names
+from ...executor import Executor
+
+
+@Executor.add_plugin_class
+class AggregatePlugin(BaseRelPlugin):
+    class_name = "Aggregate"
+
+    def convert(self, rel: p.Aggregate, executor) -> Table:
+        (inp,) = self.assert_inputs(rel, 1, executor)
+        n = inp.num_rows
+
+        group_cols = [executor.eval_expr(e, inp) for e in rel.group_exprs]
+        if group_cols:
+            gid, order, num_groups = g.factorize(g.key_arrays(group_cols))
+            if n == 0:
+                num_groups = 0
+        else:
+            gid = jnp.zeros(n, dtype=jnp.int32)
+            num_groups = 1  # global aggregate always yields one row
+            order = jnp.arange(n, dtype=jnp.int32)
+
+        names = unique_names([f.name for f in rel.schema])
+        out: Dict[str, Column] = {}
+        # group key columns: value at first occurrence of each group
+        if group_cols and num_groups > 0:
+            first = g.group_first_indices(gid, num_groups)
+            for name, col in zip(names, group_cols):
+                out[name] = col.take(first)
+        elif group_cols:
+            for name, col in zip(names, group_cols):
+                out[name] = col.slice(0, 0)
+
+        agg_names = names[len(group_cols):]
+        for name, agg in zip(agg_names, rel.agg_exprs):
+            out[name] = self._compute_agg(agg, inp, gid, num_groups, executor)
+        return Table(out, num_groups)
+
+    # ------------------------------------------------------------------
+    def _compute_agg(self, agg: AggExpr, inp: Table, gid, num_groups: int,
+                     executor) -> Column:
+        n = inp.num_rows
+        func = agg.func
+
+        # FILTER (WHERE ...) restricts contributing rows (validity-mask AND)
+        fmask = None
+        if agg.filter is not None:
+            fc = executor.eval_expr(agg.filter, inp)
+            fmask = fc.data & fc.valid_mask()
+
+        if func == "count_star":
+            valid = jnp.ones(n, dtype=bool) if fmask is None else fmask
+            if agg.distinct:
+                # COUNT(DISTINCT *) over all columns
+                cols = [inp.columns[c] for c in inp.column_names]
+                return self._count_distinct(cols, valid, gid, num_groups)
+            cnt = g.seg_count(valid, gid, num_groups)
+            return Column(cnt, SqlType.BIGINT)
+
+        if func.startswith("udaf:"):
+            return self._udaf(func[5:], agg, inp, gid, num_groups, executor, fmask)
+
+        args = [executor.eval_expr(a, inp) for a in agg.args]
+        col = args[0] if args else None
+        if col is not None and col.dictionary is not None:
+            # sorted dictionary => min/max over codes == lexicographic min/max
+            col = col.compact_dictionary()
+        valid = col.valid_mask() if col is not None else jnp.ones(n, dtype=bool)
+        if fmask is not None:
+            valid = valid & fmask
+        if col is not None and col.sql_type in (SqlType.FLOAT, SqlType.DOUBLE, SqlType.DECIMAL):
+            valid = valid & ~jnp.isnan(col.data)
+
+        if agg.distinct and func not in ("min", "max"):
+            # dedup (group, value) pairs before reducing — parity:
+            # reference drop_duplicates pre-pass (aggregate.py:562-568)
+            keys = [gid] + g.key_arrays([col])
+            pair_gid, _, pair_num = g.factorize(keys)
+            first = g.group_first_indices(pair_gid, pair_num) if n else jnp.zeros(0, jnp.int64)
+            keep = jnp.zeros(n, dtype=bool)
+            if n:
+                keep = keep.at[first].set(True)
+            valid = valid & keep
+
+        values = col.data if col is not None else None
+
+        if func == "count":
+            if agg.distinct:
+                pass  # already deduped above
+            return Column(g.seg_count(valid, gid, num_groups), SqlType.BIGINT)
+        if func == "sum":
+            vals, ok = g.seg_sum(_as_acc(values, col), valid, gid, num_groups)
+            return _mk(vals, ok, agg.sql_type)
+        if func == "min":
+            vals, ok = g.seg_min(values, valid, gid, num_groups)
+            return _mk_like(vals, ok, col, agg.sql_type)
+        if func == "max":
+            vals, ok = g.seg_max(values, valid, gid, num_groups)
+            return _mk_like(vals, ok, col, agg.sql_type)
+        if func == "avg":
+            vals, ok = g.seg_avg(_numeric(values), valid, gid, num_groups)
+            return _mk(vals, ok, SqlType.DOUBLE)
+        if func in ("var_samp", "var_pop", "stddev_samp", "stddev_pop"):
+            ddof = 1 if func.endswith("samp") else 0
+            vals, ok = g.seg_var(_numeric(values), valid, gid, num_groups, ddof)
+            if func.startswith("stddev"):
+                vals = jnp.sqrt(vals)
+            return _mk(vals, ok, SqlType.DOUBLE)
+        if func == "every":
+            vals, ok = g.seg_bool_and(values, valid, gid, num_groups)
+            return _mk(vals, ok, SqlType.BOOLEAN)
+        if func == "bool_or":
+            vals, ok = g.seg_bool_or(values, valid, gid, num_groups)
+            return _mk(vals, ok, SqlType.BOOLEAN)
+        if func in ("bit_and", "bit_or", "bit_xor"):
+            vals, ok = g.seg_bitwise(values, valid, gid, num_groups, func)
+            return _mk_like(vals.astype(col.data.dtype), ok, col, agg.sql_type)
+        if func in ("single_value", "first_value"):
+            vals, ok = g.seg_first(values, valid, gid, num_groups)
+            return _mk_like(vals, ok, col, agg.sql_type)
+        if func == "last_value":
+            vals, ok = g.seg_last(values, valid, gid, num_groups)
+            return _mk_like(vals, ok, col, agg.sql_type)
+        if func == "approx_count_distinct":
+            cols = [col]
+            return self._count_distinct(cols, valid, gid, num_groups)
+        if func == "regr_count":
+            y, x = args
+            both = valid & x.valid_mask()
+            return Column(g.seg_count(both, gid, num_groups), SqlType.BIGINT)
+        if func in ("regr_syy", "regr_sxx"):
+            y, x = args
+            both = y.valid_mask() & x.valid_mask()
+            if fmask is not None:
+                both = both & fmask
+            target = y if func == "regr_syy" else x
+            vals, ok = g.seg_var(_numeric(target.data), both, gid, num_groups, 0)
+            cnt = g.seg_count(both, gid, num_groups)
+            return _mk(vals * cnt, ok, SqlType.DOUBLE)
+        raise NotImplementedError(f"aggregate {func}")
+
+    def _count_distinct(self, cols, valid, gid, num_groups) -> Column:
+        n = int(valid.shape[0])
+        keys = [gid] + g.key_arrays(cols)
+        pair_gid, _, pair_num = g.factorize(keys)
+        first = g.group_first_indices(pair_gid, pair_num) if n else jnp.zeros(0, jnp.int64)
+        keep = jnp.zeros(n, dtype=bool)
+        if n:
+            keep = keep.at[first].set(True)
+        allv = jnp.ones(n, dtype=bool)
+        for c in cols:
+            allv &= c.valid_mask()
+        cnt = g.seg_count(keep & valid & allv, gid, num_groups)
+        return Column(cnt, SqlType.BIGINT)
+
+    def _udaf(self, name: str, agg: AggExpr, inp: Table, gid, num_groups,
+              executor, fmask) -> Column:
+        """User-registered aggregation: applied per group on host (parity:
+        reference dd.Aggregation custom UDAFs, context.py:415)."""
+        fd = executor.lookup_function(name)
+        args = [executor.eval_expr(a, inp) for a in agg.args]
+        col = args[0]
+        import pandas as pd
+
+        ser = pd.Series(col.to_numpy())
+        gids = np.asarray(gid)
+        if fmask is not None:
+            keep = np.asarray(fmask)
+            ser = ser[keep]
+            gids = gids[keep]
+        grouped = ser.groupby(gids)
+        result = fd.func(grouped)
+        out = np.full(num_groups, np.nan)
+        out[np.asarray(result.index, dtype=int)] = result.to_numpy()
+        res = Column.from_numpy(out)
+        return res.cast(fd.return_type)
+
+
+def _numeric(values):
+    return values.astype(jnp.float64)
+
+
+def _as_acc(values, col: Column):
+    """Accumulate int sums in int64 (overflow safety)."""
+    if jnp.issubdtype(values.dtype, jnp.integer) or values.dtype == jnp.bool_:
+        return values.astype(jnp.int64)
+    return values
+
+
+def _mk(vals, ok, sql_type: SqlType) -> Column:
+    target = sql_to_np(sql_type)
+    vals = vals.astype(target) if vals.dtype != target else vals
+    validity = None if bool(ok.all()) else ok
+    return Column(vals, sql_type, validity)
+
+
+def _mk_like(vals, ok, src: Column, sql_type: SqlType) -> Column:
+    """Result keeping the source column's encoding (min/max of strings etc.)."""
+    validity = None if bool(ok.all()) else ok
+    return Column(vals, sql_type, validity, src.dictionary)

@@ -1,0 +1,122 @@
+"""Join converter.
+
+Role parity: reference join.py:23 (equijoin extraction already done by the
+binder/`split_join_condition`; NULL-key filtering join.py:202-213; leftanti
+via indicator join.py:229-239; residual conditions as post-filter
+join.py:170-181; cross join via constant column join.py:133-142).  TPU-first
+mechanism: joint key factorization + sort/searchsorted probe
+(ops/join.py), no hash shuffle needed on a single device; the distributed
+path hash-shards both sides with collectives first (parallel/shuffle.py).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ....columnar.table import Table
+from ....ops import join as join_ops
+from ....planner import plan as p
+from ....planner.expressions import shift_columns
+from ..base import BaseRelPlugin, unique_names
+from ...executor import Executor
+
+
+def _cross_indices(nl: int, nr: int):
+    li = jnp.repeat(jnp.arange(nl, dtype=jnp.int64), nr)
+    ri = jnp.tile(jnp.arange(nr, dtype=jnp.int64), nl)
+    return li, ri
+
+
+def _materialize(left: Table, right: Table, li, ri) -> Table:
+    """Gather a combined table from index pairs; -1 indices produce NULLs."""
+    names = unique_names(list(left.column_names) + list(right.column_names))
+    cols = {}
+    for name, src in zip(names[: len(left.column_names)], left.column_names):
+        cols[name] = join_ops.take_with_nulls(left.columns[src], li)
+    for name, src in zip(names[len(left.column_names):], right.column_names):
+        cols[name] = join_ops.take_with_nulls(right.columns[src], ri)
+    return Table(cols, int(li.shape[0]))
+
+
+@Executor.add_plugin_class
+class JoinPlugin(BaseRelPlugin):
+    class_name = "Join"
+
+    def convert(self, rel: p.Join, executor) -> Table:
+        left, right = self.assert_inputs(rel, 2, executor)
+        nleft = len(rel.left.schema)
+        jt = rel.join_type
+
+        if rel.on:
+            lkeys = [executor.eval_expr(l, left) for l, _ in rel.on]
+            rkeys = [executor.eval_expr(shift_columns(r, -nleft), right) for _, r in rel.on]
+            lgid, rgid = join_ops.join_key_gids(lkeys, rkeys)
+        else:
+            # no equi keys: every row matches every row (filtered below)
+            lgid = jnp.zeros(left.num_rows, dtype=jnp.int64)
+            rgid = jnp.zeros(right.num_rows, dtype=jnp.int64)
+
+        if jt in ("LEFTSEMI", "LEFTANTI"):
+            if rel.filter is None:
+                mask = join_ops.semi_join_mask(lgid, rgid, anti=(jt == "LEFTANTI"))
+                return self.fix_column_to_row_type(left.filter(mask), rel.schema)
+            li, ri = join_ops.inner_join_indices(lgid, rgid)
+            combined = _materialize(left, right, li, ri)
+            cond = executor.eval_expr(rel.filter, combined)
+            keep = cond.data & cond.valid_mask()
+            matched = jnp.zeros(left.num_rows, dtype=bool)
+            if int(li.shape[0]):
+                matched = matched.at[li].max(keep)
+            if jt == "LEFTANTI":
+                matched = ~matched
+            return self.fix_column_to_row_type(left.filter(matched), rel.schema)
+
+        if jt == "INNER":
+            li, ri = join_ops.inner_join_indices(lgid, rgid)
+            combined = _materialize(left, right, li, ri)
+            if rel.filter is not None:
+                cond = executor.eval_expr(rel.filter, combined)
+                combined = combined.filter(cond.data & cond.valid_mask())
+            return self.fix_column_to_row_type(combined, rel.schema)
+
+        if jt in ("LEFT", "RIGHT", "FULL"):
+            # probe as inner first, apply the residual to matched pairs, then
+            # pad outer rows that lost all their matches
+            li, ri = join_ops.inner_join_indices(lgid, rgid)
+            if rel.filter is not None and int(li.shape[0]):
+                combined = _materialize(left, right, li, ri)
+                cond = executor.eval_expr(rel.filter, combined)
+                keep = cond.data & cond.valid_mask()
+                li, ri = li[keep], ri[keep]
+            li2, ri2 = li, ri
+            if jt in ("LEFT", "FULL"):
+                lm = jnp.zeros(left.num_rows, dtype=bool)
+                if int(li.shape[0]):
+                    lm = lm.at[li].set(True)
+                pad = jnp.nonzero(~lm)[0].astype(jnp.int64)
+                li2 = jnp.concatenate([li2, pad])
+                ri2 = jnp.concatenate([ri2, jnp.full(pad.shape[0], -1, dtype=jnp.int64)])
+            if jt in ("RIGHT", "FULL"):
+                rm = jnp.zeros(right.num_rows, dtype=bool)
+                if int(ri.shape[0]):
+                    rm = rm.at[ri].set(True)
+                pad = jnp.nonzero(~rm)[0].astype(jnp.int64)
+                li2 = jnp.concatenate([li2, jnp.full(pad.shape[0], -1, dtype=jnp.int64)])
+                ri2 = jnp.concatenate([ri2, pad])
+            combined = _materialize(left, right, li2, ri2)
+            return self.fix_column_to_row_type(combined, rel.schema)
+
+        raise NotImplementedError(f"join type {jt}")
+
+
+@Executor.add_plugin_class
+class CrossJoinPlugin(BaseRelPlugin):
+    """Parity: reference cross_join.py:15."""
+
+    class_name = "CrossJoin"
+
+    def convert(self, rel: p.CrossJoin, executor) -> Table:
+        left, right = self.assert_inputs(rel, 2, executor)
+        li, ri = _cross_indices(left.num_rows, right.num_rows)
+        return self.fix_column_to_row_type(
+            _materialize(left, right, li, ri), rel.schema)

@@ -1,0 +1,360 @@
+"""Window-function converter.
+
+Role parity: reference window.py:201 (groupby(partition).apply with per-group
+sort + pandas expanding/rolling Indexers, window.py:96-198; ops row_number/
+sum/count/max/min/avg/first/last window.py:214-225 — we add the rank family
+and lag/lead).
+
+TPU-first mechanism (SURVEY.md §7 "windows"): ONE device lexsort by
+(partition keys, order keys), segment boundaries from key-change flags, then
+every window function is a vectorized segmented prefix-scan / prefix-sum
+difference over the sorted layout, scattered back through the inverse
+permutation.  No per-group host loops.
+"""
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ....columnar.column import Column
+from ....columnar.dtypes import STRING_TYPES, SqlType, sql_to_np
+from ....columnar.table import Table
+from ....ops.grouping import key_arrays
+from ....ops.sorting import sort_permutation
+from ....planner import plan as p
+from ....planner.expressions import WindowExpr, WindowFrameBound
+from ..base import BaseRelPlugin, unique_names
+from ...executor import Executor
+
+
+@Executor.add_plugin_class
+class WindowPlugin(BaseRelPlugin):
+    class_name = "Window"
+
+    def convert(self, rel: p.Window, executor) -> Table:
+        (inp,) = self.assert_inputs(rel, 1, executor)
+        names = unique_names([f.name for f in rel.schema])
+        out_cols = dict(zip(names[: len(inp.column_names)],
+                            [inp.columns[c] for c in inp.column_names]))
+        n = inp.num_rows
+        # group window exprs by identical (partition, order) so one sort serves many
+        by_spec = {}
+        for i, w in enumerate(rel.window_exprs):
+            key = (w.spec.partition_by, w.spec.order_by)
+            by_spec.setdefault(key, []).append((i, w))
+        results: List[Column] = [None] * len(rel.window_exprs)
+        for (part, order), items in by_spec.items():
+            part_cols = [executor.eval_expr(e, inp) for e in part]
+            order_cols = [executor.eval_expr(k.expr, inp) for k in order]
+            layout = _SortedLayout(part_cols, order_cols,
+                                   [k.ascending for k in order],
+                                   [k.nulls_first_resolved() for k in order], n)
+            for i, w in items:
+                args = [executor.eval_expr(a, inp) for a in w.args]
+                results[i] = _compute_window(w, args, layout)
+        for name, col in zip(names[len(inp.column_names):], results):
+            out_cols[name] = col
+        return Table(out_cols, n)
+
+
+class _SortedLayout:
+    """Shared sorted layout for one (partition, order) spec."""
+
+    def __init__(self, part_cols, order_cols, ascendings, nulls_firsts, n: int):
+        self.n = n
+        if n == 0:
+            self.perm = jnp.zeros(0, dtype=jnp.int64)
+            self.inv = jnp.zeros(0, dtype=jnp.int64)
+            return
+        keys_cols = list(part_cols) + list(order_cols)
+        asc = [True] * len(part_cols) + list(ascendings)
+        nf = [False] * len(part_cols) + list(nulls_firsts)
+        if keys_cols:
+            self.perm = sort_permutation(keys_cols, asc, nf)
+        else:
+            self.perm = jnp.arange(n, dtype=jnp.int64)
+        self.inv = jnp.zeros(n, dtype=jnp.int64).at[self.perm].set(
+            jnp.arange(n, dtype=jnp.int64))
+        # segment flags in sorted space
+        self.new_seg = _change_flags(part_cols, self.perm, n)
+        self.new_peer = self.new_seg | _change_flags(order_cols, self.perm, n) \
+            if order_cols else self.new_seg.copy()
+        if not order_cols:
+            self.new_peer = self.new_seg
+        idx = jnp.arange(n, dtype=jnp.int64)
+        self.seg_start = _running_latest(jnp.where(self.new_seg, idx, -1))
+        self.peer_start = _running_latest(jnp.where(self.new_peer, idx, -1))
+        # segment/peer end (exclusive): next start, scanned from the right
+        self.seg_end = _next_start(self.new_seg, n)
+        self.peer_end = _next_start(self.new_peer, n)
+
+    def scatter_back(self, sorted_vals, validity=None):
+        data = sorted_vals[self.inv]
+        v = None if validity is None else validity[self.inv]
+        return data, v
+
+
+def _change_flags(cols, perm, n):
+    flags = jnp.zeros(n, dtype=bool).at[0].set(True)
+    for k in key_arrays(cols):
+        ks = k[perm]
+        flags = flags.at[1:].set(flags[1:] | (ks[1:] != ks[:-1]))
+    if not cols:
+        flags = jnp.zeros(n, dtype=bool).at[0].set(True)
+    return flags
+
+
+def _running_latest(marked):
+    """Per position, the latest index where marked >= 0 (cummax)."""
+    return jax.lax.cummax(marked)
+
+
+def _next_start(flags, n):
+    idx = jnp.arange(n, dtype=jnp.int64)
+    nxt = jnp.where(flags, idx, n)
+    rev = jax.lax.cummin(nxt[::-1])[::-1]
+    # next start *after* each position
+    shifted = jnp.concatenate([rev[1:], jnp.array([n], dtype=rev.dtype)])
+    return shifted
+
+
+def _prefix(vals):
+    """P[k] = sum of first k entries (length n+1)."""
+    return jnp.concatenate([jnp.zeros(1, dtype=vals.dtype), jnp.cumsum(vals)])
+
+
+def _frame_bounds(w: WindowExpr, lay: _SortedLayout):
+    """Per sorted row: [lo, hi) frame range."""
+    n = lay.n
+    i = jnp.arange(n, dtype=jnp.int64)
+    spec = w.spec
+    if spec.units == "RANGE" or not spec.explicit_frame and spec.order_by:
+        # default ordered frame: start of segment .. end of current peer group
+        lo = lay.seg_start
+        hi = lay.peer_end
+        if spec.explicit_frame:
+            s, e = spec.start, spec.end
+            if s.kind == "CURRENT_ROW":
+                lo = lay.peer_start
+            if e.kind == "UNBOUNDED_FOLLOWING":
+                hi = lay.seg_end
+            if s.kind == "UNBOUNDED_PRECEDING":
+                lo = lay.seg_start
+            if e.kind == "CURRENT_ROW":
+                hi = lay.peer_end
+        return lo, hi
+    # ROWS frames
+    s, e = w.spec.start, w.spec.end
+    if s.kind == "UNBOUNDED_PRECEDING":
+        lo = lay.seg_start
+    elif s.kind == "PRECEDING":
+        lo = jnp.maximum(lay.seg_start, i - int(s.offset))
+    elif s.kind == "CURRENT_ROW":
+        lo = i
+    elif s.kind == "FOLLOWING":
+        lo = jnp.minimum(lay.seg_end, i + int(s.offset))
+    else:
+        lo = lay.seg_start
+    if e.kind == "UNBOUNDED_FOLLOWING":
+        hi = lay.seg_end
+    elif e.kind == "FOLLOWING":
+        hi = jnp.minimum(lay.seg_end, i + int(e.offset) + 1)
+    elif e.kind == "CURRENT_ROW":
+        hi = i + 1
+    elif e.kind == "PRECEDING":
+        hi = jnp.maximum(lay.seg_start, i - int(e.offset) + 1)
+    else:
+        hi = lay.seg_end
+    return lo, hi
+
+
+def _compute_window(w: WindowExpr, args: List[Column], lay: _SortedLayout) -> Column:
+    n = lay.n
+    if n == 0:
+        return Column(jnp.zeros(0, dtype=sql_to_np(w.sql_type)), w.sql_type)
+    i = jnp.arange(n, dtype=jnp.int64)
+    func = w.func
+
+    if func == "row_number":
+        vals = i - lay.seg_start + 1
+        data, _ = lay.scatter_back(vals)
+        return Column(data.astype(jnp.int64), SqlType.BIGINT)
+    if func == "rank":
+        vals = lay.peer_start - lay.seg_start + 1
+        data, _ = lay.scatter_back(vals)
+        return Column(data.astype(jnp.int64), SqlType.BIGINT)
+    if func == "dense_rank":
+        np_int = lay.new_peer.astype(jnp.int64)
+        c = jnp.cumsum(np_int)
+        vals = c - c[lay.seg_start] + 1
+        data, _ = lay.scatter_back(vals)
+        return Column(data.astype(jnp.int64), SqlType.BIGINT)
+    if func == "percent_rank":
+        seg_len = lay.seg_end - lay.seg_start
+        rank = lay.peer_start - lay.seg_start + 1
+        vals = jnp.where(seg_len > 1, (rank - 1) / jnp.maximum(seg_len - 1, 1), 0.0)
+        data, _ = lay.scatter_back(vals)
+        return Column(data.astype(jnp.float64), SqlType.DOUBLE)
+    if func == "cume_dist":
+        seg_len = lay.seg_end - lay.seg_start
+        vals = (lay.peer_end - lay.seg_start) / jnp.maximum(seg_len, 1)
+        data, _ = lay.scatter_back(vals)
+        return Column(data.astype(jnp.float64), SqlType.DOUBLE)
+    if func == "ntile":
+        k = int(np.asarray(args[0].data)[0]) if args else 1
+        seg_len = lay.seg_end - lay.seg_start
+        rn = i - lay.seg_start
+        vals = jnp.minimum((rn * k) // jnp.maximum(seg_len, 1), k - 1) + 1
+        data, _ = lay.scatter_back(vals)
+        return Column(data.astype(jnp.int64), SqlType.BIGINT)
+    if func in ("lag", "lead"):
+        x = args[0]
+        off = int(np.asarray(args[1].data)[0]) if len(args) > 1 else 1
+        default = args[2] if len(args) > 2 else None
+        xs = x.data[lay.perm]
+        xv = x.valid_mask()[lay.perm]
+        j = i - off if func == "lag" else i + off
+        inside = (j >= lay.seg_start) & (j < lay.seg_end)
+        j_safe = jnp.clip(j, 0, n - 1)
+        vals = xs[j_safe]
+        valid = xv[j_safe] & inside
+        if default is not None:
+            dv = default.cast(x.sql_type)
+            ds = dv.data[lay.perm]
+            vals = jnp.where(inside, vals, ds)
+            valid = jnp.where(inside, valid, dv.valid_mask()[lay.perm])
+        data, v = lay.scatter_back(vals, valid)
+        validity = None if bool(v.all()) else v
+        return Column(data, w.sql_type, validity, x.dictionary)
+
+    # frame-based functions
+    lo, hi = _frame_bounds(w, lay)
+    if func in ("first_value", "last_value", "nth_value"):
+        x = args[0]
+        xs = x.data[lay.perm]
+        xv = x.valid_mask()[lay.perm]
+        if func == "first_value":
+            j = lo
+        elif func == "last_value":
+            j = hi - 1
+        else:
+            k = int(np.asarray(args[1].data)[0])
+            j = lo + (k - 1)
+        inside = (j >= lo) & (j < hi) & (hi > lo)
+        j_safe = jnp.clip(j, 0, n - 1)
+        vals = xs[j_safe]
+        valid = xv[j_safe] & inside
+        data, v = lay.scatter_back(vals, valid)
+        validity = None if bool(v.all()) else v
+        return Column(data, w.sql_type, validity, x.dictionary)
+
+    if func == "count_star":
+        vals = (hi - lo).astype(jnp.int64)
+        data, _ = lay.scatter_back(vals)
+        return Column(data, SqlType.BIGINT)
+
+    x = args[0] if args else None
+    xs = x.data[lay.perm] if x is not None else None
+    xv = x.valid_mask()[lay.perm] if x is not None else None
+
+    if func == "count":
+        P = _prefix(xv.astype(jnp.int64))
+        vals = P[hi] - P[lo]
+        data, _ = lay.scatter_back(vals)
+        return Column(data, SqlType.BIGINT)
+    if func in ("sum", "avg"):
+        acc = xs.astype(jnp.float64) if func == "avg" or xs.dtype.kind == "f" \
+            else xs.astype(jnp.int64)
+        acc = jnp.where(xv, acc, jnp.zeros_like(acc))
+        P = _prefix(acc)
+        s = P[hi] - P[lo]
+        Pc = _prefix(xv.astype(jnp.int64))
+        cnt = Pc[hi] - Pc[lo]
+        if func == "avg":
+            vals = s / jnp.maximum(cnt, 1)
+        else:
+            vals = s
+        valid = cnt > 0
+        data, v = lay.scatter_back(vals, valid)
+        validity = None if bool(v.all()) else v
+        target = sql_to_np(w.sql_type)
+        return Column(data.astype(target), w.sql_type, validity)
+    if func in ("min", "max"):
+        big = _extreme_val(xs.dtype, func == "min")
+        masked = jnp.where(xv, xs, big)
+        # segmented running min/max handles prefix frames; bounded frames use
+        # a log-shift sparse table (O(n log w))
+        if bool(jnp.all(lo == lay.seg_start)) and bool(jnp.all(hi == i + 1) | jnp.all(hi == lay.peer_end)):
+            op = jnp.minimum if func == "min" else jnp.maximum
+            run = _segmented_scan(masked, lay.new_seg, op)
+            peer_adjusted = run[jnp.clip(hi - 1, 0, n - 1)]
+            vals = peer_adjusted
+        else:
+            vals = _range_minmax(masked, lo, hi, func == "min")
+        Pc = _prefix(xv.astype(jnp.int64))
+        cnt = Pc[hi] - Pc[lo]
+        valid = cnt > 0
+        data, v = lay.scatter_back(vals, valid)
+        validity = None if bool(v.all()) else v
+        return Column(data, w.sql_type, validity, x.dictionary)
+    if func in ("stddev_samp", "stddev_pop", "var_samp", "var_pop"):
+        acc = jnp.where(xv, xs.astype(jnp.float64), 0.0)
+        P1 = _prefix(acc)
+        P2 = _prefix(acc * acc)
+        Pc = _prefix(xv.astype(jnp.int64))
+        cnt = Pc[hi] - Pc[lo]
+        s1 = P1[hi] - P1[lo]
+        s2 = P2[hi] - P2[lo]
+        ddof = 1 if func.endswith("samp") else 0
+        mean = s1 / jnp.maximum(cnt, 1)
+        var = (s2 - cnt * mean * mean) / jnp.maximum(cnt - ddof, 1)
+        var = jnp.maximum(var, 0.0)
+        vals = jnp.sqrt(var) if func.startswith("stddev") else var
+        valid = cnt > ddof
+        data, v = lay.scatter_back(vals, valid)
+        return Column(data, SqlType.DOUBLE, None if bool(v.all()) else v)
+    raise NotImplementedError(f"window function {func}")
+
+
+def _extreme_val(dtype, for_min: bool):
+    if jnp.issubdtype(dtype, jnp.floating):
+        return jnp.array(jnp.inf if for_min else -jnp.inf, dtype=dtype)
+    info = jnp.iinfo(dtype)
+    return jnp.array(info.max if for_min else info.min, dtype=dtype)
+
+
+def _segmented_scan(vals, new_seg, op):
+    """Running op within segments via associative scan with reset flags."""
+
+    def combine(a, b):
+        af, av = a
+        bf, bv = b
+        return (af | bf, jnp.where(bf, bv, op(av, bv)))
+
+    flags, out = jax.lax.associative_scan(combine, (new_seg, vals))
+    return out
+
+
+def _range_minmax(masked, lo, hi, is_min: bool):
+    """Sparse-table (doubling) range min/max query for arbitrary frames."""
+    n = masked.shape[0]
+    op = jnp.minimum if is_min else jnp.maximum
+    big = _extreme_val(masked.dtype, is_min)
+    levels = [masked]
+    length = 1
+    while length < n:
+        prev = levels[-1]
+        shifted = jnp.concatenate([prev[length:], jnp.full(min(length, n), big, dtype=prev.dtype)])
+        levels.append(op(prev, shifted))
+        length *= 2
+    width = jnp.maximum(hi - lo, 1)
+    k = jnp.floor(jnp.log2(width.astype(jnp.float64))).astype(jnp.int32)
+    table = jnp.stack(levels)  # [levels, n]
+    idx1 = jnp.clip(lo, 0, n - 1)
+    idx2 = jnp.clip(hi - (1 << k.astype(jnp.int64)), 0, n - 1)
+    a = table[k, idx1]
+    b = table[k, idx2]
+    return op(a, b)

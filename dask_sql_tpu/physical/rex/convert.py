@@ -1,0 +1,203 @@
+"""Rex converter: typed Expr -> device Column against an input Table.
+
+Role parity: reference RexConverter plugin registry (physical/rex/convert.py
+there, _REX_TYPE_TO_PLUGIN convert.py:16-22) with one plugin per expression
+kind (core/input_ref.py, literal.py, call.py, alias.py, subquery.py).  Here
+the registry is keyed by IR class; kernels come from OPERATION_MAPPING.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Type
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...columnar.column import Column
+from ...columnar.dtypes import STRING_TYPES, SqlType, sql_to_np
+from ...columnar.table import Table
+from ...planner.expressions import (
+    CaseExpr,
+    Cast,
+    ColumnRef,
+    ExistsExpr,
+    Expr,
+    InListExpr,
+    InSubqueryExpr,
+    Literal,
+    ScalarFunc,
+    ScalarSubqueryExpr,
+    UdfExpr,
+)
+from .operations import OPERATION_MAPPING, _and_validity, _merged_for_compare
+
+
+class RexConverter:
+    """Evaluates bound expressions over a Table.  `executor` supplies
+    subquery execution and UDF lookup (the physical rel executor)."""
+
+    def __init__(self, executor=None):
+        self.executor = executor
+        self._plugins: Dict[Type, Callable] = {
+            ColumnRef: self._input_ref,
+            Literal: self._literal,
+            ScalarFunc: self._call,
+            Cast: self._cast,
+            CaseExpr: self._case,
+            InListExpr: self._in_list,
+            ScalarSubqueryExpr: self._scalar_subquery,
+            InSubqueryExpr: self._in_subquery,
+            ExistsExpr: self._exists,
+            UdfExpr: self._udf,
+        }
+
+    def convert(self, expr: Expr, table: Table) -> Column:
+        plugin = self._plugins.get(type(expr))
+        if plugin is None:
+            for klass, pl in self._plugins.items():
+                if isinstance(expr, klass):
+                    plugin = pl
+                    break
+        if plugin is None:
+            raise NotImplementedError(f"No rex plugin for {type(expr).__name__}")
+        return plugin(expr, table)
+
+    # -- plugins ------------------------------------------------------------
+    def _input_ref(self, expr: ColumnRef, table: Table) -> Column:
+        # parity: core/input_ref.py — positional backend lookup
+        name = table.column_names[expr.index]
+        return table.columns[name]
+
+    def _literal(self, expr: Literal, table: Table) -> Column:
+        n = max(table.num_rows, 1) if table is not None else 1
+        col = _literal_column(expr, table.num_rows if table is not None else 1)
+        return col
+
+    def _call(self, expr: ScalarFunc, table: Table) -> Column:
+        fn = OPERATION_MAPPING.get(expr.op)
+        if fn is None:
+            raise NotImplementedError(f"No kernel for op {expr.op!r}")
+        args = [self.convert(a, table) for a in expr.args]
+        if not args:
+            return fn(length=max(table.num_rows, 0))
+        out = fn(*args)
+        # trust the planner's result type when it differs benignly
+        return out
+
+    def _cast(self, expr: Cast, table: Table) -> Column:
+        col = self.convert(expr.arg, table)
+        return col.cast(expr.sql_type)
+
+    def _case(self, expr: CaseExpr, table: Table) -> Column:
+        target = expr.sql_type
+        if expr.else_ is not None:
+            out = self.convert(expr.else_, table).cast(target)
+        else:
+            out = Column.from_scalar(None, table.num_rows, target)
+        if target in STRING_TYPES:
+            # strings: materialize on host (dictionaries differ per branch)
+            res = out.to_numpy()
+            for cond, val in reversed(expr.whens):
+                c = self.convert(cond, table)
+                v = self.convert(val, table).cast(target).to_numpy()
+                mask = np.asarray(c.data & c.valid_mask())
+                res[mask] = v[mask]
+            return Column.from_numpy(res)
+        for cond, val in reversed(expr.whens):
+            c = self.convert(cond, table)
+            v = self.convert(val, table).cast(target)
+            take = c.data & c.valid_mask()
+            data = jnp.where(take, v.data, out.data)
+            validity = jnp.where(take, v.valid_mask(), out.valid_mask())
+            out = Column(data, target, None if bool(validity.all()) else validity)
+        return out
+
+    def _in_list(self, expr: InListExpr, table: Table) -> Column:
+        arg = self.convert(expr.arg, table)
+        hits = None
+        any_null_item = False
+        for item in expr.items:
+            ic = self.convert(item, table)
+            if isinstance(item, Literal) and item.value is None:
+                any_null_item = True
+                continue
+            da, db = _merged_for_compare(arg, ic)
+            h = (da == db) & ic.valid_mask()
+            hits = h if hits is None else (hits | h)
+        if hits is None:
+            hits = jnp.zeros(len(arg), dtype=bool)
+        # SQL 3VL: x IN (...) is NULL when no hit and (x is NULL or list has NULL)
+        known = arg.valid_mask() & (hits | (not any_null_item))
+        value = hits if not expr.negated else ~hits
+        validity = None if bool(known.all()) else known
+        return Column(value, SqlType.BOOLEAN, validity)
+
+    def _scalar_subquery(self, expr: ScalarSubqueryExpr, table: Table) -> Column:
+        sub = self.executor.execute(expr.plan)
+        if sub.num_rows == 0:
+            return Column.from_scalar(None, table.num_rows, expr.sql_type)
+        col = sub.columns[sub.column_names[0]]
+        first = col.slice(0, 1)
+        # broadcast the scalar
+        data = jnp.broadcast_to(first.data, (table.num_rows,))
+        validity = None
+        if first.validity is not None:
+            validity = jnp.broadcast_to(first.validity, (table.num_rows,))
+        return Column(data, col.sql_type, validity, col.dictionary)
+
+    def _in_subquery(self, expr: InSubqueryExpr, table: Table) -> Column:
+        from ...ops.join import join_key_gids, semi_join_mask
+
+        arg = self.convert(expr.arg, table)
+        sub = self.executor.execute(expr.plan)
+        sub_col = sub.columns[sub.column_names[0]]
+        lgid, rgid = join_key_gids([arg], [sub_col])
+        mask = semi_join_mask(lgid, rgid)
+        value = ~mask if expr.negated else mask
+        # 3VL: NULL when not matched and (arg null or subquery contains null)
+        sub_has_null = bool(sub_col.has_nulls)
+        known = arg.valid_mask() & (mask | (not sub_has_null))
+        return Column(value, SqlType.BOOLEAN, None if bool(known.all()) else known)
+
+    def _exists(self, expr: ExistsExpr, table: Table) -> Column:
+        sub = self.executor.execute(expr.plan)
+        exists = sub.num_rows > 0
+        val = (not exists) if expr.negated else exists
+        return Column.from_scalar(val, table.num_rows, SqlType.BOOLEAN)
+
+    def _udf(self, expr: UdfExpr, table: Table) -> Column:
+        fd = self.executor.lookup_function(expr.name)
+        args = [self.convert(a, table) for a in expr.args]
+        if fd.row_udf:
+            # row UDF: pandas-style row dicts on host (reference UDF wrapper,
+            # datacontainer.py:234-270 there)
+            import pandas as pd
+
+            frame = pd.DataFrame({f"arg{i}": a.to_numpy() for i, a in enumerate(args)})
+            frame.columns = [p[0] for p in fd.parameters][: len(args)]
+            out = frame.apply(lambda row: fd.func(row), axis=1).to_numpy()
+            col = Column.from_numpy(np.asarray(out))
+        else:
+            out = fd.func(*[a.data for a in args])
+            col = Column(jnp.asarray(out), fd.return_type, _and_validity(*args))
+        return col.cast(fd.return_type) if col.sql_type != fd.return_type else col
+
+
+def _literal_column(expr: Literal, length: int) -> Column:
+    v = expr.value
+    st = expr.sql_type
+    length = max(length, 0)
+    if v is None:
+        col = Column.from_scalar(None, length, st if st != SqlType.NULL else SqlType.DOUBLE)
+        return col
+    if st in STRING_TYPES:
+        col = Column(jnp.zeros(length, dtype=jnp.int32), st, None,
+                     np.array([v], dtype=object))
+    elif st in (SqlType.TIMESTAMP, SqlType.DATE, SqlType.TIME,
+                SqlType.INTERVAL_DAY_TIME, SqlType.INTERVAL_YEAR_MONTH):
+        col = Column(jnp.full(length, int(v), dtype=jnp.int64), st)
+    elif st == SqlType.BOOLEAN:
+        col = Column(jnp.full(length, bool(v), dtype=jnp.bool_), st)
+    else:
+        col = Column(jnp.full(length, v, dtype=sql_to_np(st)), st)
+    object.__setattr__(col, "_lit_value", v)
+    return col

@@ -1,0 +1,678 @@
+"""Scalar kernel implementations: canonical op name -> Column function.
+
+Role parity: the reference's ~100-entry OPERATION_MAPPING (call.py:1047-1156)
+plus its Operation/ReduceOperation/TensorScalarOperation machinery
+(call.py:58-163).  Re-designed for device columns: every kernel is jnp over
+flat buffers + explicit validity-mask algebra (SQL three-valued logic), with
+string ops routed through the dictionary (ops/strings.py) so only uniques
+touch the host.
+"""
+from __future__ import annotations
+
+import math
+import re
+from typing import Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...columnar.column import Column
+from ...columnar.dtypes import (
+    DATETIME_TYPES,
+    FLOAT_TYPES,
+    INTEGER_TYPES,
+    STRING_TYPES,
+    SqlType,
+    promote,
+    sql_to_np,
+)
+from ...ops import datetime as dt_ops
+from ...ops import strings as str_ops
+from ...ops.join import _merge_string_dicts
+
+
+def _and_validity(*cols: Column):
+    masks = [c.validity for c in cols if c.validity is not None]
+    if not masks:
+        return None
+    out = masks[0]
+    for m in masks[1:]:
+        out = out & m
+    return out
+
+
+def _merged_for_compare(a: Column, b: Column):
+    """Return comparable device arrays for two columns (strings via merged
+    sorted dictionary so integer order == lexicographic order)."""
+    if a.sql_type in STRING_TYPES or b.sql_type in STRING_TYPES:
+        ka, kb = _merge_string_dicts(a, b)
+        return ka, kb
+    target = promote(a.sql_type, b.sql_type)
+    return a.cast(target).data, b.cast(target).data
+
+
+# ---------------------------------------------------------------------------
+# arithmetic
+# ---------------------------------------------------------------------------
+def _arith(fn) -> Callable:
+    def op(a: Column, b: Column) -> Column:
+        target = promote(a.sql_type, b.sql_type)
+        da = a.cast(target).data
+        db = b.cast(target).data
+        return Column(fn(da, db), target, _and_validity(a, b))
+
+    return op
+
+
+def _op_div(a: Column, b: Column) -> Column:
+    target = promote(a.sql_type, b.sql_type)
+    da, db = a.cast(target).data, b.cast(target).data
+    if target in INTEGER_TYPES:
+        # SQL integer division truncates toward zero (reference
+        # SQLDivisionOperator, call.py:165); guard /0 under validity
+        safe = jnp.where(db == 0, 1, db)
+        q = jnp.floor_divide(jnp.abs(da), jnp.abs(safe))
+        q = jnp.where((da < 0) ^ (db < 0), -q, q)
+        validity = _and_validity(a, b)
+        zero = db == 0
+        if bool(zero.any()):
+            validity = (~zero) if validity is None else (validity & ~zero)
+        return Column(q, target, validity)
+    return Column(da / db, target, _and_validity(a, b))
+
+
+def _op_mod(a: Column, b: Column) -> Column:
+    target = promote(a.sql_type, b.sql_type)
+    da, db = a.cast(target).data, b.cast(target).data
+    safe = jnp.where(db == 0, 1, db) if target in INTEGER_TYPES else db
+    # SQL MOD: result has the sign of the dividend (fmod semantics)
+    r = jnp.fmod(da, safe)
+    validity = _and_validity(a, b)
+    if target in INTEGER_TYPES:
+        zero = db == 0
+        if bool(zero.any()):
+            validity = (~zero) if validity is None else (validity & ~zero)
+    return Column(r, target, validity)
+
+
+def _op_neg(a: Column) -> Column:
+    return Column(-a.data, a.sql_type, a.validity)
+
+
+# ---------------------------------------------------------------------------
+# comparison
+# ---------------------------------------------------------------------------
+def _compare(fn) -> Callable:
+    def op(a: Column, b: Column) -> Column:
+        da, db = _merged_for_compare(a, b)
+        return Column(fn(da, db), SqlType.BOOLEAN, _and_validity(a, b))
+
+    return op
+
+
+def _op_is_distinct_from(a: Column, b: Column) -> Column:
+    da, db = _merged_for_compare(a, b)
+    va, vb = a.valid_mask(), b.valid_mask()
+    distinct = (va != vb) | (va & vb & (da != db))
+    return Column(distinct, SqlType.BOOLEAN, None)
+
+
+def _op_is_not_distinct_from(a: Column, b: Column) -> Column:
+    c = _op_is_distinct_from(a, b)
+    return Column(~c.data, SqlType.BOOLEAN, None)
+
+
+# ---------------------------------------------------------------------------
+# boolean logic (three-valued)
+# ---------------------------------------------------------------------------
+def _op_and(a: Column, b: Column) -> Column:
+    va, vb = a.valid_mask(), b.valid_mask()
+    da = a.data & va  # treat NULL as False for the value plane
+    db = b.data & vb
+    value = da & db
+    known = (va & vb) | (va & ~a.data) | (vb & ~b.data)
+    validity = None if bool(known.all()) else known
+    return Column(value, SqlType.BOOLEAN, validity)
+
+
+def _op_or(a: Column, b: Column) -> Column:
+    va, vb = a.valid_mask(), b.valid_mask()
+    value = (a.data & va) | (b.data & vb)
+    known = (va & vb) | (va & a.data) | (vb & b.data)
+    validity = None if bool(known.all()) else known
+    return Column(value, SqlType.BOOLEAN, validity)
+
+
+def _op_not(a: Column) -> Column:
+    return Column(~a.data, SqlType.BOOLEAN, a.validity)
+
+
+def _op_is_null(a: Column) -> Column:
+    if a.validity is None:
+        v = jnp.zeros(len(a), dtype=bool)
+    else:
+        v = ~a.validity
+    if a.sql_type in FLOAT_TYPES:
+        v = v | jnp.isnan(a.data)
+    return Column(v, SqlType.BOOLEAN, None)
+
+
+def _op_is_not_null(a: Column) -> Column:
+    return Column(~_op_is_null(a).data, SqlType.BOOLEAN, None)
+
+
+def _op_is_true(a: Column) -> Column:
+    return Column(a.data & a.valid_mask(), SqlType.BOOLEAN, None)
+
+
+def _op_is_false(a: Column) -> Column:
+    return Column(~a.data & a.valid_mask(), SqlType.BOOLEAN, None)
+
+
+def _op_is_not_true(a: Column) -> Column:
+    return Column(~(a.data & a.valid_mask()), SqlType.BOOLEAN, None)
+
+
+def _op_is_not_false(a: Column) -> Column:
+    return Column(~(~a.data & a.valid_mask()), SqlType.BOOLEAN, None)
+
+
+# ---------------------------------------------------------------------------
+# math
+# ---------------------------------------------------------------------------
+def _mathf(fn) -> Callable:
+    def op(a: Column) -> Column:
+        return Column(fn(a.data.astype(jnp.float64)), SqlType.DOUBLE, a.validity)
+
+    return op
+
+
+def _op_abs(a: Column) -> Column:
+    return Column(jnp.abs(a.data), a.sql_type, a.validity)
+
+
+def _op_sign(a: Column) -> Column:
+    return Column(jnp.sign(a.data), a.sql_type, a.validity)
+
+
+def _op_round(a: Column, digits: Optional[Column] = None) -> Column:
+    nd = digits.data if digits is not None else 0
+    if a.sql_type in INTEGER_TYPES and digits is None:
+        return a
+    factor = jnp.power(10.0, nd)
+    # SQL/banker's? Calcite ROUND = half away from zero
+    x = a.data.astype(jnp.float64) * factor
+    r = jnp.sign(x) * jnp.floor(jnp.abs(x) + 0.5)
+    out = r / factor
+    if a.sql_type in INTEGER_TYPES:
+        out = out.astype(a.data.dtype)
+        return Column(out, a.sql_type, _and_validity(a, *( [digits] if digits is not None else [] )))
+    return Column(out, a.sql_type if a.sql_type in FLOAT_TYPES else SqlType.DOUBLE,
+                  _and_validity(a, *( [digits] if digits is not None else [] )))
+
+
+def _op_truncate(a: Column, digits: Optional[Column] = None) -> Column:
+    nd = digits.data if digits is not None else 0
+    factor = jnp.power(10.0, nd)
+    out = jnp.trunc(a.data.astype(jnp.float64) * factor) / factor
+    if a.sql_type in INTEGER_TYPES and digits is None:
+        return a
+    return Column(out, SqlType.DOUBLE, a.validity)
+
+
+def _op_ceil(a: Column) -> Column:
+    if a.sql_type in INTEGER_TYPES:
+        return a
+    return Column(jnp.ceil(a.data.astype(jnp.float64)), SqlType.DOUBLE, a.validity)
+
+
+def _op_floor(a: Column) -> Column:
+    if a.sql_type in INTEGER_TYPES:
+        return a
+    return Column(jnp.floor(a.data.astype(jnp.float64)), SqlType.DOUBLE, a.validity)
+
+
+def _op_log(a: Column, x: Optional[Column] = None) -> Column:
+    if x is None:
+        return Column(jnp.log10(a.data.astype(jnp.float64)), SqlType.DOUBLE, a.validity)
+    # LOG(base, x): log of x in base `a`
+    return Column(jnp.log(x.data.astype(jnp.float64)) / jnp.log(a.data.astype(jnp.float64)),
+                  SqlType.DOUBLE, _and_validity(a, x))
+
+
+_rand_state = {"counter": 0}
+
+
+def _op_rand(seed: Optional[Column] = None, *, length: int = 1) -> Column:
+    if seed is not None:
+        key = jax.random.PRNGKey(int(np.asarray(seed.data)[0]))
+    else:
+        _rand_state["counter"] += 1
+        key = jax.random.PRNGKey(np.random.SeedSequence().entropy % (2**31) + _rand_state["counter"])
+    return Column(jax.random.uniform(key, (length,), dtype=jnp.float64), SqlType.DOUBLE)
+
+
+def _op_rand_integer(*args: Column, length: int = 1) -> Column:
+    if len(args) == 2:
+        seed, bound = args
+        key = jax.random.PRNGKey(int(np.asarray(seed.data)[0]))
+    else:
+        (bound,) = args
+        _rand_state["counter"] += 1
+        key = jax.random.PRNGKey(np.random.SeedSequence().entropy % (2**31) + _rand_state["counter"])
+    n = int(np.asarray(bound.data)[0])
+    return Column(jax.random.randint(key, (length,), 0, max(n, 1)).astype(jnp.int32),
+                  SqlType.INTEGER)
+
+
+# ---------------------------------------------------------------------------
+# conditional / null handling
+# ---------------------------------------------------------------------------
+def _op_coalesce(*cols: Column) -> Column:
+    target = cols[0].sql_type
+    for c in cols[1:]:
+        target = promote(target, c.sql_type) if c.sql_type != SqlType.NULL else target
+    if target in STRING_TYPES:
+        # host path via materialization (dictionaries differ)
+        arrs = [c.to_numpy() for c in cols]
+        out = arrs[0].copy()
+        for arr in arrs[1:]:
+            mask = np.array([v is None for v in out])
+            out[mask] = arr[mask]
+        return Column.from_numpy(out)
+    cols = [c.cast(target) for c in cols]
+    data = cols[-1].data
+    valid = cols[-1].valid_mask()
+    for c in reversed(cols[:-1]):
+        cv = c.valid_mask()
+        data = jnp.where(cv, c.data, data)
+        valid = cv | valid
+    return Column(data, target, None if bool(valid.all()) else valid)
+
+
+def _op_nullif(a: Column, b: Column) -> Column:
+    da, db = _merged_for_compare(a, b)
+    eq = (da == db) & a.valid_mask() & b.valid_mask()
+    validity = a.valid_mask() & ~eq
+    return Column(a.data, a.sql_type, None if bool(validity.all()) else validity,
+                  a.dictionary)
+
+
+def _minmax_n(fn):
+    def op(*cols: Column) -> Column:
+        target = cols[0].sql_type
+        for c in cols[1:]:
+            target = promote(target, c.sql_type)
+        cs = [c.cast(target) for c in cols]
+        data = cs[0].data
+        for c in cs[1:]:
+            data = fn(data, c.data)
+        return Column(data, target, _and_validity(*cs))
+
+    return op
+
+
+# ---------------------------------------------------------------------------
+# strings
+# ---------------------------------------------------------------------------
+def _require_dict(c: Column) -> Column:
+    if c.sql_type in STRING_TYPES:
+        return c
+    return c.cast(SqlType.VARCHAR)
+
+
+def _op_concat(*cols: Column) -> Column:
+    cols = [_require_dict(c) for c in cols]
+    return str_ops.concat_columns_str(cols)
+
+
+def _op_substring(a: Column, start: Column, length: Optional[Column] = None) -> Column:
+    a = _require_dict(a)
+    if _is_const(start) and (length is None or _is_const(length)):
+        s = int(np.asarray(start.data)[0])
+        ln = int(np.asarray(length.data)[0]) if length is not None else None
+
+        def fn(x: str) -> str:
+            begin = max(s - 1, 0) if s > 0 else max(len(x) + s, 0) if s < 0 else 0
+            if ln is None:
+                return x[begin:]
+            return x[begin : begin + max(ln, 0)] if ln >= 0 else ""
+
+        return str_ops.map_unary(a, fn)
+    # column offsets: host row-wise fallback
+    vals = a.to_numpy()
+    ss = np.asarray(start.data)
+    ls = np.asarray(length.data) if length is not None else None
+    out = np.empty(len(vals), dtype=object)
+    for i, v in enumerate(vals):
+        if v is None:
+            out[i] = None
+            continue
+        s = int(ss[i] if ss.ndim else ss)
+        begin = max(s - 1, 0) if s > 0 else max(len(v) + s, 0) if s < 0 else 0
+        if ls is None:
+            out[i] = v[begin:]
+        else:
+            ln = int(ls[i] if ls.ndim else ls)
+            out[i] = v[begin : begin + max(ln, 0)] if ln >= 0 else ""
+    return Column.from_numpy(out)
+
+
+def _is_const(c: Column) -> bool:
+    return hasattr(c, "_lit_value") or len(c) == 1
+
+
+def _trim_op(where: str):
+    def op(a: Column, chars: Optional[Column] = None) -> Column:
+        a = _require_dict(a)
+        ch = None
+        if chars is not None:
+            ch = str(np.asarray(chars.to_numpy())[0])
+        if where == "both":
+            return str_ops.map_unary(a, lambda x: x.strip(ch))
+        if where == "left":
+            return str_ops.map_unary(a, lambda x: x.lstrip(ch))
+        return str_ops.map_unary(a, lambda x: x.rstrip(ch))
+
+    return op
+
+
+def _op_like(a: Column, pattern: Column, escape: Optional[Column] = None,
+             case_insensitive: bool = False, similar: bool = False) -> Column:
+    a = _require_dict(a)
+    pat = str(pattern.to_numpy()[0])
+    esc = str(escape.to_numpy()[0]) if escape is not None else None
+    rx_text = str_ops.similar_to_regex(pat, esc) if similar else str_ops.like_to_regex(pat, esc)
+    rx = re.compile(rx_text, re.IGNORECASE if case_insensitive else 0)
+    return str_ops.map_predicate(a, lambda x: rx.match(x) is not None)
+
+
+def _op_position(needle: Column, hay: Column) -> Column:
+    hay = _require_dict(hay)
+    if _is_const(needle):
+        nd = str(needle.to_numpy()[0])
+        return str_ops.map_unary_value(hay, lambda x: x.find(nd) + 1, np.int32)
+    out = str_ops.binary_string_op(_require_dict(needle), hay,
+                                   lambda n, h: str(h.find(n) + 1))
+    return out.cast(SqlType.INTEGER)
+
+
+def _op_overlay(a: Column, repl: Column, start: Column, length: Optional[Column] = None) -> Column:
+    a = _require_dict(a)
+    r = str(repl.to_numpy()[0])
+    s = int(np.asarray(start.data)[0])
+    ln = int(np.asarray(length.data)[0]) if length is not None else len(r)
+
+    def fn(x: str) -> str:
+        begin = s - 1
+        return x[:begin] + r + x[begin + ln :]
+
+    return str_ops.map_unary(a, fn)
+
+
+def _op_split_part(a: Column, delim: Column, n: Column) -> Column:
+    a = _require_dict(a)
+    d = str(delim.to_numpy()[0])
+    k = int(np.asarray(n.data)[0])
+
+    def fn(x: str) -> str:
+        parts = x.split(d)
+        return parts[k - 1] if 1 <= k <= len(parts) else ""
+
+    return str_ops.map_unary(a, fn)
+
+
+# ---------------------------------------------------------------------------
+# datetime
+# ---------------------------------------------------------------------------
+def _extract_op(unit: str):
+    def op(a: Column) -> Column:
+        return Column(dt_ops.extract(unit, a.data), SqlType.BIGINT, a.validity)
+
+    return op
+
+
+def _op_datetime_floor(a: Column, unit: Column) -> Column:
+    u = str(unit.to_numpy()[0])
+    return Column(dt_ops.truncate(u, a.data), a.sql_type, a.validity)
+
+
+def _op_datetime_ceil(a: Column, unit: Column) -> Column:
+    u = str(unit.to_numpy()[0])
+    return Column(dt_ops.ceil_to(u, a.data), a.sql_type, a.validity)
+
+
+def _op_date_trunc(unit: Column, a: Column) -> Column:
+    u = str(unit.to_numpy()[0])
+    return Column(dt_ops.truncate(u, a.data), a.sql_type, a.validity)
+
+
+def _op_timestampadd(unit: Column, n: Column, ts: Column) -> Column:
+    u = str(unit.to_numpy()[0])
+    return Column(dt_ops.timestampadd(u, n.data, ts.data), SqlType.TIMESTAMP,
+                  _and_validity(n, ts))
+
+
+def _op_timestampdiff(unit: Column, a: Column, b: Column) -> Column:
+    u = str(unit.to_numpy()[0])
+    return Column(dt_ops.timestampdiff(u, a.data, b.data), SqlType.BIGINT,
+                  _and_validity(a, b))
+
+
+def _op_last_day(a: Column) -> Column:
+    return Column(dt_ops.last_day(a.data), a.sql_type, a.validity)
+
+
+def _op_datetime_add(ts: Column, iv: Column) -> Column:
+    if iv.sql_type == SqlType.INTERVAL_YEAR_MONTH:
+        return Column(dt_ops.add_months(ts.data, iv.data), ts.sql_type, _and_validity(ts, iv))
+    return Column(ts.data + iv.data, ts.sql_type, _and_validity(ts, iv))
+
+
+def _op_datetime_sub_interval(ts: Column, iv: Column) -> Column:
+    if iv.sql_type == SqlType.INTERVAL_YEAR_MONTH:
+        return Column(dt_ops.add_months(ts.data, -iv.data), ts.sql_type, _and_validity(ts, iv))
+    return Column(ts.data - iv.data, ts.sql_type, _and_validity(ts, iv))
+
+
+def _op_datetime_sub(a: Column, b: Column) -> Column:
+    return Column(a.data - b.data, SqlType.INTERVAL_DAY_TIME, _and_validity(a, b))
+
+
+def _op_int_to_interval_days(a: Column) -> Column:
+    return Column(a.data.astype(jnp.int64) * dt_ops.NS_PER_DAY,
+                  SqlType.INTERVAL_DAY_TIME, a.validity)
+
+
+def _op_to_timestamp(a: Column, fmt: Optional[Column] = None) -> Column:
+    if a.sql_type in STRING_TYPES:
+        f = str(fmt.to_numpy()[0]) if fmt is not None else None
+        import datetime as _dt
+
+        def parse(x: str):
+            if f is not None:
+                try:
+                    return int(np.datetime64(_dt.datetime.strptime(x, f), "ns").astype(np.int64))
+                except ValueError:
+                    return np.iinfo(np.int64).min
+            try:
+                return int(np.datetime64(x.strip(), "ns").astype(np.int64))
+            except ValueError:
+                return np.iinfo(np.int64).min
+
+        col = str_ops.map_unary_value(a, parse, np.int64)
+        bad = col.data == np.iinfo(np.int64).min
+        validity = col.validity
+        if bool(bad.any()):
+            validity = ~bad if validity is None else (validity & ~bad)
+        return Column(col.data, SqlType.TIMESTAMP, validity)
+    if a.sql_type in INTEGER_TYPES:
+        # seconds since epoch
+        return Column(a.data.astype(jnp.int64) * dt_ops.NS_PER_SECOND,
+                      SqlType.TIMESTAMP, a.validity)
+    return a.cast(SqlType.TIMESTAMP)
+
+
+def _op_current_timestamp(*, length: int = 1) -> Column:
+    import time
+
+    now_ns = int(time.time() * 1e9)
+    return Column(jnp.full(length, now_ns, dtype=jnp.int64), SqlType.TIMESTAMP)
+
+
+def _op_current_date(*, length: int = 1) -> Column:
+    import time
+
+    now_ns = int(time.time() * 1e9)
+    day_ns = (now_ns // dt_ops.NS_PER_DAY) * dt_ops.NS_PER_DAY
+    return Column(jnp.full(length, day_ns, dtype=jnp.int64), SqlType.DATE)
+
+
+# ---------------------------------------------------------------------------
+# misc
+# ---------------------------------------------------------------------------
+def _op_md5(a: Column) -> Column:
+    import hashlib
+
+    a = _require_dict(a)
+    return str_ops.map_unary(a, lambda x: hashlib.md5(x.encode()).hexdigest())
+
+
+def _op_hash64(*cols: Column) -> Column:
+    from ...ops.grouping import factorize, key_arrays
+
+    gid, _, _ = factorize(key_arrays(list(cols)))
+    return Column(gid.astype(jnp.int64), SqlType.BIGINT)
+
+
+OPERATION_MAPPING: Dict[str, Callable] = {
+    # arithmetic
+    "add": _arith(jnp.add),
+    "sub": _arith(jnp.subtract),
+    "mul": _arith(jnp.multiply),
+    "div": _op_div,
+    "mod": _op_mod,
+    "neg": _op_neg,
+    # comparison
+    "eq": _compare(jnp.equal),
+    "ne": _compare(jnp.not_equal),
+    "lt": _compare(jnp.less),
+    "le": _compare(jnp.less_equal),
+    "gt": _compare(jnp.greater),
+    "ge": _compare(jnp.greater_equal),
+    "is_distinct_from": _op_is_distinct_from,
+    "is_not_distinct_from": _op_is_not_distinct_from,
+    # boolean
+    "and": _op_and,
+    "or": _op_or,
+    "not": _op_not,
+    "is_null": _op_is_null,
+    "is_not_null": _op_is_not_null,
+    "is_true": _op_is_true,
+    "is_false": _op_is_false,
+    "is_not_true": _op_is_not_true,
+    "is_not_false": _op_is_not_false,
+    # math
+    "abs": _op_abs,
+    "acos": _mathf(jnp.arccos),
+    "asin": _mathf(jnp.arcsin),
+    "atan": _mathf(jnp.arctan),
+    "atan2": lambda a, b: Column(jnp.arctan2(a.data.astype(jnp.float64),
+                                             b.data.astype(jnp.float64)),
+                                 SqlType.DOUBLE, _and_validity(a, b)),
+    "cbrt": _mathf(jnp.cbrt),
+    "ceil": _op_ceil,
+    "floor": _op_floor,
+    "cos": _mathf(jnp.cos),
+    "cot": _mathf(lambda x: 1.0 / jnp.tan(x)),
+    "degrees": _mathf(jnp.degrees),
+    "exp": _mathf(jnp.exp),
+    "ln": _mathf(jnp.log),
+    "log": _op_log,
+    "log10": _mathf(jnp.log10),
+    "log2": _mathf(jnp.log2),
+    "power": lambda a, b: Column(jnp.power(a.data.astype(jnp.float64),
+                                           b.data.astype(jnp.float64)),
+                                 SqlType.DOUBLE, _and_validity(a, b)),
+    "radians": _mathf(jnp.radians),
+    "round": _op_round,
+    "sign": _op_sign,
+    "sin": _mathf(jnp.sin),
+    "sqrt": _mathf(jnp.sqrt),
+    "tan": _mathf(jnp.tan),
+    "truncate": _op_truncate,
+    "rand": _op_rand,
+    "rand_integer": _op_rand_integer,
+    "pi": lambda *, length=1: Column(jnp.full(length, math.pi, dtype=jnp.float64), SqlType.DOUBLE),
+    # conditional
+    "coalesce": _op_coalesce,
+    "nullif": _op_nullif,
+    "greatest": _minmax_n(jnp.maximum),
+    "least": _minmax_n(jnp.minimum),
+    # strings
+    "char_length": lambda a: str_ops.map_unary_value(_require_dict(a), len, np.int64),
+    "upper": lambda a: str_ops.map_unary(_require_dict(a), str.upper),
+    "lower": lambda a: str_ops.map_unary(_require_dict(a), str.lower),
+    "initcap": lambda a: str_ops.map_unary(_require_dict(a),
+                                           lambda x: re.sub(r"[a-zA-Z]+", lambda m: m.group(0).capitalize(), x)),
+    "reverse": lambda a: str_ops.map_unary(_require_dict(a), lambda x: x[::-1]),
+    "concat": _op_concat,
+    "substring": _op_substring,
+    "btrim": _trim_op("both"),
+    "ltrim": _trim_op("left"),
+    "rtrim": _trim_op("right"),
+    "like": lambda a, p, e=None: _op_like(a, p, e, False, False),
+    "ilike": lambda a, p, e=None: _op_like(a, p, e, True, False),
+    "similar": lambda a, p, e=None: _op_like(a, p, e, False, True),
+    "position": _op_position,
+    "overlay": _op_overlay,
+    "replace": lambda a, f, t: str_ops.map_unary(
+        _require_dict(a), lambda x: x.replace(str(f.to_numpy()[0]), str(t.to_numpy()[0]))),
+    "left": lambda a, n: str_ops.map_unary(
+        _require_dict(a), lambda x, k=int(np.asarray(n.data)[0]): x[:k] if k >= 0 else x[: max(len(x) + k, 0)]),
+    "right": lambda a, n: str_ops.map_unary(
+        _require_dict(a), lambda x, k=int(np.asarray(n.data)[0]): (x[-k:] if k > 0 else x[min(-k, len(x)):]) if k != 0 else ""),
+    "repeat_str": lambda a, n: str_ops.map_unary(
+        _require_dict(a), lambda x, k=int(np.asarray(n.data)[0]): x * max(k, 0)),
+    "lpad": lambda a, n, p=None: str_ops.map_unary(
+        _require_dict(a),
+        lambda x, k=int(np.asarray(n.data)[0]), c=(str(p.to_numpy()[0]) if p is not None else " "):
+            (c * k + x)[-k:] if len(x) < k else x[:k]),
+    "rpad": lambda a, n, p=None: str_ops.map_unary(
+        _require_dict(a),
+        lambda x, k=int(np.asarray(n.data)[0]), c=(str(p.to_numpy()[0]) if p is not None else " "):
+            (x + c * k)[:k]),
+    "ascii": lambda a: str_ops.map_unary_value(_require_dict(a),
+                                               lambda x: ord(x[0]) if x else 0, np.int32),
+    "chr": lambda a: _chr_op(a),
+    "split_part": _op_split_part,
+    "md5": _op_md5,
+    "hash64": _op_hash64,
+    # datetime
+    "datetime_add": _op_datetime_add,
+    "datetime_sub_interval": _op_datetime_sub_interval,
+    "datetime_sub": _op_datetime_sub,
+    "int_to_interval_days": _op_int_to_interval_days,
+    "datetime_floor": _op_datetime_floor,
+    "datetime_ceil": _op_datetime_ceil,
+    "date_trunc": _op_date_trunc,
+    "timestampadd": _op_timestampadd,
+    "timestampdiff": _op_timestampdiff,
+    "last_day": _op_last_day,
+    "to_timestamp": _op_to_timestamp,
+    "current_timestamp": _op_current_timestamp,
+    "current_date": _op_current_date,
+}
+
+for _unit in ("year", "month", "day", "hour", "minute", "second", "quarter", "week",
+              "dow", "isodow", "doy", "epoch", "century", "decade", "millennium",
+              "millisecond", "microsecond", "nanosecond", "isoyear"):
+    OPERATION_MAPPING[f"extract_{_unit}"] = _extract_op(_unit)
+
+
+def _chr_op(a: Column) -> Column:
+    vals = np.asarray(a.data)
+    uniq, codes = np.unique(vals, return_inverse=True)
+    d = np.array([chr(int(v)) if 0 < v < 0x110000 else "" for v in uniq], dtype=object)
+    return Column(jnp.asarray(codes.astype(np.int32)), SqlType.VARCHAR, a.validity, d)

@@ -1,0 +1,11 @@
+"""Logical plan optimizer driver.
+
+Role parity: reference src/sql/optimizer.rs (19-rule DataFusion pipeline,
+optimizer.rs:53-98) + preoptimizer.rs.  Rules live in `rules.py`; JoinReorder
+in `join_reorder.py`; DynamicPartitionPruning in `dpp.py`.
+"""
+from __future__ import annotations
+
+from .driver import optimize_plan
+
+__all__ = ["optimize_plan"]

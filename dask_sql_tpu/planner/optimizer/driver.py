@@ -1,0 +1,49 @@
+"""Optimizer rule driver (parity: reference optimizer.rs rule list + observe
+tracing, optimizer.rs:132-138)."""
+from __future__ import annotations
+
+import logging
+
+logger = logging.getLogger(__name__)
+
+_RULES = None
+
+
+def _load_rules():
+    global _RULES
+    if _RULES is None:
+        from . import rules
+
+        # Order matters (parity: optimizer.rs:53-98)
+        _RULES = [
+            rules.SimplifyExpressions(),
+            rules.DecorrelateSubqueries(),
+            rules.EliminateCrossJoin(),
+            rules.EliminateLimit(),
+            rules.FilterNullJoinKeys(),
+            rules.PushDownLimit(),
+            rules.PushDownFilter(),
+            rules.SimplifyExpressions(),
+            rules.PushDownProjection(),
+            rules.PushDownLimit(),
+        ]
+    return _RULES
+
+
+def optimize_plan(plan, config, catalog):
+    rules = _load_rules()
+    verbose = bool(config.get("sql.optimizer.verbose", False))
+    for rule in rules:
+        new_plan = rule.apply(plan, config, catalog)
+        if new_plan is not None:
+            if verbose and new_plan is not plan:
+                logger.info("After %s:\n%s", type(rule).__name__, new_plan.explain())
+            plan = new_plan
+    from . import join_reorder
+
+    plan = join_reorder.maybe_reorder(plan, config, catalog)
+    if config.get("sql.dynamic_partition_pruning", True):
+        from . import dpp
+
+        plan = dpp.apply(plan, config, catalog)
+    return plan
