@@ -26,15 +26,27 @@ def key_arrays(cols: Sequence[Column]) -> List[jnp.ndarray]:
     for c in cols:
         if c.sql_type in STRING_TYPES:
             c = c.compact_dictionary()
-            out.append(c.data)
+            data = c.data
         elif c.data.dtype == jnp.bool_:
-            out.append(c.data.astype(jnp.int32))
+            data = c.data.astype(jnp.int32)
         else:
-            out.append(c.data)
+            data = c.data
+        valid = None
         if c.validity is not None:
-            # validity participates: NULL forms its own group (dropna=False
-            # semantics, reference aggregate.py:575-577)
-            out.append(c.valid_mask().astype(jnp.int32))
+            valid = c.valid_mask()
+        if jnp.issubdtype(data.dtype, jnp.floating):
+            nan = jnp.isnan(data)
+            if bool(nan.any()):
+                valid = ~nan if valid is None else (valid & ~nan)
+        if valid is not None:
+            # NULL forms its own single group (dropna=False semantics,
+            # reference aggregate.py:575-577): zero the payload under NULL and
+            # key on validity so all NULLs collide
+            data = jnp.where(valid, data, jnp.zeros_like(data))
+            out.append(data)
+            out.append(valid.astype(jnp.int32))
+        else:
+            out.append(data)
     return out
 
 

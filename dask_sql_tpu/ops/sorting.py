@@ -36,10 +36,11 @@ def sort_permutation(cols: Sequence[Column], ascendings: Sequence[bool],
             data = -data
         valid = col.valid_mask() if col.validity is not None else None
         if valid is not None:
-            # nulls-first => invalid key 0 sorts before valid 1
+            # null indicator outranks the value within this sort key;
+            # nulls-first => invalid rows get 0 which sorts before valid 1
             nullkey = jnp.where(valid, 1, 0) if nf else jnp.where(valid, 0, 1)
-            keys.append(data)
             keys.append(nullkey)
+            keys.append(data)
         else:
             keys.append(data)
     # lexsort: last key is primary

@@ -1,0 +1,84 @@
+"""Filter tests (parity: reference test_filter.py incl. pushdown checks)."""
+import numpy as np
+import pandas as pd
+import pytest
+
+from tests.utils import assert_eq
+
+
+def test_filter(c, df):
+    result = c.sql("SELECT * FROM df WHERE a < 2").compute()
+    expected = df[df["a"] < 2]
+    assert_eq(result, expected, check_dtype=False)
+
+def test_filter_scalar(c, df):
+    result = c.sql("SELECT * FROM df WHERE True").compute()
+    assert_eq(result, df, check_dtype=False)
+    result = c.sql("SELECT * FROM df WHERE False").compute()
+    assert len(result) == 0
+    result = c.sql("SELECT * FROM df WHERE (1 = 1)").compute()
+    assert_eq(result, df, check_dtype=False)
+
+def test_filter_complicated(c, df):
+    result = c.sql("SELECT * FROM df WHERE a < 3 AND (b > 1 AND b < 3)").compute()
+    expected = df[(df["a"] < 3) & ((df["b"] > 1) & (df["b"] < 3))]
+    assert_eq(result, expected, check_dtype=False)
+
+def test_filter_with_nan(c, user_table_nan):
+    result = c.sql("SELECT * FROM user_table_nan WHERE c = 3").compute()
+    assert list(result["c"]) == [3.0]
+
+def test_filter_null_is_false(c):
+    df = pd.DataFrame({"a": [1.0, None, 3.0]})
+    c.create_table("fnull", df)
+    result = c.sql("SELECT * FROM fnull WHERE a > 0").compute()
+    assert len(result) == 2  # NULL comparison filters out
+
+def test_filter_between(c, df):
+    result = c.sql("SELECT * FROM df WHERE b BETWEEN 2 AND 4").compute()
+    expected = df[(df.b >= 2) & (df.b <= 4)]
+    assert_eq(result, expected, check_dtype=False)
+
+def test_filter_in(c, user_table_1):
+    result = c.sql("SELECT * FROM user_table_1 WHERE user_id IN (1, 3)").compute()
+    expected = user_table_1[user_table_1.user_id.isin([1, 3])]
+    assert_eq(result, expected, check_dtype=False, sort_results=True)
+
+def test_filter_not_in(c, user_table_1):
+    result = c.sql("SELECT * FROM user_table_1 WHERE user_id NOT IN (1, 3)").compute()
+    expected = user_table_1[~user_table_1.user_id.isin([1, 3])]
+    assert_eq(result, expected, check_dtype=False, sort_results=True)
+
+def test_filter_string_like(c, string_table):
+    result = c.sql("SELECT * FROM string_table WHERE a LIKE '%normal%'").compute()
+    assert list(result["a"]) == ["a normal string"]
+    result = c.sql("SELECT * FROM string_table WHERE a LIKE '^|()-*[]$'").compute()
+    assert list(result["a"]) == ["^|()-*[]$"]
+    result = c.sql("SELECT * FROM string_table WHERE a LIKE '%\\%^%' ESCAPE '\\'").compute()
+    assert list(result["a"]) == []
+    result = c.sql("SELECT * FROM string_table WHERE a LIKE '_\\_\\%' ESCAPE '\\'").compute()
+    assert list(result["a"]) == ["%_%"]
+
+def test_filter_is_null(c):
+    df = pd.DataFrame({"a": [1.0, None, 3.0]})
+    c.create_table("isn", df)
+    assert len(c.sql("SELECT * FROM isn WHERE a IS NULL").compute()) == 1
+    assert len(c.sql("SELECT * FROM isn WHERE a IS NOT NULL").compute()) == 2
+
+def test_filter_or(c, user_table_1):
+    result = c.sql("SELECT * FROM user_table_1 WHERE user_id = 1 OR b = 1").compute()
+    expected = user_table_1[(user_table_1.user_id == 1) | (user_table_1.b == 1)]
+    assert_eq(result, expected, check_dtype=False, sort_results=True)
+
+def test_filter_datetime(c, datetime_table):
+    result = c.sql(
+        "SELECT * FROM datetime_table WHERE no_timezone > '2014-08-01 23:00'"
+    ).compute()
+    expected = datetime_table[datetime_table.no_timezone > "2014-08-01 23:00"]
+    assert len(result) == len(expected)
+
+def test_filter_pushdown_into_scan(c, df):
+    # the optimized plan should carry the predicate inside the TableScan
+    plan_text = c.explain("SELECT a FROM df WHERE a < 2")
+    assert "TableScan" in plan_text
+    assert "filters=" in plan_text

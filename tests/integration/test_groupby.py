@@ -1,0 +1,122 @@
+"""Groupby/aggregation tests (parity: reference test_groupby.py)."""
+import numpy as np
+import pandas as pd
+import pytest
+
+from tests.utils import assert_eq
+
+
+def test_group_by(c, df):
+    result = c.sql("SELECT a, SUM(b) AS s FROM df GROUP BY a").compute()
+    expected = df.groupby("a").b.sum().reset_index().rename(columns={"b": "s"})
+    assert_eq(result, expected, check_dtype=False, sort_results=True)
+
+def test_group_by_all_aggs(c, df):
+    result = c.sql(
+        """SELECT a, SUM(b) AS "sum", AVG(b) AS "avg", MIN(b) AS "min",
+                  MAX(b) AS "max", COUNT(b) AS "count",
+                  STDDEV(b) AS "std", VAR_SAMP(b) AS "var"
+           FROM df GROUP BY a"""
+    ).compute()
+    g = df.groupby("a").b
+    expected = pd.DataFrame({
+        "a": sorted(df.a.unique()),
+        "sum": g.sum().values, "avg": g.mean().values, "min": g.min().values,
+        "max": g.max().values, "count": g.count().values,
+        "std": g.std().values, "var": g.var().values,
+    })
+    assert_eq(result, expected, check_dtype=False, sort_results=True)
+
+def test_group_by_filtered(c, user_table_1):
+    result = c.sql(
+        """SELECT user_id, SUM(b) FILTER (WHERE b = 3) AS "s1", SUM(b) AS "s2"
+           FROM user_table_1 GROUP BY user_id"""
+    ).compute()
+    expected = pd.DataFrame({
+        "user_id": [1, 2, 3],
+        "s1": [3.0, 3.0, 3.0],
+        "s2": [3, 4, 3],
+    })
+    assert_eq(result, expected, check_dtype=False, sort_results=True)
+
+def test_global_aggregation(c, df):
+    result = c.sql("SELECT SUM(a) AS s, COUNT(*) AS c, AVG(b) AS m FROM df").compute()
+    assert result["s"][0] == df.a.sum()
+    assert result["c"][0] == len(df)
+    assert abs(result["m"][0] - df.b.mean()) < 1e-9
+
+def test_count_distinct(c, user_table_1):
+    result = c.sql("SELECT COUNT(DISTINCT b) AS cd FROM user_table_1").compute()
+    assert result["cd"][0] == 2
+
+def test_group_by_nulls(c):
+    df = pd.DataFrame({"a": [1, 1, None, None, 2], "b": [1, 2, 3, 4, 5]})
+    c.create_table("nulls_df", df)
+    result = c.sql("SELECT a, SUM(b) AS s FROM nulls_df GROUP BY a").compute()
+    # NULL forms its own group (dropna=False semantics)
+    assert len(result) == 3
+    null_row = result[pd.isna(result["a"])]
+    assert null_row["s"].iloc[0] == 7
+
+def test_sum_of_nulls_is_null(c):
+    df = pd.DataFrame({"g": [1, 1, 2], "v": [None, None, 3.0]})
+    c.create_table("sumnull", df)
+    result = c.sql("SELECT g, SUM(v) AS s FROM sumnull GROUP BY g").compute()
+    result = result.sort_values("g").reset_index(drop=True)
+    assert pd.isna(result["s"][0])
+    assert result["s"][1] == 3.0
+
+def test_having(c, df):
+    result = c.sql(
+        "SELECT a, COUNT(*) AS c FROM df GROUP BY a HAVING COUNT(*) > 150"
+    ).compute()
+    expected = df.groupby("a").size().reset_index(name="c")
+    expected = expected[expected["c"] > 150]
+    assert_eq(result, expected, check_dtype=False, sort_results=True)
+
+def test_group_by_case(c, df):
+    result = c.sql(
+        "SELECT CASE WHEN a = 1 THEN 'one' ELSE 'other' END AS k, COUNT(*) AS c FROM df GROUP BY CASE WHEN a = 1 THEN 'one' ELSE 'other' END"
+    ).compute()
+    assert set(result["k"]) == {"one", "other"}
+
+def test_aggregation_on_expression(c, df):
+    result = c.sql("SELECT a + 1 AS k, SUM(b * 2) AS s FROM df GROUP BY a + 1").compute()
+    expected = df.assign(k=df.a + 1, s=df.b * 2).groupby("k").s.sum().reset_index()
+    assert_eq(result, expected, check_dtype=False, sort_results=True)
+
+def test_min_max_string(c, user_table_1):
+    df = pd.DataFrame({"g": [1, 1, 2], "s": ["b", "a", "c"]})
+    c.create_table("strs", df)
+    result = c.sql("SELECT g, MIN(s) AS lo, MAX(s) AS hi FROM strs GROUP BY g").compute()
+    result = result.sort_values("g").reset_index(drop=True)
+    assert list(result["lo"]) == ["a", "c"]
+    assert list(result["hi"]) == ["b", "c"]
+
+def test_bool_aggs(c):
+    df = pd.DataFrame({"g": [1, 1, 2, 2], "b": [True, False, True, True]})
+    c.create_table("bools", df)
+    result = c.sql(
+        "SELECT g, EVERY(b) AS e, BOOL_OR(b) AS o FROM bools GROUP BY g"
+    ).compute().sort_values("g").reset_index(drop=True)
+    assert list(result["e"]) == [False, True]
+    assert list(result["o"]) == [True, True]
+
+def test_stddev_matches_pandas(c, df):
+    result = c.sql(
+        "SELECT STDDEV_POP(b) AS sp, STDDEV_SAMP(b) AS ss FROM df"
+    ).compute()
+    assert abs(result["sp"][0] - df.b.std(ddof=0)) < 1e-9
+    assert abs(result["ss"][0] - df.b.std(ddof=1)) < 1e-9
+
+def test_group_by_distinct_agg(c, user_table_1):
+    result = c.sql(
+        "SELECT user_id, COUNT(DISTINCT b) AS cd, SUM(DISTINCT b) AS sd FROM user_table_1 GROUP BY user_id"
+    ).compute().sort_values("user_id").reset_index(drop=True)
+    expected = user_table_1.groupby("user_id").b.agg([
+        ("cd", "nunique"), ("sd", lambda x: x.drop_duplicates().sum())]).reset_index()
+    assert_eq(result, expected, check_dtype=False, check_names=False)
+
+def test_distinct_plain(c, df):
+    result = c.sql("SELECT DISTINCT a FROM df").compute()
+    assert sorted(result["a"]) == [1.0, 2.0, 3.0]

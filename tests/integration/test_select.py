@@ -1,0 +1,112 @@
+"""SELECT / projection / expression tests (parity: reference test_select.py)."""
+import numpy as np
+import pandas as pd
+import pytest
+
+from tests.utils import assert_eq
+
+
+def test_select_all(c, df):
+    result = c.sql("SELECT * FROM df")
+    assert_eq(result.compute(), df, check_dtype=False)
+
+def test_select_column(c, df):
+    result = c.sql("SELECT a FROM df")
+    assert_eq(result.compute(), df[["a"]], check_dtype=False)
+
+def test_select_different_types(c):
+    expected = pd.DataFrame(
+        {
+            "date": pd.to_datetime(
+                ["2022-01-21 17:34", "2022-01-21", "2021-11-07", "NaT"], format="mixed"),
+            "string": ["this is a test", "another test", "äölüć", ""],
+            "integer": [1, 2, -4, 5],
+            "float": [-1.1, np.nan, np.pi, np.e],
+        }
+    )
+    c.create_table("df2", expected)
+    result = c.sql("SELECT * FROM df2")
+    assert_eq(result.compute(), expected, check_dtype=False)
+
+def test_select_expr(c, df):
+    result = c.sql("SELECT a + 1 AS a, b AS bla, a - 1 FROM df").compute()
+    expected = pd.DataFrame({"a": df["a"] + 1, "bla": df["b"], '"df"."a" - 1': df["a"] - 1})
+    assert_eq(result, expected, check_dtype=False, check_names=False)
+
+def test_select_of_select(c, df):
+    result = c.sql(
+        """
+        SELECT 2*c AS e, d - 1 AS f
+        FROM (SELECT a - 1 AS c, 2*b AS d FROM df) AS "inner"
+        """
+    ).compute()
+    expected = pd.DataFrame({"e": 2 * (df["a"] - 1), "f": 2 * df["b"] - 1})
+    assert_eq(result, expected, check_dtype=False)
+
+def test_select_case(c, df):
+    result = c.sql(
+        """
+        SELECT CASE WHEN a = 1 THEN 'one' WHEN a = 2 THEN 'two' ELSE 'many' END AS "s"
+        FROM df
+        """
+    ).compute()
+    expected = pd.DataFrame({"s": df["a"].map({1.0: "one", 2.0: "two", 3.0: "many"})})
+    assert_eq(result, expected, check_dtype=False)
+
+def test_select_null_and_constants(c):
+    result = c.sql("SELECT 1 AS a, 1.5 AS b, 'hello' AS c, TRUE AS d, NULL AS e").compute()
+    assert result["a"][0] == 1
+    assert result["b"][0] == 1.5
+    assert result["c"][0] == "hello"
+    assert bool(result["d"][0]) is True
+    assert pd.isna(result["e"][0])
+
+def test_select_boolean_expressions(c, df):
+    result = c.sql("SELECT a > 2 AS x, NOT (b < 5) AS y, a = 1 OR b > 9 AS z FROM df").compute()
+    expected = pd.DataFrame({
+        "x": df["a"] > 2, "y": ~(df["b"] < 5), "z": (df["a"] == 1) | (df["b"] > 9)})
+    assert_eq(result, expected, check_dtype=False)
+
+def test_union(c, user_table_1, user_table_2):
+    result = c.sql(
+        "SELECT user_id FROM user_table_1 UNION ALL SELECT user_id FROM user_table_2"
+    ).compute()
+    expected = pd.DataFrame({"user_id": list(user_table_1.user_id) + list(user_table_2.user_id)})
+    assert_eq(result, expected, check_dtype=False, sort_results=True)
+
+def test_union_distinct(c, user_table_1, user_table_2):
+    result = c.sql(
+        "SELECT user_id FROM user_table_1 UNION SELECT user_id FROM user_table_2"
+    ).compute()
+    expected = pd.DataFrame({"user_id": sorted(set(user_table_1.user_id) | set(user_table_2.user_id))})
+    assert_eq(result, expected, check_dtype=False, sort_results=True)
+
+def test_intersect_except(c):
+    result = c.sql("SELECT user_id FROM user_table_1 INTERSECT SELECT user_id FROM user_table_2").compute()
+    assert sorted(result["user_id"]) == [1, 2]
+    result = c.sql("SELECT user_id FROM user_table_1 EXCEPT SELECT user_id FROM user_table_2").compute()
+    assert sorted(result["user_id"]) == [3]
+
+def test_values(c):
+    result = c.sql("SELECT * FROM (VALUES (1, 'a'), (2, 'b')) AS t(x, y)").compute()
+    expected = pd.DataFrame({"x": [1, 2], "y": ["a", "b"]})
+    assert_eq(result, expected, check_dtype=False)
+
+def test_select_without_from(c):
+    result = c.sql("SELECT 1 + 1 AS two").compute()
+    assert result["two"][0] == 2
+
+def test_cte(c, df):
+    result = c.sql(
+        "WITH big AS (SELECT a, b FROM df WHERE b > 5) SELECT SUM(a) AS s FROM big"
+    ).compute()
+    expected = df[df.b > 5]["a"].sum()
+    assert result["s"][0] == expected
+
+def test_distinct(c, user_table_1):
+    result = c.sql("SELECT DISTINCT b FROM user_table_1").compute()
+    assert sorted(result["b"]) == [1, 3]
+
+def test_wildcard_qualified(c, user_table_1):
+    result = c.sql("SELECT u.* FROM user_table_1 u").compute()
+    assert_eq(result, user_table_1, check_dtype=False)

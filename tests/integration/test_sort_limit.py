@@ -1,0 +1,59 @@
+"""Sort/limit tests (parity: reference test_sort.py + limit parts)."""
+import numpy as np
+import pandas as pd
+import pytest
+
+from tests.utils import assert_eq
+
+
+def test_sort(c, user_table_1):
+    result = c.sql("SELECT * FROM user_table_1 ORDER BY b, user_id DESC").compute()
+    expected = user_table_1.sort_values(["b", "user_id"], ascending=[True, False]).reset_index(drop=True)
+    assert_eq(result, expected, check_dtype=False)
+
+def test_sort_desc(c, df):
+    result = c.sql("SELECT * FROM df ORDER BY b DESC").compute()
+    expected = df.sort_values("b", ascending=False).reset_index(drop=True)
+    assert_eq(result, expected, check_dtype=False)
+
+def test_sort_nulls(c):
+    data = pd.DataFrame({"a": [1.0, None, 3.0, None, 2.0]})
+    c.create_table("sn", data)
+    result = c.sql("SELECT * FROM sn ORDER BY a").compute()
+    assert list(result["a"].fillna(-1)) == [1.0, 2.0, 3.0, -1, -1]  # nulls last by default
+    result = c.sql("SELECT * FROM sn ORDER BY a NULLS FIRST").compute()
+    assert list(result["a"].fillna(-1)) == [-1, -1, 1.0, 2.0, 3.0]
+    result = c.sql("SELECT * FROM sn ORDER BY a DESC").compute()
+    assert list(result["a"].fillna(-1)) == [-1, -1, 3.0, 2.0, 1.0]  # desc: nulls first
+    result = c.sql("SELECT * FROM sn ORDER BY a DESC NULLS LAST").compute()
+    assert list(result["a"].fillna(-1)) == [3.0, 2.0, 1.0, -1, -1]
+
+def test_sort_strings(c, string_table):
+    result = c.sql("SELECT * FROM string_table ORDER BY a").compute()
+    expected = string_table.sort_values("a").reset_index(drop=True)
+    assert_eq(result, expected, check_dtype=False)
+
+def test_limit(c, long_table):
+    result = c.sql("SELECT * FROM long_table LIMIT 101").compute()
+    assert_eq(result, long_table.head(101), check_dtype=False)
+    result = c.sql("SELECT * FROM long_table LIMIT 101 OFFSET 99").compute()
+    assert_eq(result, long_table.iloc[99 : 99 + 101].reset_index(drop=True), check_dtype=False)
+
+def test_topk(c, df):
+    result = c.sql("SELECT * FROM df ORDER BY b LIMIT 10").compute()
+    expected = df.nsmallest(10, "b").reset_index(drop=True)
+    assert_eq(result, expected, check_dtype=False)
+    result = c.sql("SELECT * FROM df ORDER BY b DESC LIMIT 10").compute()
+    expected = df.nlargest(10, "b").reset_index(drop=True)
+    assert_eq(result, expected, check_dtype=False)
+
+def test_sort_by_alias(c, df):
+    result = c.sql("SELECT b AS my_column FROM df ORDER BY my_column LIMIT 5").compute()
+    expected = df.sort_values("b").head(5).reset_index(drop=True)[["b"]]
+    expected.columns = ["my_column"]
+    assert_eq(result, expected, check_dtype=False)
+
+def test_sort_with_limit_multi_key(c, user_table_1):
+    result = c.sql("SELECT * FROM user_table_1 ORDER BY b DESC, user_id LIMIT 2").compute()
+    expected = user_table_1.sort_values(["b", "user_id"], ascending=[False, True]).head(2).reset_index(drop=True)
+    assert_eq(result, expected, check_dtype=False)

@@ -1,0 +1,97 @@
+"""Result-equality helper (parity: reference tests/utils.py:15 assert_eq
+wrapping dask's frame comparison; convert_nullable_columns tests/utils.py:21)."""
+from __future__ import annotations
+
+import numpy as np
+import pandas as pd
+
+
+def _normalize(df):
+    if isinstance(df, pd.Series):
+        df = df.to_frame()
+    df = df.reset_index(drop=True)
+    out = {}
+    for i, col in enumerate(df.columns):
+        s = df[col] if df.columns.get_loc(col) == i or not df.columns.duplicated().any() else df.iloc[:, i]
+        s = df.iloc[:, i]
+        if str(s.dtype) in ("string", "str"):
+            s = s.astype(object)
+        if s.dtype == object:
+            s = s.where(pd.notna(s), None)
+        out[i] = s
+    return df, out
+
+
+def assert_eq(got, expected, check_dtype: bool = True, check_index: bool = False,
+              check_names: bool = True, sort_results: bool = False, **kwargs):
+    got = got.compute() if hasattr(got, "compute") else got
+    expected = expected.compute() if hasattr(expected, "compute") else expected
+    if isinstance(got, pd.Series):
+        got = got.to_frame()
+    if isinstance(expected, pd.Series):
+        expected = expected.to_frame()
+    assert list(map(str, got.columns)) == list(map(str, expected.columns)) or not check_names, \
+        f"columns differ: {list(got.columns)} vs {list(expected.columns)}"
+    assert len(got) == len(expected), f"row counts differ: {len(got)} vs {len(expected)}"
+    if sort_results and len(got.columns):
+        got = got.sort_values(by=list(got.columns), kind="stable").reset_index(drop=True)
+        expected = expected.sort_values(by=list(expected.columns), kind="stable").reset_index(drop=True)
+    got = got.reset_index(drop=True)
+    expected = expected.reset_index(drop=True)
+    for i in range(len(got.columns)):
+        g = got.iloc[:, i]
+        e = expected.iloc[:, i]
+        _assert_series_eq(g, e, check_dtype, str(got.columns[i]))
+
+
+def _assert_series_eq(g: pd.Series, e: pd.Series, check_dtype: bool, name: str):
+    gk = _kind(g)
+    ek = _kind(e)
+    if check_dtype:
+        assert gk == ek, f"column {name}: dtype kind {gk} != {ek} ({g.dtype} vs {e.dtype})"
+    gn = pd.isna(g).to_numpy()
+    en = pd.isna(e).to_numpy()
+    assert (gn == en).all(), f"column {name}: NULL positions differ"
+    gv = g[~gn]
+    ev = e[~en]
+    if gk == "f" or ek == "f":
+        np.testing.assert_allclose(gv.astype(float).to_numpy(), ev.astype(float).to_numpy(),
+                                   rtol=1e-9, atol=1e-12, err_msg=f"column {name}")
+    elif gk == "M":
+        got_ns = pd.to_datetime(gv).astype("datetime64[ns]").to_numpy()
+        exp_ns = pd.to_datetime(ev).astype("datetime64[ns]").to_numpy()
+        assert (got_ns == exp_ns).all(), f"column {name}: datetime values differ"
+    elif gk == "i" and ek == "f" or gk == "f" and ek == "i":
+        np.testing.assert_allclose(gv.astype(float).to_numpy(), ev.astype(float).to_numpy(),
+                                   err_msg=f"column {name}")
+    else:
+        assert list(gv.astype(str)) == list(ev.astype(str)), \
+            f"column {name}: values differ\n{list(gv)[:10]}\nvs\n{list(ev)[:10]}"
+
+
+def _kind(s: pd.Series) -> str:
+    dt = str(s.dtype).lower()
+    if "int" in dt:
+        return "i"
+    if "float" in dt or "decimal" in dt:
+        return "f"
+    if "bool" in dt:
+        return "b"
+    if "datetime" in dt:
+        return "M"
+    if "timedelta" in dt:
+        return "m"
+    return "O"
+
+
+def convert_nullable_columns(df: pd.DataFrame) -> pd.DataFrame:
+    """Normalize pandas nullable extension dtypes to plain numpy dtypes."""
+    out = df.copy()
+    for col in out.columns:
+        dt = str(out[col].dtype)
+        if dt in ("Int64", "Int32", "Float64", "boolean"):
+            if out[col].isna().any():
+                out[col] = out[col].astype("float64")
+            else:
+                out[col] = out[col].astype(dt.lower().replace("boolean", "bool"))
+    return out
