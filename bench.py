@@ -1,0 +1,118 @@
+"""Benchmark: TPC-H Q1 (SF~1 lineitem, synthetic) through the full SQL path.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+value = rows/sec/chip through c.sql() end-to-end (plan + device execution),
+vs_baseline = speedup over pandas executing the same query (the reference's
+single-partition execution engine).
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+N_ROWS = 6_000_000  # ~SF1 lineitem row count
+QUERY = """
+SELECT
+    l_returnflag,
+    l_linestatus,
+    SUM(l_quantity) AS sum_qty,
+    SUM(l_extendedprice) AS sum_base_price,
+    SUM(l_extendedprice * (1 - l_discount)) AS sum_disc_price,
+    SUM(l_extendedprice * (1 - l_discount) * (1 + l_tax)) AS sum_charge,
+    AVG(l_quantity) AS avg_qty,
+    AVG(l_extendedprice) AS avg_price,
+    AVG(l_discount) AS avg_disc,
+    COUNT(*) AS count_order
+FROM lineitem
+WHERE l_shipdate <= DATE '1998-09-02'
+GROUP BY l_returnflag, l_linestatus
+ORDER BY l_returnflag, l_linestatus
+"""
+
+
+def gen_lineitem(n: int, seed: int = 0):
+    import pandas as pd
+
+    rng = np.random.RandomState(seed)
+    start = np.datetime64("1992-01-01")
+    return pd.DataFrame(
+        {
+            "l_returnflag": rng.choice(["A", "N", "R"], n),
+            "l_linestatus": rng.choice(["F", "O"], n),
+            "l_quantity": rng.randint(1, 51, n).astype(np.float32),
+            "l_extendedprice": (rng.rand(n).astype(np.float32) * 100000.0),
+            "l_discount": (rng.rand(n).astype(np.float32) * 0.1),
+            "l_tax": (rng.rand(n).astype(np.float32) * 0.08),
+            "l_shipdate": start + rng.randint(0, 2526, n).astype("timedelta64[D]"),
+        }
+    )
+
+
+def run_pandas(df):
+    cutoff = np.datetime64("1998-09-02")
+    sel = df[df.l_shipdate <= cutoff]
+    disc_price = sel.l_extendedprice * (1 - sel.l_discount)
+    charge = disc_price * (1 + sel.l_tax)
+    work = sel.assign(disc_price=disc_price, charge=charge)
+    out = work.groupby(["l_returnflag", "l_linestatus"]).agg(
+        sum_qty=("l_quantity", "sum"),
+        sum_base_price=("l_extendedprice", "sum"),
+        sum_disc_price=("disc_price", "sum"),
+        sum_charge=("charge", "sum"),
+        avg_qty=("l_quantity", "mean"),
+        avg_price=("l_extendedprice", "mean"),
+        avg_disc=("l_discount", "mean"),
+        count_order=("l_quantity", "count"),
+    ).reset_index().sort_values(["l_returnflag", "l_linestatus"])
+    return out
+
+
+def main():
+    import jax
+
+    from dask_sql_tpu import Context
+
+    df = gen_lineitem(N_ROWS)
+
+    c = Context()
+    c.create_table("lineitem", df)
+
+    # warm-up (compile caches, device transfer)
+    frame = c.sql(QUERY)
+    _ = frame.compute()
+
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        res = c.sql(QUERY).compute()
+        times.append(time.perf_counter() - t0)
+    best = min(times)
+    throughput = N_ROWS / best
+
+    # pandas baseline (the reference's per-partition engine)
+    t0 = time.perf_counter()
+    expected = run_pandas(df)
+    pandas_time = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    expected = run_pandas(df)
+    pandas_time = min(pandas_time, time.perf_counter() - t0)
+
+    # correctness spot check
+    assert len(res) == len(expected), (len(res), len(expected))
+    np.testing.assert_allclose(
+        res["sum_qty"].to_numpy(dtype=np.float64),
+        expected["sum_qty"].to_numpy(dtype=np.float64), rtol=1e-2)
+
+    print(json.dumps({
+        "metric": "tpch_q1_sf1_rows_per_sec_per_chip",
+        "value": round(throughput, 1),
+        "unit": "rows/s",
+        "vs_baseline": round((N_ROWS / pandas_time) and throughput / (N_ROWS / pandas_time), 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

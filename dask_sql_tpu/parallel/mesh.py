@@ -1,0 +1,67 @@
+"""Device mesh management.
+
+Role parity: the reference's dask.distributed Client/cluster handle
+(SURVEY.md §2.4) — here a `jax.sharding.Mesh` over TPU chips, with row-block
+sharding of columnar tables.  Within a slice collectives ride ICI; across
+slices XLA routes them over DCN — the comm backend is XLA itself, no NCCL/MPI
+translation layer.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXIS = "shards"
+
+_default_mesh: Optional[Mesh] = None
+
+
+def make_mesh(n_devices: Optional[int] = None, devices=None) -> Mesh:
+    if devices is None:
+        devices = jax.devices()
+        if n_devices is not None:
+            devices = devices[:n_devices]
+    return Mesh(np.array(devices), (AXIS,))
+
+
+def default_mesh() -> Mesh:
+    global _default_mesh
+    if _default_mesh is None:
+        _default_mesh = make_mesh()
+    return _default_mesh
+
+
+def set_default_mesh(mesh: Mesh) -> None:
+    global _default_mesh
+    _default_mesh = mesh
+
+
+def row_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P(AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def pad_to_multiple(arr: jnp.ndarray, multiple: int, fill=0):
+    """Pad a 1-D array so its length divides the shard count; returns
+    (padded, valid_mask)."""
+    n = arr.shape[0]
+    target = ((n + multiple - 1) // multiple) * multiple
+    if target == n:
+        return arr, jnp.ones(n, dtype=bool)
+    pad = target - n
+    padded = jnp.concatenate([arr, jnp.full((pad,), fill, dtype=arr.dtype)])
+    valid = jnp.concatenate([jnp.ones(n, dtype=bool), jnp.zeros(pad, dtype=bool)])
+    return padded, valid
+
+
+def shard_rows(arr: jnp.ndarray, mesh: Optional[Mesh] = None):
+    """Place a row-padded array with row-block sharding over the mesh."""
+    mesh = mesh or default_mesh()
+    return jax.device_put(arr, row_sharding(mesh))

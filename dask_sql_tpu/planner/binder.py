@@ -979,10 +979,18 @@ def _bind_literal(e: a.Literal) -> Literal:
 def _cast_literal(lit: Literal, target: SqlType) -> Literal:
     v = lit.value
     if target in DATETIME_TYPES:
-        ns = np.datetime64(str(v).strip(), "ns").astype(np.int64)
+        if lit.sql_type in DATETIME_TYPES:
+            # already epoch nanoseconds
+            ns = int(v)
+        else:
+            ns = int(np.datetime64(str(v).strip(), "ns").astype(np.int64))
         if target == SqlType.DATE:
             ns = (ns // 86_400_000_000_000) * 86_400_000_000_000
         return Literal(int(ns), target)
+    if lit.sql_type in DATETIME_TYPES or lit.sql_type in INTERVAL_TYPES:
+        if target in INTEGER_TYPES:
+            return Literal(int(v), target)
+        return lit
     if target in INTEGER_TYPES:
         return Literal(int(v), target)
     if target in (SqlType.FLOAT, SqlType.DOUBLE, SqlType.DECIMAL, SqlType.REAL):

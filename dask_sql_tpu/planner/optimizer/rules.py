@@ -441,23 +441,17 @@ def _prune(plan, required: Set[int]) -> Tuple[p.LogicalPlan, Dict[int, int]]:
     ident = {i: i for i in range(len(plan.schema))}
 
     if isinstance(plan, p.TableScan):
-        keep = sorted(required)
+        # scan filters may reference pruned columns — those must stay readable
+        fcols = set()
+        for f in plan.filters:
+            fcols |= referenced_columns(f)
+        keep = sorted(set(required) | fcols)
         if len(keep) == len(plan.schema) and plan.projection is None:
             return plan, ident
         mapping = {old: new for new, old in enumerate(keep)}
         fields = [plan.schema[i] for i in keep]
         names = [f.name for f in fields]
-        filters = [remap_columns(f, mapping) for f in plan.filters] if plan.filters else []
-        # scan filters may reference pruned columns — retain those columns
-        fcols = set()
-        for f in plan.filters:
-            fcols |= referenced_columns(f)
-        if not fcols <= set(keep):
-            keep = sorted(set(keep) | fcols)
-            mapping = {old: new for new, old in enumerate(keep)}
-            fields = [plan.schema[i] for i in keep]
-            names = [f.name for f in fields]
-            filters = [remap_columns(f, mapping) for f in plan.filters]
+        filters = [remap_columns(f, mapping) for f in plan.filters]
         scan = p.TableScan(plan.schema_name, plan.table_name, fields, names, filters)
         return scan, mapping
 
