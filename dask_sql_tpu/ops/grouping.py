@@ -50,6 +50,65 @@ def key_arrays(cols: Sequence[Column]) -> List[jnp.ndarray]:
     return out
 
 
+def radix_gid(cols: Sequence[Column], max_domain: int = 1 << 22):
+    """Sort-free group ids for small-domain keys (dictionary codes / bools).
+
+    When every key column is dictionary-encoded (or boolean), group ids are a
+    mixed-radix combination of the codes — one fused multiply-add per column,
+    no O(n log n) sort.  This is the hot path for TPC-H Q1-style aggregations.
+    Returns (gid, domain, decode) or None when ineligible; `decode(gids)`
+    maps group ids back to per-column Columns (for key materialization).
+    """
+    radices = []
+    for c in cols:
+        if c.sql_type in STRING_TYPES and c.dictionary is not None:
+            radices.append(len(c.dictionary) + 1)  # +1 slot for NULL
+        elif c.data.dtype == jnp.bool_:
+            radices.append(3)
+        else:
+            return None
+    domain = 1
+    for r in radices:
+        domain *= r
+    if domain > max_domain:
+        return None
+    gid = None
+    codes_list = []
+    for c, r in zip(cols, radices):
+        codes = c.data.astype(jnp.int64) if c.data.dtype != jnp.bool_ else c.data.astype(jnp.int64)
+        codes = jnp.clip(codes, 0, r - 2)
+        if c.validity is not None:
+            codes = jnp.where(c.validity, codes, r - 1)  # NULL -> last slot
+        codes_list.append(codes)
+        gid = codes if gid is None else gid * r + codes
+
+    def decode(gids: jnp.ndarray) -> List[Column]:
+        out = []
+        rem = gids
+        strides = []
+        s = 1
+        for r in reversed(radices):
+            strides.append(s)
+            s *= r
+        strides = list(reversed(strides))
+        for c, r, stride in zip(cols, radices, strides):
+            code = (gids // stride) % r
+            validity = None
+            is_null = code == (r - 1)
+            if bool(is_null.any()):
+                validity = ~is_null
+            code = jnp.minimum(code, r - 2)
+            if c.sql_type in STRING_TYPES:
+                out.append(Column(code.astype(jnp.int32), c.sql_type, validity,
+                                  c.dictionary))
+            else:
+                out.append(Column(code.astype(c.data.dtype) if c.data.dtype != jnp.bool_
+                                  else (code == 1), c.sql_type, validity))
+        return out
+
+    return gid.astype(jnp.int32) if domain < 2**31 else gid, domain, decode
+
+
 def factorize(keys: Sequence[jnp.ndarray]) -> Tuple[jnp.ndarray, jnp.ndarray, int]:
     """Dense group ids for multi-column keys.
 

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 from typing import Callable, Dict
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -37,30 +38,41 @@ class AggregatePlugin(BaseRelPlugin):
         n = inp.num_rows
 
         group_cols = [executor.eval_expr(e, inp) for e in rel.group_exprs]
-        if group_cols:
-            gid, order, num_groups = g.factorize(g.key_arrays(group_cols))
-            if n == 0:
-                num_groups = 0
+        names = unique_names([f.name for f in rel.schema])
+        out: Dict[str, Column] = {}
+        present = None  # raw-domain compaction indices (radix fast path)
+        if group_cols and n > 0:
+            fast = g.radix_gid(group_cols)
+            if fast is not None:
+                # sort-free path: mixed-radix dictionary codes as segment ids
+                gid, domain, decode = fast
+                hit = jax.ops.segment_sum(jnp.ones(n, dtype=jnp.int32), gid, domain) > 0
+                present = jnp.nonzero(hit)[0]
+                num_groups = domain
+                for name, col in zip(names, decode(present)):
+                    out[name] = col
+            else:
+                gid, order, num_groups = g.factorize(g.key_arrays(group_cols))
+                first = g.group_first_indices(gid, num_groups)
+                for name, col in zip(names, group_cols):
+                    out[name] = col.take(first)
+        elif group_cols:
+            gid = jnp.zeros(0, dtype=jnp.int32)
+            num_groups = 0
+            for name, col in zip(names, group_cols):
+                out[name] = col.slice(0, 0)
         else:
             gid = jnp.zeros(n, dtype=jnp.int32)
             num_groups = 1  # global aggregate always yields one row
-            order = jnp.arange(n, dtype=jnp.int32)
-
-        names = unique_names([f.name for f in rel.schema])
-        out: Dict[str, Column] = {}
-        # group key columns: value at first occurrence of each group
-        if group_cols and num_groups > 0:
-            first = g.group_first_indices(gid, num_groups)
-            for name, col in zip(names, group_cols):
-                out[name] = col.take(first)
-        elif group_cols:
-            for name, col in zip(names, group_cols):
-                out[name] = col.slice(0, 0)
 
         agg_names = names[len(group_cols):]
         for name, agg in zip(agg_names, rel.agg_exprs):
-            out[name] = self._compute_agg(agg, inp, gid, num_groups, executor)
-        return Table(out, num_groups)
+            col = self._compute_agg(agg, inp, gid, num_groups, executor)
+            if present is not None:
+                col = col.take(present)
+            out[name] = col
+        nrows = int(present.shape[0]) if present is not None else num_groups
+        return Table(out, nrows)
 
     # ------------------------------------------------------------------
     def _compute_agg(self, agg: AggExpr, inp: Table, gid, num_groups: int,
