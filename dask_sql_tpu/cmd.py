@@ -1,0 +1,123 @@
+"""Interactive REPL CLI.
+
+Role parity: reference cmd.py — prompt-toolkit REPL with SQL highlighting,
+psql-style meta commands (\\l \\dt \\df \\dm \\de \\dss \\dsc, cmd.py:79-146),
+and the `dask-sql` console entrypoint (cmd.py:233).
+"""
+from __future__ import annotations
+
+import sys
+from typing import Optional
+
+
+META_COMMANDS_HELP = """
+\\l             list schemas
+\\dt            list tables in the current schema
+\\df            list user-defined functions
+\\dm            list models
+\\de            list experiments
+\\dss <schema>  switch schema
+\\dsc <schema>  show tables of a schema
+\\conf [key]    show configuration
+\\q             quit
+"""
+
+
+def _handle_meta(context, text: str) -> bool:
+    """Handle a psql-style meta command; returns True when handled."""
+    import pandas as pd
+
+    cmd, _, arg = text.strip().partition(" ")
+    arg = arg.strip()
+    schema = context.schema[context.schema_name]
+    if cmd == "\\l":
+        print(pd.DataFrame({"Schema": list(context.schema.keys())}))
+    elif cmd == "\\dt":
+        print(pd.DataFrame({"Table": list(schema.tables.keys())}))
+    elif cmd == "\\df":
+        print(pd.DataFrame({"Function": list(schema.function_lists.keys())}))
+    elif cmd == "\\dm":
+        print(pd.DataFrame({"Model": list(schema.models.keys())}))
+    elif cmd == "\\de":
+        print(pd.DataFrame({"Experiment": list(schema.experiments.keys())}))
+    elif cmd == "\\dss":
+        if arg in context.schema:
+            context.schema_name = arg
+            print(f"Schema switched to {arg}")
+        else:
+            print(f"Schema {arg!r} not found")
+    elif cmd == "\\dsc":
+        if arg in context.schema:
+            print(pd.DataFrame({"Table": list(context.schema[arg].tables.keys())}))
+        else:
+            print(f"Schema {arg!r} not found")
+    elif cmd == "\\conf":
+        from . import config as cfg
+
+        items = {k: context.config.get(k) for k in cfg.DEFAULTS if not arg or arg in k}
+        print(pd.DataFrame({"Key": list(items.keys()), "Value": [str(v) for v in items.values()]}))
+    elif cmd in ("\\q", "quit", "exit"):
+        raise EOFError
+    elif cmd in ("\\?", "help"):
+        print(META_COMMANDS_HELP)
+    else:
+        return False
+    return True
+
+
+def _run_query(context, sql: str):
+    import time
+
+    t0 = time.perf_counter()
+    try:
+        result = context.sql(sql)
+        if result is not None:
+            print(result.compute())
+        elapsed = time.perf_counter() - t0
+        print(f"({elapsed:.3f}s)")
+    except Exception as e:  # noqa: BLE001 - REPL surfaces all errors
+        print(f"ERROR: {e}", file=sys.stderr)
+
+
+def cmd_loop(context=None, client=None, startup: bool = False,
+             log_level=None):  # pragma: no cover - interactive
+    """Parity: reference cmd_loop (cmd.py)."""
+    from .context import Context
+
+    context = context or Context()
+    print("dask-sql-tpu — TPU-native SQL. Type \\? for help, \\q to quit.")
+    try:
+        from prompt_toolkit import PromptSession
+        from prompt_toolkit.history import InMemoryHistory
+
+        session = PromptSession(history=InMemoryHistory())
+        read = lambda: session.prompt("(tpu-sql) > ")
+    except ImportError:
+        read = lambda: input("(tpu-sql) > ")
+
+    while True:
+        try:
+            text = read()
+        except (EOFError, KeyboardInterrupt):
+            break
+        if not text.strip():
+            continue
+        try:
+            if text.strip().startswith("\\") or text.strip() in ("quit", "exit", "help"):
+                if _handle_meta(context, text):
+                    continue
+            _run_query(context, text)
+        except EOFError:
+            break
+
+
+def main():  # pragma: no cover - console entrypoint
+    import argparse
+
+    parser = argparse.ArgumentParser(description="TPU-native SQL REPL")
+    parser.parse_args()
+    cmd_loop()
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

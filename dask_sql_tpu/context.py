@@ -273,16 +273,19 @@ class Context:
 
     def _run_statement(self, stmt) -> Optional[TpuFrame]:
         plan = self._get_ral(stmt)
-        frame = TpuFrame(self, plan, [f.name for f in plan.schema])
         if isinstance(plan, plan_nodes.CustomNode) and not isinstance(
                 plan, (plan_nodes.PredictModelNode,)):
             # DDL / side-effecting statements run eagerly (parity: reference
             # converts them immediately, create_memory_table.py etc.)
-            table = frame.execute()
-            if not plan.schema:
+            from .physical.executor import Executor
+
+            table = Executor(self).execute(plan)
+            if not table.columns:
                 return None
+            frame = TpuFrame(self, plan, list(table.column_names))
+            frame._result = table
             return frame
-        return frame
+        return TpuFrame(self, plan, [f.name for f in plan.schema])
 
     def explain(self, sql: str, dataframes: Optional[Dict[str, Any]] = None) -> str:
         """Return the optimized logical plan as a string (parity context.py:535)."""

@@ -1,0 +1,40 @@
+"""FugueSQL execution-engine adapter (parity: reference integrations/fugue.py:22-70
+— registers a dask-sql based SQL engine with fugue).  Gated on the optional
+`fugue` dependency, exactly like the reference."""
+from __future__ import annotations
+
+try:  # pragma: no cover - optional dependency
+    import fugue
+    from fugue import ExecutionEngine, SqlEngine
+
+    _HAS_FUGUE = True
+except ImportError:  # pragma: no cover
+    _HAS_FUGUE = False
+
+
+if _HAS_FUGUE:  # pragma: no cover - optional dependency
+
+    class TpuSQLEngine(SqlEngine):
+        """Fugue SqlEngine backed by a dask_sql_tpu Context."""
+
+        def __init__(self, execution_engine=None):
+            super().__init__(execution_engine)
+            from ..context import Context
+
+            self._context = Context()
+
+        def select(self, dfs, statement):
+            import pandas as pd
+
+            for name, df in dfs.items():
+                self._context.create_table(name, df.as_pandas())
+            result = self._context.sql(
+                statement if isinstance(statement, str) else statement.construct())
+            return fugue.dataframe.PandasDataFrame(result.compute())
+
+else:
+
+    class TpuSQLEngine:  # type: ignore[no-redef]
+        def __init__(self, *args, **kwargs):
+            raise ImportError(
+                "fugue is not installed; `pip install fugue` to use the adapter")

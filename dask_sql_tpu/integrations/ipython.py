@@ -1,0 +1,26 @@
+"""IPython %%sql magic (parity: reference integrations/ipython.py — registers
+a sql cell/line magic bound to a Context; with auto_include, dataframes from
+the calling namespace are registered automatically, context.py:914-931)."""
+from __future__ import annotations
+
+
+def ipython_integration(context, auto_include: bool = False,
+                        disable_highlighting: bool = True) -> None:  # pragma: no cover
+    try:
+        from IPython.core.magic import needs_local_scope, register_line_cell_magic
+    except ImportError as e:
+        raise ImportError("IPython is required for the %%sql magic") from e
+
+    @needs_local_scope
+    def sql(line, cell=None, local_ns=None):
+        sql_statement = cell if cell is not None else line
+        if auto_include and local_ns:
+            import pandas as pd
+
+            for name, value in list(local_ns.items()):
+                if isinstance(value, pd.DataFrame) and not name.startswith("_"):
+                    context.create_table(name, value)
+        result = context.sql(sql_statement)
+        return result.compute() if result is not None else None
+
+    register_line_cell_magic(sql)

@@ -244,23 +244,30 @@ def _op_log(a: Column, x: Optional[Column] = None) -> Column:
 _rand_state = {"counter": 0}
 
 
+def _fresh_key(seed: Optional[Column]) -> "jax.Array":
+    if seed is not None:
+        return jax.random.PRNGKey(int(np.asarray(seed.data)[0]))
+    _rand_state["counter"] += 1
+    return jax.random.PRNGKey(
+        int(np.random.SeedSequence().entropy % (2**31)) + _rand_state["counter"])
+
+
 def _op_rand(seed: Optional[Column] = None, *, length: int = 1) -> Column:
     if seed is not None:
-        key = jax.random.PRNGKey(int(np.asarray(seed.data)[0]))
-    else:
-        _rand_state["counter"] += 1
-        key = jax.random.PRNGKey(np.random.SeedSequence().entropy % (2**31) + _rand_state["counter"])
+        length = len(seed)
+    key = _fresh_key(seed)
     return Column(jax.random.uniform(key, (length,), dtype=jnp.float64), SqlType.DOUBLE)
 
 
 def _op_rand_integer(*args: Column, length: int = 1) -> Column:
     if len(args) == 2:
         seed, bound = args
-        key = jax.random.PRNGKey(int(np.asarray(seed.data)[0]))
+        length = len(seed)
     else:
         (bound,) = args
-        _rand_state["counter"] += 1
-        key = jax.random.PRNGKey(np.random.SeedSequence().entropy % (2**31) + _rand_state["counter"])
+        seed = None
+        length = len(bound)
+    key = _fresh_key(seed)
     n = int(np.asarray(bound.data)[0])
     return Column(jax.random.randint(key, (length,), 0, max(n, 1)).astype(jnp.int32),
                   SqlType.INTEGER)

@@ -870,6 +870,18 @@ class Parser:
                 return a.CeilFloorTo("CEIL" if up != "FLOOR" else "FLOOR", operand, unit)
             self.expect(")")
             return a.FunctionCall("CEIL" if up != "FLOOR" else "FLOOR", [operand])
+        if up in ("TIMESTAMPADD", "TIMESTAMPDIFF", "DATEDIFF") and self.peek(1).value == "(":
+            # first argument is a bare datetime-unit keyword
+            self.next()
+            self.expect("(")
+            unit_tok = self.next()
+            unit = unit_tok.value if unit_tok.type == TokenType.STRING else unit_tok.upper
+            self.expect(",")
+            args = [a.Literal(unit), self.parse_expr()]
+            self.expect(",")
+            args.append(self.parse_expr())
+            self.expect(")")
+            return a.FunctionCall(up, args)
         if up == "EXISTS" and self.peek(1).value == "(":
             self.next()
             self.expect("(")

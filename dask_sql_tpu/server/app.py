@@ -1,0 +1,209 @@
+"""Presto-wire-protocol HTTP server.
+
+Role parity: reference server/app.py — POST /v1/statement (app.py:69-100),
+async status polling GET /v1/statement/{id} (app.py:44-66), cancellation
+DELETE /v1/cancel/{id} (app.py:28-41), /v1/empty, plus JDBC metadata tables
+(server/presto_jdbc.py).  Built on the stdlib ThreadingHTTPServer (this image
+ships no fastapi/uvicorn); queries run on a worker thread pool so polling
+stays responsive — the analogue of the reference's distributed futures.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import uuid
+from concurrent.futures import Future, ThreadPoolExecutor
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional
+
+from . import responses
+
+logger = logging.getLogger(__name__)
+
+
+class _QueryRegistry:
+    """Future registry (parity: the reference's app.future_list, app.py:20)."""
+
+    def __init__(self, max_workers: int = 8):
+        self.pool = ThreadPoolExecutor(max_workers=max_workers)
+        self.futures: Dict[str, Future] = {}
+        self.lock = threading.Lock()
+
+    def submit(self, fn) -> str:
+        qid = str(uuid.uuid4())
+        with self.lock:
+            self.futures[qid] = self.pool.submit(fn)
+        return qid
+
+    def get(self, qid: str) -> Optional[Future]:
+        with self.lock:
+            return self.futures.get(qid)
+
+    def cancel(self, qid: str) -> bool:
+        with self.lock:
+            fut = self.futures.pop(qid, None)
+        return fut.cancel() if fut is not None else False
+
+
+def _make_handler(context, registry: _QueryRegistry, jdbc_meta: bool):
+    class Handler(BaseHTTPRequestHandler):
+        server_version = "dask-sql-tpu-presto"
+
+        def log_message(self, fmt, *args):  # quiet
+            logger.debug(fmt, *args)
+
+        def _send(self, payload: Dict[str, Any], status: int = 200):
+            body = json.dumps(payload).encode()
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _base(self) -> str:
+            host = self.headers.get("Host", "localhost")
+            return f"http://{host}"
+
+        # ------------------------------------------------------------ POST
+        def do_POST(self):
+            if self.path.rstrip("/") != "/v1/statement":
+                self._send({"error": "unknown endpoint"}, 404)
+                return
+            length = int(self.headers.get("Content-Length", 0))
+            sql = self.rfile.read(length).decode()
+            if not sql.strip():
+                self._send(self._empty_results())
+                return
+
+            def run():
+                result = context.sql(sql)
+                return result.compute() if result is not None else None
+
+            qid = registry.submit(run)
+            self._send({
+                "id": qid,
+                "infoUri": f"{self._base()}/v1/info/{qid}",
+                "nextUri": f"{self._base()}/v1/statement/{qid}",
+                "stats": {**responses.query_stats(), "state": "QUEUED"},
+                "warnings": [],
+            })
+
+        def _empty_results(self):
+            qid = str(uuid.uuid4())
+            return {"id": qid, "infoUri": "", "stats": responses.query_stats(),
+                    "warnings": [], "columns": [], "data": []}
+
+        # ------------------------------------------------------------- GET
+        def do_GET(self):
+            parts = self.path.strip("/").split("/")
+            if len(parts) == 3 and parts[0] == "v1" and parts[1] == "statement":
+                self._status(parts[2])
+                return
+            if self.path.rstrip("/") == "/v1/empty":
+                self._send(self._empty_results())
+                return
+            self._send({"error": "unknown endpoint"}, 404)
+
+        def _status(self, qid: str):
+            fut = registry.get(qid)
+            if fut is None:
+                self._send({"error": f"unknown query {qid}"}, 404)
+                return
+            if not fut.done():
+                self._send({
+                    "id": qid,
+                    "infoUri": f"{self._base()}/v1/info/{qid}",
+                    "nextUri": f"{self._base()}/v1/statement/{qid}",
+                    "stats": {**responses.query_stats(), "state": "RUNNING"},
+                    "warnings": [],
+                })
+                return
+            try:
+                df = fut.result()
+            except Exception as e:  # noqa: BLE001 - surfaced to the client
+                self._send(responses.error_results(qid, None, e))
+                return
+            payload = {
+                "id": qid,
+                "infoUri": f"{self._base()}/v1/info/{qid}",
+                "stats": responses.query_stats(),
+                "warnings": [],
+            }
+            if df is not None:
+                payload["columns"] = responses.columns_from_frame(df)
+                payload["data"] = responses.data_from_frame(df)
+            self._send(payload)
+
+        # ---------------------------------------------------------- DELETE
+        def do_DELETE(self):
+            parts = self.path.strip("/").split("/")
+            if len(parts) == 3 and parts[0] == "v1" and parts[1] == "cancel":
+                ok = registry.cancel(parts[2])
+                self._send({"cancelled": bool(ok)}, 200 if ok else 404)
+                return
+            self._send({"error": "unknown endpoint"}, 404)
+
+    return Handler
+
+
+class PrestoServer:
+    def __init__(self, context=None, host: str = "0.0.0.0", port: int = 8080,
+                 jdbc_metadata: bool = False):
+        from ..context import Context
+
+        self.context = context or Context()
+        if jdbc_metadata:
+            from .presto_jdbc import create_meta_data
+
+            create_meta_data(self.context)
+        self.registry = _QueryRegistry()
+        handler = _make_handler(self.context, self.registry, jdbc_metadata)
+        self.httpd = ThreadingHTTPServer((host, port), handler)
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self.httpd.server_address[1]
+
+    def serve_forever(self):  # pragma: no cover - blocking entrypoint
+        logger.info("Presto server listening on %s", self.httpd.server_address)
+        self.httpd.serve_forever()
+
+    def start_background(self) -> "PrestoServer":
+        self._thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
+        self._thread.start()
+        return self
+
+    def shutdown(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def run_server(context=None, host: str = "0.0.0.0", port: int = 8080,
+               startup: bool = False, log_level=None, blocking: bool = True,
+               jdbc_metadata: bool = False):
+    """Parity: reference run_server (server/app.py:210 entrypoint)."""
+    server = PrestoServer(context, host=host, port=port, jdbc_metadata=jdbc_metadata)
+    if blocking:  # pragma: no cover - blocking entrypoint
+        try:
+            server.serve_forever()
+        except KeyboardInterrupt:
+            server.shutdown()
+        return None
+    return server.start_background()
+
+
+def main():  # pragma: no cover - console entrypoint (dask-sql-server parity)
+    import argparse
+
+    parser = argparse.ArgumentParser(description="Start the SQL server")
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", default=8080, type=int)
+    parser.add_argument("--jdbc-metadata", action="store_true")
+    args = parser.parse_args()
+    run_server(host=args.host, port=args.port, jdbc_metadata=args.jdbc_metadata)
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

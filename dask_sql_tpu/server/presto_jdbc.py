@@ -1,0 +1,40 @@
+"""JDBC metadata emulation (parity: reference server/presto_jdbc.py:10 —
+creates a `system` schema with `jdbc` tables describing catalogs/schemas/
+tables/columns so JDBC drivers can introspect)."""
+from __future__ import annotations
+
+import pandas as pd
+
+SYSTEM_SCHEMA = "system_jdbc"
+
+
+def create_meta_data(context) -> None:
+    context.create_schema(SYSTEM_SCHEMA)
+
+    schemas = pd.DataFrame({
+        "table_schem": list(context.schema.keys()),
+        "table_catalog": ["" for _ in context.schema],
+    })
+    context.create_table("schemas", schemas, schema_name=SYSTEM_SCHEMA)
+
+    rows = []
+    for schema_name, schema in context.schema.items():
+        for table_name in schema.tables:
+            rows.append((schema_name, table_name, "TABLE"))
+    tables = pd.DataFrame(rows, columns=["table_schem", "table_name", "table_type"]) \
+        if rows else pd.DataFrame({"table_schem": [], "table_name": [], "table_type": []})
+    context.create_table("tables", tables, schema_name=SYSTEM_SCHEMA)
+
+    crows = []
+    for schema_name, schema in context.schema.items():
+        for table_name, dc in schema.tables.items():
+            for pos, (col, c) in enumerate(dc.table.columns.items(), start=1):
+                crows.append((schema_name, table_name, col, str(c.sql_type),
+                              pos, "YES"))
+    columns = pd.DataFrame(
+        crows, columns=["table_schem", "table_name", "column_name", "type_name",
+                        "ordinal_position", "is_nullable"]) \
+        if crows else pd.DataFrame({"table_schem": [], "table_name": [],
+                                    "column_name": [], "type_name": [],
+                                    "ordinal_position": [], "is_nullable": []})
+    context.create_table("columns", columns, schema_name=SYSTEM_SCHEMA)

@@ -1,0 +1,115 @@
+"""DDL / schema / introspection tests (parity: reference test_create.py,
+test_schemas.py, test_show.py, test_analyze.py, test_distributeby.py)."""
+import os
+
+import pandas as pd
+import pytest
+
+from tests.utils import assert_eq
+
+
+def test_create_table_as(c, df):
+    c.sql("CREATE TABLE new_table AS (SELECT a, b FROM df WHERE a = 1)")
+    result = c.sql("SELECT * FROM new_table").compute()
+    expected = df[df.a == 1]
+    assert_eq(result, expected, check_dtype=False)
+
+def test_create_view_lazy(c, df):
+    c.sql("CREATE VIEW my_view AS (SELECT a, b FROM df WHERE a = 2)")
+    result = c.sql("SELECT COUNT(*) AS n FROM my_view").compute()
+    assert result["n"][0] == (df.a == 2).sum()
+
+def test_create_or_replace(c, df):
+    c.sql("CREATE TABLE t1 AS (SELECT a FROM df)")
+    with pytest.raises(RuntimeError):
+        c.sql("CREATE TABLE t1 AS (SELECT b FROM df)")
+    c.sql("CREATE OR REPLACE TABLE t1 AS (SELECT b FROM df)")
+    assert list(c.sql("SELECT * FROM t1").compute().columns) == ["b"]
+    c.sql("CREATE TABLE IF NOT EXISTS t1 AS (SELECT a FROM df)")
+    assert list(c.sql("SELECT * FROM t1").compute().columns) == ["b"]
+
+def test_drop_table(c, df):
+    c.sql("CREATE TABLE to_drop AS (SELECT a FROM df)")
+    c.sql("DROP TABLE to_drop")
+    with pytest.raises(Exception):
+        c.sql("SELECT * FROM to_drop")
+    c.sql("DROP TABLE IF EXISTS to_drop")  # no error
+
+def test_create_table_with_location(c, df_simple, tmp_path):
+    path = str(tmp_path / "data.csv")
+    df_simple.to_csv(path, index=False)
+    c.sql(f"CREATE TABLE from_csv WITH (location = '{path}', format = 'csv')")
+    result = c.sql("SELECT * FROM from_csv").compute()
+    assert_eq(result, df_simple, check_dtype=False)
+
+def test_create_table_parquet(c, df_simple, tmp_path):
+    path = str(tmp_path / "data.parquet")
+    df_simple.to_parquet(path)
+    c.sql(f"CREATE TABLE from_pq WITH (location = '{path}', format = 'parquet')")
+    result = c.sql("SELECT * FROM from_pq").compute()
+    assert_eq(result, df_simple, check_dtype=False)
+
+def test_schemas(c):
+    c.sql("CREATE SCHEMA other")
+    assert "other" in c.schema
+    c.sql("USE SCHEMA other")
+    assert c.schema_name == "other"
+    c.sql("USE SCHEMA root")
+    c.sql("ALTER SCHEMA other RENAME TO other2")
+    assert "other2" in c.schema and "other" not in c.schema
+    c.sql("DROP SCHEMA other2")
+    assert "other2" not in c.schema
+
+def test_show_schemas(c):
+    result = c.sql("SHOW SCHEMAS").compute()
+    assert "root" in list(result["Schema"])
+
+def test_show_tables(c):
+    result = c.sql("SHOW TABLES FROM root").compute()
+    assert "df_simple" in list(result["Table"])
+
+def test_show_columns(c):
+    result = c.sql("SHOW COLUMNS FROM df_simple").compute()
+    assert set(result["Column"]) == {"a", "b"}
+
+def test_alter_table(c, df_simple):
+    c.create_table("alter_me", df_simple)
+    c.sql("ALTER TABLE alter_me RENAME TO altered")
+    assert "altered" in c.schema["root"].tables
+    c.sql("DROP TABLE altered")
+
+def test_analyze_table(c, df):
+    result = c.sql("ANALYZE TABLE df COMPUTE STATISTICS FOR ALL COLUMNS").compute()
+    assert "col_name" in result.columns
+    assert "a" in result.columns
+
+def test_distribute_by(c, user_table_1):
+    result = c.sql("SELECT * FROM user_table_1 DISTRIBUTE BY user_id").compute()
+    assert len(result) == len(user_table_1)
+    # rows with equal keys must be contiguous after the re-shard
+    ids = list(result["user_id"])
+    seen = set()
+    prev = None
+    for x in ids:
+        if x != prev:
+            assert x not in seen
+            seen.add(x)
+        prev = x
+
+def test_explain(c, df):
+    text = c.explain("SELECT a FROM df WHERE a > 1")
+    assert "TableScan" in text
+
+def test_explain_statement(c, df):
+    result = c.sql("EXPLAIN SELECT a FROM df").compute()
+    assert "PLAN" in result.columns
+
+def test_sample(c, df):
+    result = c.sql("SELECT * FROM df TABLESAMPLE BERNOULLI (50) WHERE a >= 1").compute()
+    assert 0 < len(result) < len(df)
+    result = c.sql("SELECT * FROM df TABLESAMPLE SYSTEM (50) REPEATABLE (42)").compute()
+    assert 0 <= len(result) <= len(df)
+
+def test_multiple_statements(c, df):
+    result = c.sql("CREATE TABLE ms1 AS (SELECT a FROM df); SELECT COUNT(*) AS n FROM ms1")
+    assert result.compute()["n"][0] == len(df)

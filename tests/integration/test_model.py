@@ -1,0 +1,127 @@
+"""SQL ML layer tests (parity: reference test_model.py, 1076 LoC)."""
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+
+@pytest.fixture
+def training_df(c):
+    np.random.seed(0)
+    df = pd.DataFrame({
+        "x": np.random.rand(100),
+        "y": np.random.rand(100),
+    })
+    df["target"] = (df.x * 2 + df.y > 1.5).astype(np.int64)
+    c.create_table("timeseries", df)
+    return df
+
+
+def test_create_model_tpu_native(c, training_df):
+    c.sql(
+        """CREATE MODEL my_model WITH (
+               model_class = 'LinearRegression',
+               target_column = 'target'
+           ) AS (SELECT x, y, target FROM timeseries)"""
+    )
+    assert "my_model" in c.schema[c.schema_name].models
+    result = c.sql(
+        "SELECT * FROM PREDICT(MODEL my_model, SELECT x, y FROM timeseries)"
+    ).compute()
+    assert "target" in result.columns
+    assert len(result) == 100
+
+def test_create_model_sklearn(c, training_df):
+    c.sql(
+        """CREATE MODEL sk_model WITH (
+               model_class = 'sklearn.linear_model.LogisticRegression',
+               wrap_predict = True,
+               target_column = 'target'
+           ) AS (SELECT x, y, target FROM timeseries)"""
+    )
+    result = c.sql(
+        "SELECT * FROM PREDICT(MODEL sk_model, SELECT x, y FROM timeseries)"
+    ).compute()
+    acc = (result["target"] == training_df["target"]).mean()
+    assert acc > 0.8
+
+def test_wrap_fit_incremental(c, training_df):
+    c.sql(
+        """CREATE MODEL inc_model WITH (
+               model_class = 'sklearn.linear_model.SGDClassifier',
+               wrap_fit = True,
+               target_column = 'target'
+           ) AS (SELECT x, y, target FROM timeseries)"""
+    )
+    result = c.sql(
+        "SELECT * FROM PREDICT(MODEL inc_model, SELECT x, y FROM timeseries)"
+    ).compute()
+    assert len(result) == 100
+
+def test_show_describe_drop_model(c, training_df):
+    c.sql(
+        """CREATE MODEL m1 WITH (
+               model_class = 'LinearRegression', target_column = 'target'
+           ) AS (SELECT x, y, target FROM timeseries)"""
+    )
+    models = c.sql("SHOW MODELS").compute()
+    assert "m1" in list(models["Model"])
+    desc = c.sql("DESCRIBE MODEL m1").compute()
+    assert "training_columns" in list(desc["Params"])
+    c.sql("DROP MODEL m1")
+    assert "m1" not in c.schema[c.schema_name].models
+    c.sql("DROP MODEL IF EXISTS m1")
+    with pytest.raises(RuntimeError):
+        c.sql("DROP MODEL m1")
+
+def test_export_model(c, training_df, tmp_path):
+    c.sql(
+        """CREATE MODEL exp_model WITH (
+               model_class = 'sklearn.linear_model.LinearRegression',
+               target_column = 'target'
+           ) AS (SELECT x, y, target FROM timeseries)"""
+    )
+    path = str(tmp_path / "model.pkl")
+    c.sql(f"EXPORT MODEL exp_model WITH (format = 'pickle', location = '{path}')")
+    import pickle
+
+    with open(path, "rb") as f:
+        model = pickle.load(f)
+    assert hasattr(model, "predict")
+    path2 = str(tmp_path / "model.joblib")
+    c.sql(f"EXPORT MODEL exp_model WITH (format = 'joblib', location = '{path2}')")
+    assert os.path.exists(path2)
+
+def test_create_experiment(c, training_df):
+    c.sql(
+        """CREATE EXPERIMENT exp1 WITH (
+               model_class = 'sklearn.linear_model.LogisticRegression',
+               experiment_class = 'sklearn.model_selection.GridSearchCV',
+               tune_parameters = (C = (0.1, 1.0)),
+               target_column = 'target'
+           ) AS (SELECT x, y, target FROM timeseries)"""
+    )
+    assert "exp1" in c.schema[c.schema_name].experiments
+    assert "exp1" in c.schema[c.schema_name].models
+
+def test_kmeans_unsupervised(c, training_df):
+    c.sql(
+        """CREATE MODEL km WITH (
+               model_class = 'KMeans', n_clusters = 2
+           ) AS (SELECT x, y FROM timeseries)"""
+    )
+    result = c.sql("SELECT * FROM PREDICT(MODEL km, SELECT x, y FROM timeseries)").compute()
+    assert set(result["target"]) <= {0, 1}
+
+def test_ml_metrics():
+    from dask_sql_tpu.ml.metrics import (accuracy_score, log_loss,
+                                         mean_squared_error, r2_score)
+
+    y = np.array([0, 1, 1, 0])
+    p = np.array([0, 1, 0, 0])
+    assert accuracy_score(y, p) == 0.75
+    proba = np.array([0.1, 0.9, 0.4, 0.2])
+    assert log_loss(y, proba) > 0
+    assert mean_squared_error([1.0, 2.0], [1.0, 3.0]) == 0.5
+    assert r2_score([1.0, 2.0, 3.0], [1.0, 2.0, 3.0]) == 1.0

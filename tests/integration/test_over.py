@@ -1,0 +1,124 @@
+"""Window function tests (parity: reference test_over.py + rank family)."""
+import numpy as np
+import pandas as pd
+import pytest
+
+from tests.utils import assert_eq
+
+
+@pytest.fixture
+def win_df(c):
+    df = pd.DataFrame({
+        "g": ["a", "a", "a", "b", "b", "c"],
+        "x": [3, 1, 2, 10, 20, 5],
+        "y": [1.0, 2.0, 3.0, 4.0, 5.0, 6.0],
+    })
+    c.create_table("win", df)
+    return df
+
+
+def test_row_number(c, win_df):
+    result = c.sql(
+        "SELECT g, x, ROW_NUMBER() OVER (PARTITION BY g ORDER BY x) AS rn FROM win"
+    ).compute()
+    expected = win_df.assign(rn=win_df.sort_values("x").groupby("g").cumcount() + 1)
+    merged = result.sort_values(["g", "x"]).reset_index(drop=True)
+    exp = expected.sort_values(["g", "x"]).reset_index(drop=True)[["g", "x", "rn"]]
+    assert_eq(merged, exp, check_dtype=False)
+
+def test_row_number_no_partition(c, win_df):
+    result = c.sql("SELECT x, ROW_NUMBER() OVER (ORDER BY x) AS rn FROM win").compute()
+    assert list(result.sort_values("x")["rn"]) == [1, 2, 3, 4, 5, 6]
+
+def test_rank_dense_rank(c):
+    df = pd.DataFrame({"g": ["a"] * 5, "x": [1, 2, 2, 3, 3]})
+    c.create_table("rnk", df)
+    result = c.sql(
+        """SELECT x, RANK() OVER (PARTITION BY g ORDER BY x) AS r,
+                  DENSE_RANK() OVER (PARTITION BY g ORDER BY x) AS dr
+           FROM rnk"""
+    ).compute().sort_values("x").reset_index(drop=True)
+    assert list(result["r"]) == [1, 2, 2, 4, 4]
+    assert list(result["dr"]) == [1, 2, 2, 3, 3]
+
+def test_cumulative_sum(c, win_df):
+    result = c.sql(
+        "SELECT g, x, SUM(x) OVER (PARTITION BY g ORDER BY x) AS cs FROM win"
+    ).compute().sort_values(["g", "x"]).reset_index(drop=True)
+    expected = win_df.sort_values(["g", "x"]).groupby("g").x.cumsum()
+    assert list(result["cs"]) == list(expected)
+
+def test_window_whole_partition(c, win_df):
+    result = c.sql(
+        "SELECT g, SUM(x) OVER (PARTITION BY g) AS total FROM win"
+    ).compute()
+    expected = win_df.groupby("g").x.transform("sum")
+    merged = result.sort_values(["g"]).reset_index(drop=True)
+    assert sorted(result["total"]) == sorted(expected)
+
+def test_rows_frame(c, win_df):
+    result = c.sql(
+        """SELECT g, x, SUM(x) OVER (PARTITION BY g ORDER BY x
+               ROWS BETWEEN 1 PRECEDING AND CURRENT ROW) AS s
+           FROM win"""
+    ).compute().sort_values(["g", "x"]).reset_index(drop=True)
+    expected = (win_df.sort_values(["g", "x"]).groupby("g").x
+                .rolling(2, min_periods=1).sum().reset_index(drop=True))
+    assert list(result["s"]) == list(expected)
+
+def test_lag_lead(c, win_df):
+    result = c.sql(
+        """SELECT g, x, LAG(x, 1) OVER (PARTITION BY g ORDER BY x) AS lg,
+                  LEAD(x, 1) OVER (PARTITION BY g ORDER BY x) AS ld
+           FROM win"""
+    ).compute().sort_values(["g", "x"]).reset_index(drop=True)
+    srt = win_df.sort_values(["g", "x"])
+    assert list(result["lg"].fillna(-1)) == list(srt.groupby("g").x.shift(1).fillna(-1))
+    assert list(result["ld"].fillna(-1)) == list(srt.groupby("g").x.shift(-1).fillna(-1))
+
+def test_first_last_value(c, win_df):
+    result = c.sql(
+        """SELECT g, x,
+                  FIRST_VALUE(x) OVER (PARTITION BY g ORDER BY x) AS fv,
+                  LAST_VALUE(x) OVER (PARTITION BY g ORDER BY x
+                      ROWS BETWEEN UNBOUNDED PRECEDING AND UNBOUNDED FOLLOWING) AS lv
+           FROM win"""
+    ).compute().sort_values(["g", "x"]).reset_index(drop=True)
+    srt = win_df.sort_values(["g", "x"])
+    assert list(result["fv"]) == list(srt.groupby("g").x.transform("min"))
+    assert list(result["lv"]) == list(srt.groupby("g").x.transform("max"))
+
+def test_avg_count_window(c, win_df):
+    result = c.sql(
+        """SELECT g, x, AVG(y) OVER (PARTITION BY g ORDER BY x) AS av,
+                  COUNT(*) OVER (PARTITION BY g) AS cnt
+           FROM win"""
+    ).compute().sort_values(["g", "x"]).reset_index(drop=True)
+    srt = win_df.sort_values(["g", "x"])
+    expected_av = srt.groupby("g").y.expanding().mean().reset_index(drop=True)
+    np.testing.assert_allclose(result["av"], expected_av)
+    assert list(result["cnt"]) == list(srt.groupby("g").x.transform("count"))
+
+def test_min_max_window(c, win_df):
+    result = c.sql(
+        """SELECT g, x, MIN(x) OVER (PARTITION BY g ORDER BY x) AS mn,
+                  MAX(x) OVER (PARTITION BY g ORDER BY x ROWS BETWEEN 1 PRECEDING AND 1 FOLLOWING) AS mx
+           FROM win"""
+    ).compute().sort_values(["g", "x"]).reset_index(drop=True)
+    srt = win_df.sort_values(["g", "x"])
+    assert list(result["mn"]) == list(srt.groupby("g").x.expanding().min().reset_index(drop=True).astype(int))
+    expected_mx = srt.groupby("g").x.rolling(3, min_periods=1, center=True).max().reset_index(drop=True)
+    assert list(result["mx"]) == list(expected_mx.astype(int))
+
+def test_percent_rank_cume_dist(c):
+    df = pd.DataFrame({"x": [1, 2, 3, 4]})
+    c.create_table("pr", df)
+    result = c.sql(
+        """SELECT x, PERCENT_RANK() OVER (ORDER BY x) AS p,
+                  CUME_DIST() OVER (ORDER BY x) AS cd,
+                  NTILE(2) OVER (ORDER BY x) AS nt
+           FROM pr"""
+    ).compute().sort_values("x").reset_index(drop=True)
+    np.testing.assert_allclose(result["p"], [0, 1 / 3, 2 / 3, 1.0])
+    np.testing.assert_allclose(result["cd"], [0.25, 0.5, 0.75, 1.0])
+    assert list(result["nt"]) == [1, 1, 2, 2]

@@ -1,0 +1,169 @@
+"""Scalar-expression kernel tests (parity: reference test_rex.py, 1255 LoC)."""
+import numpy as np
+import pandas as pd
+import pytest
+
+from tests.utils import assert_eq
+
+
+def test_arithmetic(c, df):
+    result = c.sql(
+        "SELECT a + b AS s, a - b AS d, a * b AS m, b / a AS q, MOD(CAST(b AS BIGINT), 3) AS r FROM df"
+    ).compute()
+    expected = pd.DataFrame({
+        "s": df.a + df.b, "d": df.a - df.b, "m": df.a * df.b, "q": df.b / df.a,
+        "r": df.b.astype("int64") % 3,
+    })
+    assert_eq(result, expected, check_dtype=False)
+
+def test_integer_division_truncates(c):
+    c.create_table("intdiv", pd.DataFrame({"a": [7, -7], "b": [2, 2]}))
+    result = c.sql("SELECT a / b AS q FROM intdiv").compute()
+    assert list(result["q"]) == [3, -3]  # truncation toward zero
+
+def test_math_functions(c, df):
+    result = c.sql(
+        """SELECT ABS(b - 5) AS v1, SQRT(b) AS v2, FLOOR(b) AS v3, CEIL(b) AS v4,
+                  ROUND(b, 1) AS v5, EXP(a) AS v6, LN(b + 1) AS v7, POWER(a, 2) AS v8,
+                  SIGN(b - 5) AS v9, SIN(b) AS v10, COS(b) AS v11, ATAN(b) AS v12
+           FROM df"""
+    ).compute()
+    np.testing.assert_allclose(result["v1"], (df.b - 5).abs(), rtol=1e-9)
+    np.testing.assert_allclose(result["v2"], np.sqrt(df.b), rtol=1e-9)
+    np.testing.assert_allclose(result["v3"], np.floor(df.b))
+    np.testing.assert_allclose(result["v4"], np.ceil(df.b))
+    np.testing.assert_allclose(result["v5"], np.sign(df.b * 10) * np.floor(np.abs(df.b * 10) + 0.5) / 10, rtol=1e-9)
+    np.testing.assert_allclose(result["v6"], np.exp(df.a), rtol=1e-9)
+    np.testing.assert_allclose(result["v8"], df.a ** 2, rtol=1e-9)
+
+def test_string_functions(c, string_table):
+    result = c.sql(
+        """SELECT UPPER(a) AS u, LOWER(a) AS l, CHAR_LENGTH(a) AS n,
+                  SUBSTRING(a FROM 2 FOR 3) AS sub, CONCAT(a, '!') AS cc,
+                  REPLACE(a, 'a', 'X') AS rep, TRIM(a) AS tr,
+                  POSITION('n' IN a) AS pos, INITCAP(a) AS ic, REVERSE(a) AS rv,
+                  LEFT(a, 3) AS lft, RIGHT(a, 3) AS rgt
+           FROM string_table"""
+    ).compute()
+    s = string_table.a
+    assert list(result["u"]) == list(s.str.upper())
+    assert list(result["l"]) == list(s.str.lower())
+    assert list(result["n"]) == list(s.str.len())
+    assert list(result["sub"]) == list(s.str[1:4])
+    assert list(result["cc"]) == list(s + "!")
+    assert list(result["rep"]) == list(s.str.replace("a", "X"))
+    assert list(result["pos"]) == [x.find("n") + 1 for x in s]
+    assert list(result["rv"]) == [x[::-1] for x in s]
+    assert list(result["lft"]) == [x[:3] for x in s]
+    assert list(result["rgt"]) == [x[-3:] for x in s]
+
+def test_like_similar(c, string_table):
+    result = c.sql("SELECT a LIKE '%string' AS l1, a SIMILAR TO '.*string' AS l2 FROM string_table").compute()
+    assert list(result["l1"]) == [True, False, False]
+    assert list(result["l2"]) == [True, False, False]
+
+def test_datetime_extract(c, datetime_table):
+    result = c.sql(
+        """SELECT EXTRACT(YEAR FROM no_timezone) AS y, EXTRACT(MONTH FROM no_timezone) AS m,
+                  EXTRACT(DAY FROM no_timezone) AS d, EXTRACT(HOUR FROM no_timezone) AS h,
+                  EXTRACT(MINUTE FROM no_timezone) AS mi, EXTRACT(DOW FROM no_timezone) AS dow,
+                  EXTRACT(DOY FROM no_timezone) AS doy, EXTRACT(QUARTER FROM no_timezone) AS q,
+                  EXTRACT(WEEK FROM no_timezone) AS w
+           FROM datetime_table"""
+    ).compute()
+    dt = datetime_table.no_timezone.dt
+    assert list(result["y"]) == list(dt.year)
+    assert list(result["m"]) == list(dt.month)
+    assert list(result["d"]) == list(dt.day)
+    assert list(result["h"]) == list(dt.hour)
+    assert list(result["mi"]) == list(dt.minute)
+    assert list(result["dow"]) == list(dt.dayofweek.map(lambda x: (x + 1) % 7 + 1))
+    assert list(result["doy"]) == list(dt.dayofyear)
+    assert list(result["q"]) == list(dt.quarter)
+
+def test_datetime_arith(c, datetime_table):
+    result = c.sql(
+        """SELECT no_timezone + INTERVAL '2' DAY AS plus2d,
+                  no_timezone - INTERVAL '3' HOUR AS minus3h,
+                  CEIL(no_timezone TO DAY) AS up_day,
+                  FLOOR(no_timezone TO MONTH) AS down_month
+           FROM datetime_table"""
+    ).compute()
+    src = datetime_table.no_timezone
+    assert list(result["plus2d"]) == list(src + pd.Timedelta(days=2))
+    assert list(result["minus3h"]) == list(src - pd.Timedelta(hours=3))
+    assert list(result["up_day"]) == list(src.dt.ceil("D"))
+    assert list(result["down_month"]) == list(src.dt.to_period("M").dt.start_time)
+
+def test_timestampadd_diff(c, datetime_table):
+    result = c.sql(
+        """SELECT TIMESTAMPADD(MONTH, 2, no_timezone) AS am,
+                  TIMESTAMPDIFF(DAY, TIMESTAMP '2014-08-01 00:00', no_timezone) AS dd
+           FROM datetime_table"""
+    ).compute()
+    src = datetime_table.no_timezone
+    assert list(result["am"]) == list(src + pd.DateOffset(months=2))
+    expected_dd = ((src - pd.Timestamp("2014-08-01")).dt.total_seconds() // 86400).astype(int)
+    assert list(result["dd"]) == list(expected_dd)
+
+def test_coalesce_nullif(c):
+    c.create_table("cn", pd.DataFrame({"a": [1.0, None, 3.0], "b": [10.0, 20.0, 30.0]}))
+    result = c.sql("SELECT COALESCE(a, b) AS co, NULLIF(b, 10) AS ni FROM cn").compute()
+    assert list(result["co"]) == [1.0, 20.0, 3.0]
+    assert pd.isna(result["ni"][0]) and result["ni"][1] == 20.0
+
+def test_case_operand_form(c, df):
+    result = c.sql("SELECT CASE CAST(a AS BIGINT) WHEN 1 THEN 'one' WHEN 2 THEN 'two' ELSE 'x' END AS r FROM df").compute()
+    expected = df.a.map({1.0: "one", 2.0: "two", 3.0: "x"})
+    assert list(result["r"]) == list(expected)
+
+def test_cast(c, df):
+    result = c.sql(
+        "SELECT CAST(b AS BIGINT) AS i, CAST(a AS VARCHAR) AS s, CAST(a AS BOOLEAN) AS bo FROM df"
+    ).compute()
+    assert list(result["i"]) == list(df.b.astype("int64"))
+    assert list(result["s"]) == [f"{x:.1f}" for x in df.a]
+    assert all(result["bo"])
+
+def test_is_distinct(c):
+    c.create_table("idf", pd.DataFrame({"a": [1.0, None, 3.0], "b": [1.0, None, 4.0]}))
+    result = c.sql("SELECT a IS DISTINCT FROM b AS d, a IS NOT DISTINCT FROM b AS nd FROM idf").compute()
+    assert list(result["d"]) == [False, False, True]
+    assert list(result["nd"]) == [True, True, False]
+
+def test_boolean_ops_3vl(c):
+    c.create_table("b3", pd.DataFrame({"x": [1.0, None, 0.0]}))
+    result = c.sql(
+        """SELECT (x > 0) AND (x < 2) AS a, (x > 0) OR (x IS NULL) AS o,
+                  (x > 0) IS TRUE AS t, (x > 0) IS NOT FALSE AS nf
+           FROM b3"""
+    ).compute()
+    assert list(result["a"].map(lambda v: None if pd.isna(v) else bool(v))) == [True, None, False]
+    assert list(result["o"]) == [True, True, False]
+    assert list(result["t"]) == [True, False, False]
+    assert list(result["nf"]) == [True, True, False]
+
+def test_random(c, df):
+    result = c.sql("SELECT RAND(42) AS r, RAND_INTEGER(42, 10) AS ri FROM df").compute()
+    assert ((result["r"] >= 0) & (result["r"] < 1)).all()
+    assert ((result["ri"] >= 0) & (result["ri"] < 10)).all()
+
+def test_in_expression_3vl(c):
+    c.create_table("inl", pd.DataFrame({"a": [1.0, 2.0, None]}))
+    result = c.sql("SELECT a IN (1, 3) AS i FROM inl").compute()
+    vals = [None if pd.isna(v) else bool(v) for v in result["i"]]
+    assert vals == [True, False, None]
+
+def test_string_concat_operator(c, string_table):
+    result = c.sql("SELECT a || '-x' AS r FROM string_table").compute()
+    assert list(result["r"]) == [x + "-x" for x in string_table.a]
+
+def test_overlay(c):
+    c.create_table("ov", pd.DataFrame({"s": ["abcdef"]}))
+    result = c.sql("SELECT OVERLAY(s PLACING 'XX' FROM 2 FOR 3) AS r FROM ov").compute()
+    assert result["r"][0] == "aXXef"
+
+def test_greatest_least(c, df):
+    result = c.sql("SELECT GREATEST(a, b) AS g, LEAST(a, b) AS l FROM df").compute()
+    np.testing.assert_allclose(result["g"], np.maximum(df.a, df.b))
+    np.testing.assert_allclose(result["l"], np.minimum(df.a, df.b))
