@@ -64,6 +64,9 @@ class RexConverter:
     # -- plugins ------------------------------------------------------------
     def _input_ref(self, expr: ColumnRef, table: Table) -> Column:
         # parity: core/input_ref.py — positional backend lookup
+        if type(expr).__name__ == "_OuterRef":
+            raise NotImplementedError(
+                "Correlated subquery was not decorrelated; this shape is unsupported")
         name = table.column_names[expr.index]
         return table.columns[name]
 

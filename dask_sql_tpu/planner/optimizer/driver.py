@@ -33,12 +33,16 @@ def _load_rules():
 def optimize_plan(plan, config, catalog):
     rules = _load_rules()
     verbose = bool(config.get("sql.optimizer.verbose", False))
-    for rule in rules:
-        new_plan = rule.apply(plan, config, catalog)
-        if new_plan is not None:
-            if verbose and new_plan is not plan:
-                logger.info("After %s:\n%s", type(rule).__name__, new_plan.explain())
-            plan = new_plan
+    # two passes: pushdowns expose new opportunities (e.g. cross-join
+    # elimination after filters sink) — parity with the reference pipeline
+    # repeating SimplifyExpressions/PushDownLimit (optimizer.rs:53-98)
+    for _ in range(2):
+        for rule in rules:
+            new_plan = rule.apply(plan, config, catalog)
+            if new_plan is not None:
+                if verbose and new_plan is not plan:
+                    logger.info("After %s:\n%s", type(rule).__name__, new_plan.explain())
+                plan = new_plan
     from . import join_reorder
 
     plan = join_reorder.maybe_reorder(plan, config, catalog)

@@ -470,10 +470,8 @@ def _prune(plan, required: Set[int]) -> Tuple[p.LogicalPlan, Dict[int, int]]:
         child_req = set(required) | referenced_columns(plan.predicate)
         new_child, cmap = _prune(plan.input, child_req)
         pred = remap_columns(plan.predicate, cmap)
-        keep = sorted(child_req)
-        mapping = {old: new for new, old in enumerate(keep)}
-        fields = [plan.schema[i] for i in keep]
-        f = p.Filter(new_child, pred, fields)
+        mapping = {old: cmap[old] for old in child_req}
+        f = p.Filter(new_child, pred, list(new_child.schema))
         return f, mapping
 
     if isinstance(plan, p.Join):
@@ -592,12 +590,26 @@ def list_fields(plan, new_child, cmap):
 # ---------------------------------------------------------------------------
 class DecorrelateSubqueries(Rule):
     def apply(self, plan, config, catalog):
+        def go_expr(e: Expr) -> Expr:
+            """Recurse into subquery plans embedded in expressions."""
+            def fn(x):
+                if isinstance(x, (ScalarSubqueryExpr, InSubqueryExpr, ExistsExpr)):
+                    from dataclasses import replace as _rp
+
+                    return _rp(x, plan=go(x.plan))
+                return x
+
+            return transform(e, fn)
+
         def go(node):
             node = _rewrite_children(node, go)
+            node = _map_node_exprs(node, go_expr)
             if not isinstance(node, p.Filter):
                 return node
             parts = _conjuncts(node.predicate)
             child = node.input
+            orig_width = len(child.schema)
+            orig_schema = list(child.schema)
             changed = False
             kept: List[Expr] = []
             for c in parts:
@@ -605,15 +617,95 @@ class DecorrelateSubqueries(Rule):
                 if new_child is not None:
                     child = new_child
                     changed = True
-                else:
-                    kept.append(c)
+                    continue
+                res = self._rewrite_scalar(c, child)
+                if res is not None:
+                    child, new_c = res
+                    kept.append(new_c)
+                    changed = True
+                    continue
+                kept.append(c)
             if not changed:
                 return node
-            if kept:
-                return p.Filter(child, _conjoin(kept), child.schema)
-            return child
+            out = p.Filter(child, _conjoin(kept), child.schema) if kept else child
+            if len(out.schema) != orig_width:
+                # scalar rewrites widened the row; project back
+                refs = [ColumnRef(i, f.name, f.sql_type, f.nullable)
+                        for i, f in enumerate(orig_schema)]
+                out = p.Projection(out, refs, orig_schema)
+            return out
 
         return go(plan)
+
+    def _rewrite_scalar(self, conjunct: Expr, child):
+        """`expr <op> (SELECT agg FROM ... WHERE inner = outer)` ->
+        LEFT join against the per-key aggregated subquery.
+        Parity: DataFusion's ScalarSubqueryToJoin in the reference pipeline."""
+        subqs = [x for x in walk(conjunct) if isinstance(x, ScalarSubqueryExpr)]
+        if len(subqs) != 1:
+            return None
+        sq = subqs[0]
+        node = sq.plan
+        while isinstance(node, p.SubqueryAlias):
+            node = node.input
+        if not isinstance(node, p.Projection) or len(node.exprs) != 1:
+            return None
+        agg = node.input
+        if not isinstance(agg, p.Aggregate) or agg.group_exprs:
+            return None
+        core = agg.input
+        pairs: List[Tuple[Expr, Expr]] = []
+        kept: List[Expr] = []
+        while isinstance(core, p.Filter):
+            for c in _conjuncts(core.predicate):
+                pr = _outer_eq_pair(c)
+                if pr is not None:
+                    pairs.append(pr)
+                elif any(isinstance(x, _OuterRef) for x in walk(c)):
+                    return None
+                else:
+                    kept.append(c)
+            core = core.input
+        if not pairs:
+            return None  # uncorrelated: evaluated directly
+        for e in _all_exprs_below(core) + list(agg.agg_exprs):
+            if any(isinstance(x, _OuterRef) for x in walk(e)):
+                return None
+        if kept:
+            core = p.Filter(core, _conjoin(kept), core.schema)
+        key_exprs = [inner for _, inner in pairs]
+        ngroups = len(key_exprs)
+        agg_fields = ([Field(f"__sckey{i}", e.sql_type, True)
+                       for i, e in enumerate(key_exprs)]
+                      + [Field(f"__scagg{j}", a.sql_type, True)
+                         for j, a in enumerate(agg.agg_exprs)])
+        agg2 = p.Aggregate(core, key_exprs, list(agg.agg_exprs), agg_fields)
+        # the subquery's projection referenced agg outputs at 0..; shift by ngroups
+        proj_expr = remap_columns(node.exprs[0],
+                                  {j: ngroups + j for j in range(len(agg.agg_exprs))})
+        sub_fields = ([Field("__scval", proj_expr.sql_type, True)]
+                      + [Field(f"__sckey{i}", e.sql_type, True)
+                         for i, e in enumerate(key_exprs)])
+        sub_exprs = [proj_expr] + [
+            ColumnRef(i, f"__sckey{i}", key_exprs[i].sql_type, True)
+            for i in range(ngroups)]
+        sub = p.Projection(agg2, sub_exprs, sub_fields)
+        nleft = len(child.schema)
+        on = [(_outer_to_local(outer),
+               ColumnRef(nleft + 1 + i, f"__sckey{i}", key_exprs[i].sql_type, True))
+              for i, (outer, _) in enumerate(pairs)]
+        join_fields = list(child.schema) + sub_fields
+        join = p.Join(child, sub, "LEFT", on, None, join_fields)
+        # replace the scalar subquery with a reference to the joined value
+        val_ref = ColumnRef(nleft, "__scval", sq.sql_type, True)
+
+        def fn(x):
+            if x is sq or x == sq:
+                return val_ref
+            return x
+
+        new_conjunct = transform(conjunct, fn)
+        return join, new_conjunct
 
     def _try_rewrite(self, pred: Expr, child) -> Optional[p.LogicalPlan]:
         # EXISTS / NOT EXISTS
@@ -634,20 +726,22 @@ class DecorrelateSubqueries(Rule):
 
     def _extract_correlation(self, sub):
         """Decompose the subplan as [Alias?] Projection -> Filter* -> core and
-        pull outer-ref equality conjuncts out of those filters.
+        pull outer-ref conjuncts out of those filters.
 
-        Returns (core_with_residual_filters, proj_exprs, pairs) where
-        proj_exprs and the pairs' inner expressions are all bound against the
-        core's schema (filters preserve positions).  Returns (None, None, [])
-        when the shape doesn't match or outer refs appear elsewhere.
+        Returns (core_with_residual_filters, proj_exprs, pairs, corr_residuals)
+        where proj_exprs / pairs / corr_residuals are bound against the core's
+        schema (filters preserve positions); corr_residuals are non-equality
+        correlated conjuncts (still containing _OuterRef markers).  Returns
+        (None, None, [], []) when the shape doesn't match.
         """
         node = sub
         while isinstance(node, (p.SubqueryAlias, p.Distinct)):
             node = node.inputs()[0]
         if not isinstance(node, p.Projection):
-            return None, None, []
+            return None, None, [], []
         proj_exprs = list(node.exprs)
         pairs: List[Tuple[Expr, Expr]] = []
+        corr_residuals: List[Expr] = []
         kept: List[Expr] = []
         core = node.input
         while isinstance(core, p.Filter):
@@ -656,36 +750,61 @@ class DecorrelateSubqueries(Rule):
                 if pr is not None:
                     pairs.append(pr)
                 elif any(isinstance(x, _OuterRef) for x in walk(c)):
-                    return None, None, []
+                    if _has_subquery(c):
+                        return None, None, [], []
+                    corr_residuals.append(c)
                 else:
                     kept.append(c)
             core = core.input
         # nothing below the filters may reference the outer query
         for e in _all_exprs_below(core) + proj_exprs:
             if any(isinstance(x, _OuterRef) for x in walk(e)):
-                return None, None, []
+                return None, None, [], []
         if kept:
             core = p.Filter(core, _conjoin(kept), core.schema)
-        return core, proj_exprs, pairs
+        return core, proj_exprs, pairs, corr_residuals
 
     def _rewrite_exists(self, pred: ExistsExpr, child, anti: bool) -> Optional[p.LogicalPlan]:
-        core, _, pairs = self._extract_correlation(pred.plan)
-        if core is None or not pairs:
+        core, _, pairs, corr_residuals = self._extract_correlation(pred.plan)
+        if core is None or not (pairs or corr_residuals):
             return None  # uncorrelated EXISTS is evaluated directly (cheap)
         nleft = len(child.schema)
-        # subquery output := the correlation key expressions themselves
+        # subquery output := correlation keys + inner columns the residual needs
         key_exprs = [inner for _, inner in pairs]
-        fields = [Field(f"__ckey{i}", e.sql_type, True) for i, e in enumerate(key_exprs)]
-        sub = p.Projection(core, key_exprs, fields)
+        resid_inner = sorted({
+            x.index for r in corr_residuals for x in walk(r)
+            if isinstance(x, ColumnRef) and not isinstance(x, _OuterRef)})
+        out_exprs = list(key_exprs) + [
+            ColumnRef(i, core.schema[i].name, core.schema[i].sql_type,
+                      core.schema[i].nullable) for i in resid_inner]
+        fields = [Field(f"__ckey{i}", e.sql_type, True) for i, e in enumerate(out_exprs)]
+        sub = p.Projection(core, out_exprs, fields)
         on = [(_outer_to_local(outer), ColumnRef(nleft + i, fields[i].name,
                                                  key_exprs[i].sql_type, True))
               for i, (outer, _) in enumerate(pairs)]
+        # residuals: outer refs stay local (< nleft); inner refs point at the
+        # projected copies (>= nleft)
+        inner_pos = {idx: nleft + len(key_exprs) + j for j, idx in enumerate(resid_inner)}
+
+        def fix_residual(r: Expr) -> Expr:
+            def fn(x):
+                if isinstance(x, _OuterRef):
+                    return ColumnRef(x.index, x.name, x.sql_type, x.nullable)
+                if isinstance(x, ColumnRef):
+                    from dataclasses import replace as _rp
+
+                    return _rp(x, index=inner_pos[x.index])
+                return x
+
+            return transform(r, fn)
+
+        jfilter = _conjoin([fix_residual(r) for r in corr_residuals]) if corr_residuals else None
         jt = "LEFTANTI" if anti else "LEFTSEMI"
-        return p.Join(child, sub, jt, on, None, list(child.schema))
+        return p.Join(child, sub, jt, on, jfilter, list(child.schema))
 
     def _rewrite_in(self, pred: InSubqueryExpr, child, anti: bool) -> Optional[p.LogicalPlan]:
-        core, proj_exprs, pairs = self._extract_correlation(pred.plan)
-        if core is None:
+        core, proj_exprs, pairs, corr_residuals = self._extract_correlation(pred.plan)
+        if core is None or corr_residuals:
             return None
         # NOT IN with nullable keys has 3VL semantics a plain anti-join
         # breaks — leave those to direct evaluation
