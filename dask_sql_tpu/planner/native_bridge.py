@@ -1,0 +1,98 @@
+"""ctypes bridge to the native (C++) planner components.
+
+Role parity: the reference embeds its whole planner as a native extension
+(PyO3 cdylib, src/lib.rs).  Here the native library is loaded via ctypes —
+no pybind11 needed — and each component keeps a pure-Python fallback so the
+package works before `make` has run.  The library is built lazily (g++) on
+first use and cached next to the sources.
+"""
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import List, Optional
+
+logger = logging.getLogger(__name__)
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))), "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libdsql_native.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+_TOKEN_TYPE_NAMES = ["IDENT", "QUOTED_IDENT", "NUMBER", "STRING", "OP", "PUNCT", "PARAM"]
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(["make", "-s"], cwd=_NATIVE_DIR, check=True,
+                       capture_output=True, timeout=120)
+        return os.path.exists(_LIB_PATH)
+    except Exception as e:  # noqa: BLE001 - any failure means fallback
+        logger.debug("native build failed: %s", e)
+        return False
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_LIB_PATH) and os.path.isdir(_NATIVE_DIR):
+            _build()
+        if not os.path.exists(_LIB_PATH):
+            return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+            lib.dsql_tokenize.restype = ctypes.c_int64
+            lib.dsql_tokenize.argtypes = [
+                ctypes.c_char_p, ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int64),
+                ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+            ]
+            lib.dsql_tokenizer_abi_version.restype = ctypes.c_int32
+            if lib.dsql_tokenizer_abi_version() != 1:
+                return None
+            _lib = lib
+        except OSError:
+            return None
+        return _lib
+
+
+def native_tokenize(sql: str):
+    """Tokenize via the C++ lexer; returns a lexer.Token list or None."""
+    from .lexer import Token, TokenType
+
+    lib = get_lib()
+    if lib is None:
+        return None
+    raw = sql.encode("utf-8")
+    max_tokens = max(len(raw) // 2 + 16, 64)
+    types = (ctypes.c_int32 * max_tokens)()
+    starts = (ctypes.c_int64 * max_tokens)()
+    lens = (ctypes.c_int64 * max_tokens)()
+    count = lib.dsql_tokenize(raw, len(raw), types, starts, lens, max_tokens)
+    if count < 0:
+        from .lexer import LexError
+
+        pos = -int(count) - 1
+        raise LexError(f"Unexpected character at position {pos}")
+    tokens: List[Token] = []
+    for i in range(count):
+        t = _TOKEN_TYPE_NAMES[types[i]]
+        start, length = starts[i], lens[i]
+        value = raw[start : start + length].decode("utf-8")
+        if t == "STRING":
+            value = value.replace("''", "'")
+        elif t == "QUOTED_IDENT":
+            value = value.replace('""', '"').replace("``", "`")
+        tokens.append(Token(getattr(TokenType, t), value, start))
+    end = len(raw)
+    tokens.append(Token(TokenType.EOF, "", end))
+    return tokens

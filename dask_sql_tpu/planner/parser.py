@@ -40,8 +40,21 @@ _DATETIME_UNITS = {
 class Parser:
     def __init__(self, sql: str):
         self.sql = sql
-        self.tokens = tokenize(sql)
+        self.tokens = self._tokenize(sql)
         self.pos = 0
+
+    @staticmethod
+    def _tokenize(sql: str):
+        # native (C++) lexer when built, identical-contract Python fallback
+        try:
+            from .native_bridge import native_tokenize
+
+            tokens = native_tokenize(sql)
+            if tokens is not None:
+                return tokens
+        except Exception:  # noqa: BLE001 - fall back on any native issue
+            pass
+        return tokenize(sql)
 
     # -- token helpers ------------------------------------------------------
     def peek(self, offset: int = 0) -> Token:

@@ -1,0 +1,214 @@
+// SQL tokenizer — native component of the planner frontend.
+//
+// Role parity: the tokenizer under the reference's Rust DaskParser
+// (src/parser.rs wraps sqlparser-rs).  Exposed through a C ABI consumed via
+// ctypes (planner/native_bridge.py); the token-stream contract matches
+// dask_sql_tpu/planner/lexer.py exactly (same types, same boundaries), so
+// the Python lexer remains a drop-in fallback.
+//
+// Build: see native/Makefile (g++ -O2 -shared -fPIC).
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+enum TokenType : int32_t {
+  TOK_IDENT = 0,
+  TOK_QUOTED_IDENT = 1,
+  TOK_NUMBER = 2,
+  TOK_STRING = 3,
+  TOK_OP = 4,
+  TOK_PUNCT = 5,
+  TOK_PARAM = 6,
+};
+
+inline bool is_space(char c) {
+  return c == ' ' || c == '\t' || c == '\n' || c == '\r' || c == '\f' || c == '\v';
+}
+inline bool is_digit(char c) { return c >= '0' && c <= '9'; }
+inline bool is_alpha(char c) {
+  return (c >= 'a' && c <= 'z') || (c >= 'A' && c <= 'Z') ||
+         static_cast<unsigned char>(c) >= 0x80;  // UTF-8 continuation-safe
+}
+inline bool is_ident_start(char c) { return is_alpha(c) || c == '_'; }
+inline bool is_ident_part(char c) {
+  return is_alpha(c) || is_digit(c) || c == '_' || c == '$';
+}
+
+inline bool is_one_char_op(char c) {
+  switch (c) {
+    case '+': case '-': case '*': case '/': case '%':
+    case '<': case '>': case '=': case '~':
+      return true;
+    default:
+      return false;
+  }
+}
+
+inline bool is_punct(char c) {
+  switch (c) {
+    case '(': case ')': case ',': case '.': case ';':
+    case '[': case ']': case '{': case '}': case ':': case '?':
+      return true;
+    default:
+      return false;
+  }
+}
+
+inline bool two_char_op(const char* s, int64_t n, int64_t i) {
+  if (i + 1 >= n) return false;
+  char a = s[i], b = s[i + 1];
+  return (a == '<' && b == '=') || (a == '>' && b == '=') ||
+         (a == '<' && b == '>') || (a == '!' && b == '=') ||
+         (a == '|' && b == '|') || (a == ':' && b == ':') ||
+         (a == '-' && b == '>');
+}
+
+}  // namespace
+
+extern "C" {
+
+// Tokenize `sql` (length n).  Writes up to `max_tokens` entries into the
+// parallel arrays (type, byte offset of the token *content*, content length).
+// For strings / quoted identifiers the offset+length cover the inner content
+// (without quotes, escapes left in place for the wrapper to fold).
+// Returns the token count, or -(errpos+1) on a lex error.
+int64_t dsql_tokenize(const char* sql, int64_t n, int32_t* types,
+                      int64_t* starts, int64_t* lens, int64_t max_tokens) {
+  int64_t count = 0;
+  int64_t i = 0;
+  while (i < n) {
+    char c = sql[i];
+    if (is_space(c)) {
+      ++i;
+      continue;
+    }
+    if (c == '-' && i + 1 < n && sql[i + 1] == '-') {  // line comment
+      while (i < n && sql[i] != '\n') ++i;
+      continue;
+    }
+    if (c == '/' && i + 1 < n && sql[i + 1] == '*') {  // block comment
+      int64_t j = i + 2;
+      while (j + 1 < n && !(sql[j] == '*' && sql[j + 1] == '/')) ++j;
+      if (j + 1 >= n) return -(i + 1);
+      i = j + 2;
+      continue;
+    }
+    if (count >= max_tokens) return -(i + 1);
+    if (c == '\'') {  // string literal with '' escapes
+      int64_t j = i + 1;
+      while (true) {
+        if (j >= n) return -(i + 1);
+        if (sql[j] == '\'') {
+          if (j + 1 < n && sql[j + 1] == '\'') {
+            j += 2;
+            continue;
+          }
+          break;
+        }
+        ++j;
+      }
+      types[count] = TOK_STRING;
+      starts[count] = i + 1;
+      lens[count] = j - (i + 1);
+      ++count;
+      i = j + 1;
+      continue;
+    }
+    if (c == '"' || c == '`') {  // quoted identifier
+      char quote = c;
+      int64_t j = i + 1;
+      while (true) {
+        if (j >= n) return -(i + 1);
+        if (sql[j] == quote) {
+          if (j + 1 < n && sql[j + 1] == quote) {
+            j += 2;
+            continue;
+          }
+          break;
+        }
+        ++j;
+      }
+      types[count] = TOK_QUOTED_IDENT;
+      starts[count] = i + 1;
+      lens[count] = j - (i + 1);
+      ++count;
+      i = j + 1;
+      continue;
+    }
+    if (is_digit(c) || (c == '.' && i + 1 < n && is_digit(sql[i + 1]))) {
+      int64_t j = i;
+      bool seen_dot = false, seen_exp = false;
+      while (j < n) {
+        char d = sql[j];
+        if (is_digit(d)) {
+          ++j;
+        } else if (d == '.' && !seen_dot && !seen_exp) {
+          seen_dot = true;
+          ++j;
+        } else if ((d == 'e' || d == 'E') && !seen_exp && j + 1 < n &&
+                   (is_digit(sql[j + 1]) || sql[j + 1] == '+' || sql[j + 1] == '-')) {
+          seen_exp = true;
+          j += (sql[j + 1] == '+' || sql[j + 1] == '-') ? 2 : 1;
+        } else {
+          break;
+        }
+      }
+      types[count] = TOK_NUMBER;
+      starts[count] = i;
+      lens[count] = j - i;
+      ++count;
+      i = j;
+      continue;
+    }
+    if (is_ident_start(c)) {
+      int64_t j = i;
+      while (j < n && is_ident_part(sql[j])) ++j;
+      types[count] = TOK_IDENT;
+      starts[count] = i;
+      lens[count] = j - i;
+      ++count;
+      i = j;
+      continue;
+    }
+    if (two_char_op(sql, n, i)) {
+      types[count] = TOK_OP;
+      starts[count] = i;
+      lens[count] = 2;
+      ++count;
+      i += 2;
+      continue;
+    }
+    if (is_one_char_op(c)) {
+      types[count] = TOK_OP;
+      starts[count] = i;
+      lens[count] = 1;
+      ++count;
+      ++i;
+      continue;
+    }
+    if (c == '?') {
+      types[count] = TOK_PARAM;
+      starts[count] = i;
+      lens[count] = 1;
+      ++count;
+      ++i;
+      continue;
+    }
+    if (is_punct(c)) {
+      types[count] = TOK_PUNCT;
+      starts[count] = i;
+      lens[count] = 1;
+      ++count;
+      ++i;
+      continue;
+    }
+    return -(i + 1);
+  }
+  return count;
+}
+
+int32_t dsql_tokenizer_abi_version() { return 1; }
+
+}  // extern "C"

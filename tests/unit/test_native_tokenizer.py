@@ -1,0 +1,53 @@
+"""Native C++ tokenizer vs Python lexer contract tests."""
+import pytest
+
+
+QUERIES = [
+    "SELECT a, b FROM t WHERE x >= 1.5e3 AND s <> 'it''s' -- comment\nORDER BY 1",
+    'SELECT "quoted col", `tick` FROM t /* block\ncomment */ LIMIT 5',
+    "SELECT x::DOUBLE, a || b, c -> d FROM t WHERE y BETWEEN .5 AND 2.",
+    "INSERT-free ; ? , ( ) [ ] { } : % ~",
+    "SELECT ünïcode_cöl FROM täble",
+]
+
+
+@pytest.fixture(scope="module")
+def native_available():
+    from dask_sql_tpu.planner.native_bridge import get_lib
+
+    lib = get_lib()
+    if lib is None:
+        pytest.skip("native library not built (g++ unavailable?)")
+    return lib
+
+
+@pytest.mark.parametrize("sql", QUERIES)
+def test_token_stream_matches_python(native_available, sql):
+    from dask_sql_tpu.planner.lexer import tokenize
+    from dask_sql_tpu.planner.native_bridge import native_tokenize
+
+    py_tokens = tokenize(sql)
+    c_tokens = native_tokenize(sql)
+    assert c_tokens is not None
+    assert len(c_tokens) == len(py_tokens)
+    for pt, ct in zip(py_tokens, c_tokens):
+        assert pt.type == ct.type, (pt, ct)
+        assert pt.value == ct.value, (pt, ct)
+
+
+def test_error_positions_match(native_available):
+    from dask_sql_tpu.planner.lexer import LexError, tokenize
+    from dask_sql_tpu.planner.native_bridge import native_tokenize
+
+    bad = "SELECT 'unterminated"
+    with pytest.raises(LexError):
+        tokenize(bad)
+    with pytest.raises(LexError):
+        native_tokenize(bad)
+
+
+def test_parser_uses_native(native_available):
+    from dask_sql_tpu.planner.parser import parse_sql
+
+    stmts = parse_sql("SELECT 1 AS x")
+    assert len(stmts) == 1
