@@ -1,0 +1,579 @@
+"""Compiled query pipelines: whole-subtree JIT for the hot aggregation shape.
+
+The eager converters dispatch one XLA op at a time; this module instead
+compiles a `TableScan -> [Filter/Projection]* -> Aggregate` subtree into ONE
+jitted function so XLA fuses the filter mask, the projection arithmetic and
+the segment reductions into a single pass over HBM.  The core trick for TPU
+(SURVEY.md §7 "dynamic shapes"): selection is *deferred* — the filter never
+compacts rows; its boolean mask is ANDed into each aggregate's validity mask,
+so every array keeps its static shape end-to-end and only the (tiny) group
+table is compacted on the host afterwards.
+
+Parity note: the reference has no analogue — dask fuses blockwise tasks but
+each kernel is still an interpreted pandas call; this is the TPU-native
+replacement for that entire execution layer.
+"""
+from __future__ import annotations
+
+import logging
+import re
+from typing import Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..columnar.column import Column
+from ..columnar.dtypes import (
+    DATETIME_TYPES,
+    FLOAT_TYPES,
+    INTEGER_TYPES,
+    INTERVAL_TYPES,
+    NUMERIC_TYPES,
+    STRING_TYPES,
+    SqlType,
+    sql_to_np,
+)
+from ..columnar.table import Table
+from ..ops import datetime as dt_ops
+from ..ops import strings as str_ops
+from ..planner import plan as p
+from ..planner.expressions import (
+    AggExpr,
+    CaseExpr,
+    Cast,
+    ColumnRef,
+    Expr,
+    InListExpr,
+    Literal,
+    ScalarFunc,
+    transform,
+    walk,
+)
+
+logger = logging.getLogger(__name__)
+
+_SUPPORTED_AGGS = {"sum", "count", "avg", "min", "max", "count_star",
+                   "var_samp", "var_pop", "stddev_samp", "stddev_pop"}
+
+_NUMERIC_BINOPS = {
+    "add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
+    "eq": jnp.equal, "ne": jnp.not_equal, "lt": jnp.less, "le": jnp.less_equal,
+    "gt": jnp.greater, "ge": jnp.greater_equal,
+}
+
+_MATH_UNARY = {
+    "abs": jnp.abs, "neg": jnp.negative, "sqrt": jnp.sqrt, "exp": jnp.exp,
+    "ln": jnp.log, "log10": jnp.log10, "log2": jnp.log2, "sin": jnp.sin,
+    "cos": jnp.cos, "tan": jnp.tan, "floor": jnp.floor, "ceil": jnp.ceil,
+    "sign": jnp.sign,
+}
+
+
+class _Unsupported(Exception):
+    pass
+
+
+class _TraceEval:
+    """Expression evaluator usable under jit tracing.
+
+    Values are (data, valid_or_None) pairs; string columns appear as their
+    integer dictionary codes with host-precomputed lookup tables for any
+    string-typed operation (computed at *compile* time from the concrete
+    dictionaries, entering the program as constants).
+    """
+
+    def __init__(self, table: Table):
+        self.table = table
+        self.names = table.column_names
+
+    def col(self, index: int) -> Column:
+        return self.table.columns[self.names[index]]
+
+    def eval(self, expr: Expr, slots):
+        if isinstance(expr, ColumnRef) and type(expr) is ColumnRef:
+            return slots[expr.index]
+        if isinstance(expr, Literal):
+            if expr.value is None:
+                return (jnp.zeros((), dtype=jnp.float64), jnp.zeros((), dtype=bool))
+            if expr.sql_type in STRING_TYPES:
+                raise _Unsupported("free string literal")
+            v = expr.value
+            dtype = sql_to_np(expr.sql_type)
+            return (jnp.asarray(v, dtype=dtype), None)
+        if isinstance(expr, Cast):
+            d, v = self.eval(expr.arg, slots)
+            src, dst = expr.arg.sql_type, expr.sql_type
+            if dst in STRING_TYPES or src in STRING_TYPES:
+                raise _Unsupported("string cast in compiled pipeline")
+            if src in FLOAT_TYPES and dst in INTEGER_TYPES:
+                d = jnp.nan_to_num(jnp.trunc(d))
+            if dst == SqlType.BOOLEAN:
+                return (d != 0, v)
+            return (d.astype(sql_to_np(dst)), v)
+        if isinstance(expr, CaseExpr):
+            out_d, out_v = (jnp.zeros((), dtype=sql_to_np(expr.sql_type)),
+                            jnp.zeros((), dtype=bool))
+            if expr.else_ is not None:
+                out_d, out_v = self.eval(expr.else_, slots)
+            for cond, val in reversed(expr.whens):
+                cd, cv = self.eval(cond, slots)
+                take = cd if cv is None else (cd & cv)
+                vd, vv = self.eval(val, slots)
+                out_d = jnp.where(take, vd, out_d)
+                if vv is None and out_v is None:
+                    out_v = None
+                else:
+                    vv_ = jnp.ones_like(take) if vv is None else vv
+                    ov_ = jnp.ones_like(take) if out_v is None else out_v
+                    out_v = jnp.where(take, vv_, ov_)
+            return (out_d, out_v)
+        if isinstance(expr, InListExpr):
+            return self._in_list(expr, slots)
+        if isinstance(expr, ScalarFunc):
+            return self._call(expr, slots)
+        raise _Unsupported(f"expr {type(expr).__name__}")
+
+    # -- compile-time string handling --------------------------------------
+    def _string_source(self, expr: Expr) -> Optional[Column]:
+        if isinstance(expr, ColumnRef) and type(expr) is ColumnRef:
+            c = self.col(expr.index)
+            if c.sql_type in STRING_TYPES:
+                return c
+        return None
+
+    def _in_list(self, expr: InListExpr, slots):
+        src = self._string_source(expr.arg)
+        if src is not None:
+            # membership via a host-built boolean LUT over the dictionary
+            values = {it.value for it in expr.items
+                      if isinstance(it, Literal) and it.value is not None}
+            if not all(isinstance(it, Literal) for it in expr.items):
+                raise _Unsupported("non-literal IN list")
+            d = src.dictionary if src.dictionary is not None else np.array([""], dtype=object)
+            lut = jnp.asarray(np.isin(d.astype(str), list(values)))
+            codes, valid = slots[expr.arg.index]
+            hit = lut[jnp.clip(codes, 0, len(d) - 1)]
+            if expr.negated:
+                hit = ~hit
+            return (hit, valid)
+        ad, av = self.eval(expr.arg, slots)
+        hit = jnp.zeros_like(ad, dtype=bool)
+        for it in expr.items:
+            if not isinstance(it, Literal):
+                raise _Unsupported("non-literal IN list")
+            if it.value is None:
+                continue
+            hit = hit | (ad == jnp.asarray(it.value, dtype=ad.dtype))
+        if expr.negated:
+            hit = ~hit
+        return (hit, av)
+
+    def _call(self, expr: ScalarFunc, slots):
+        op = expr.op
+        args = expr.args
+
+        # string comparisons / LIKE against literals via dictionary LUTs
+        if op in ("eq", "ne", "like", "ilike", "similar") and len(args) >= 2:
+            src = self._string_source(args[0])
+            lit = args[1]
+            if src is not None and isinstance(lit, Literal) and isinstance(lit.value, str):
+                d = src.dictionary if src.dictionary is not None else np.array([""], dtype=object)
+                if op in ("eq", "ne"):
+                    lut = jnp.asarray(d.astype(str) == lit.value)
+                else:
+                    esc = None
+                    if len(args) > 2 and isinstance(args[2], Literal):
+                        esc = args[2].value
+                    pat = (str_ops.similar_to_regex(lit.value, esc) if op == "similar"
+                           else str_ops.like_to_regex(lit.value, esc))
+                    rx = re.compile(pat, re.IGNORECASE if op == "ilike" else 0)
+                    lut = jnp.asarray(np.array([rx.match(str(x)) is not None for x in d]))
+                codes, valid = slots[args[0].index]
+                hit = lut[jnp.clip(codes, 0, len(d) - 1)]
+                if op == "ne":
+                    hit = ~hit
+                return (hit, valid)
+
+        vals = [self.eval(a, slots) for a in args]
+        if op in _NUMERIC_BINOPS:
+            (ad, av), (bd, bv) = vals
+            if _is_string_typed(args[0]) or _is_string_typed(args[1]):
+                raise _Unsupported(f"string {op}")
+            ad, bd = _promote_pair(ad, bd)
+            return (_NUMERIC_BINOPS[op](ad, bd), _and_valid(av, bv))
+        if op == "div":
+            (ad, av), (bd, bv) = vals
+            ad, bd = _promote_pair(ad, bd)
+            if jnp.issubdtype(ad.dtype, jnp.integer):
+                safe = jnp.where(bd == 0, 1, bd)
+                q = jnp.floor_divide(jnp.abs(ad), jnp.abs(safe))
+                q = jnp.where((ad < 0) ^ (bd < 0), -q, q)
+                return (q, _and_valid(av, bv, bd != 0))
+            return (ad / bd, _and_valid(av, bv))
+        if op == "mod":
+            (ad, av), (bd, bv) = vals
+            ad, bd = _promote_pair(ad, bd)
+            safe = jnp.where(bd == 0, 1, bd) if jnp.issubdtype(ad.dtype, jnp.integer) else bd
+            return (jnp.fmod(ad, safe), _and_valid(av, bv))
+        if op == "and":
+            (ad, av), (bd, bv) = vals
+            a_t = ad if av is None else (ad & av)
+            b_t = bd if bv is None else (bd & bv)
+            value = a_t & b_t
+            av_ = jnp.ones_like(ad) if av is None else av
+            bv_ = jnp.ones_like(bd) if bv is None else bv
+            known = (av_ & bv_) | (av_ & ~ad) | (bv_ & ~bd)
+            return (value, known)
+        if op == "or":
+            (ad, av), (bd, bv) = vals
+            a_t = ad if av is None else (ad & av)
+            b_t = bd if bv is None else (bd & bv)
+            value = a_t | b_t
+            av_ = jnp.ones_like(ad) if av is None else av
+            bv_ = jnp.ones_like(bd) if bv is None else bv
+            known = (av_ & bv_) | (av_ & ad) | (bv_ & bd)
+            return (value, known)
+        if op == "not":
+            (ad, av) = vals[0]
+            return (~ad, av)
+        if op == "is_null":
+            (ad, av) = vals[0]
+            if av is None:
+                base = jnp.zeros_like(ad, dtype=bool)
+            else:
+                base = ~av
+            if jnp.issubdtype(ad.dtype, jnp.floating):
+                base = base | jnp.isnan(ad)
+            return (base, None)
+        if op == "is_not_null":
+            d, _ = self._call(ScalarFunc("is_null", expr.args, SqlType.BOOLEAN), slots)
+            return (~d, None)
+        if op in ("is_true", "is_false", "is_not_true", "is_not_false"):
+            (ad, av) = vals[0]
+            av_ = jnp.ones_like(ad) if av is None else av
+            t = ad & av_
+            f = ~ad & av_
+            out = {"is_true": t, "is_false": f, "is_not_true": ~t, "is_not_false": ~f}[op]
+            return (out, None)
+        if op in _MATH_UNARY:
+            (ad, av) = vals[0]
+            x = ad.astype(jnp.float64) if op not in ("abs", "neg", "sign") else ad
+            return (_MATH_UNARY[op](x), av)
+        if op.startswith("extract_"):
+            (ad, av) = vals[0]
+            return (dt_ops.extract(op[8:], ad), av)
+        if op == "datetime_add":
+            (ad, av), (bd, bv) = vals
+            if args[1].sql_type == SqlType.INTERVAL_YEAR_MONTH:
+                return (dt_ops.add_months(ad, bd), _and_valid(av, bv))
+            return (ad + bd, _and_valid(av, bv))
+        if op == "datetime_sub_interval":
+            (ad, av), (bd, bv) = vals
+            if args[1].sql_type == SqlType.INTERVAL_YEAR_MONTH:
+                return (dt_ops.add_months(ad, -bd), _and_valid(av, bv))
+            return (ad - bd, _and_valid(av, bv))
+        if op == "datetime_sub":
+            (ad, av), (bd, bv) = vals
+            return (ad - bd, _and_valid(av, bv))
+        if op == "int_to_interval_days":
+            (ad, av) = vals[0]
+            return (ad.astype(jnp.int64) * dt_ops.NS_PER_DAY, av)
+        if op in ("datetime_floor", "datetime_ceil"):
+            (ad, av) = vals[0]
+            unit = args[1].value if isinstance(args[1], Literal) else None
+            if unit is None:
+                raise _Unsupported("dynamic truncation unit")
+            fn = dt_ops.truncate if op == "datetime_floor" else dt_ops.ceil_to
+            return (fn(str(unit), ad), av)
+        if op == "coalesce":
+            out_d, out_v = vals[-1]
+            for d, v in reversed(vals[:-1]):
+                if v is None:
+                    return (d, None)
+                out_v_ = jnp.zeros_like(v) if out_v is None else out_v
+                out_d = jnp.where(v, d, out_d)
+                out_v = v | out_v_
+            return (out_d, out_v)
+        raise _Unsupported(f"op {op}")
+
+
+def _is_string_typed(e: Expr) -> bool:
+    return e.sql_type in STRING_TYPES
+
+
+def _promote_pair(a, b):
+    dt = jnp.promote_types(a.dtype, b.dtype)
+    return a.astype(dt), b.astype(dt)
+
+
+def _and_valid(*vs):
+    out = None
+    for v in vs:
+        if v is None:
+            continue
+        out = v if out is None else (out & v)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Pipeline extraction: Aggregate <- [Filter/Projection]* <- TableScan
+# ---------------------------------------------------------------------------
+def _extract_chain(agg: p.Aggregate):
+    """Substitute projections so group/agg/filter exprs are all over the scan
+    schema.  Returns (scan, filters, group_exprs, agg_exprs) or None."""
+    # walk the chain top-down, remembering each node's position
+    chain: List[p.LogicalPlan] = []
+    node = agg.input
+    while True:
+        if isinstance(node, p.Projection):
+            if any(isinstance(x, AggExpr) for e in node.exprs for x in walk(e)):
+                return None
+            chain.append(node)
+            node = node.input
+        elif isinstance(node, (p.Filter, p.SubqueryAlias)):
+            chain.append(node)
+            node = node.input
+        elif isinstance(node, p.TableScan):
+            break
+        else:
+            return None
+    scan = node
+
+    def subst_below(expr: Expr, pos: int) -> Expr:
+        """Rewrite an expression bound at chain[pos]'s *input* onto the scan
+        schema by folding in every projection below that point."""
+        for lower in chain[pos:]:
+            if not isinstance(lower, p.Projection):
+                continue
+
+            def fn(x, proj=lower):
+                if isinstance(x, ColumnRef) and type(x) is ColumnRef:
+                    return proj.exprs[x.index]
+                return x
+
+            expr = transform(expr, fn)
+        return expr
+
+    filters: List[Expr] = []
+    for i, n_ in enumerate(chain):
+        if isinstance(n_, p.Filter):
+            filters.append(subst_below(n_.predicate, i + 1))
+    group_exprs = [subst_below(e, 0) for e in agg.group_exprs]
+    agg_exprs = []
+    for a in agg.agg_exprs:
+        new_args = tuple(subst_below(x, 0) for x in a.args)
+        new_filter = subst_below(a.filter, 0) if a.filter is not None else None
+        from dataclasses import replace as _rp
+
+        agg_exprs.append(_rp(a, args=new_args, filter=new_filter))
+    filters = filters + list(scan.filters)
+    return scan, filters, group_exprs, agg_exprs
+
+
+class CompiledAggregate:
+    """One compiled scan→aggregate pipeline bound to a concrete input table."""
+
+    def __init__(self, agg: p.Aggregate, table: Table, scan, filters,
+                 group_exprs, agg_exprs):
+        self.agg = agg
+        self.table = table
+        self.filters = filters
+        self.group_exprs = group_exprs
+        self.agg_exprs = agg_exprs
+        ev = _TraceEval(table)
+
+        # radix group-id plan (compile-time): group keys must be dict/bool cols
+        radices = []
+        gcols: List[Column] = []
+        for e in group_exprs:
+            if not (isinstance(e, ColumnRef) and type(e) is ColumnRef):
+                raise _Unsupported("non-column group key")
+            c = ev.col(e.index)
+            if c.sql_type in STRING_TYPES and c.dictionary is not None:
+                radices.append(len(c.dictionary) + 1)
+            elif c.data.dtype == jnp.bool_:
+                radices.append(3)
+            else:
+                raise _Unsupported("non-dictionary group key")
+            gcols.append(c)
+        domain = 1
+        for r in radices:
+            domain *= r
+        if domain > (1 << 22):
+            raise _Unsupported("group domain too large")
+        self.domain = max(domain, 1)
+        self.radices = radices
+        self.gcols = gcols
+        for a in agg_exprs:
+            if a.func not in _SUPPORTED_AGGS or a.distinct:
+                raise _Unsupported(f"agg {a.func}")
+            for x in list(a.args) + ([a.filter] if a.filter is not None else []):
+                for sub in walk(x):
+                    if isinstance(sub, AggExpr) and sub is not x:
+                        raise _Unsupported("nested agg")
+
+        self._fn = jax.jit(self._build())
+        # warm the cache is left to the caller; tracing happens on first call
+
+    def _build(self) -> Callable:
+        ev = _TraceEval(self.table)
+        group_refs = [e.index for e in self.group_exprs]
+        filters = self.filters
+        agg_exprs = self.agg_exprs
+        radices = self.radices
+        domain = self.domain
+        n_cols = len(self.table.column_names)
+
+        def fn(datas, valids):
+            slots = {i: (datas[i], valids[i]) for i in range(n_cols)}
+            # selection mask (never compacts — static shapes end to end)
+            mask = None
+            for f in filters:
+                d, v = ev.eval(f, slots)
+                m = d if v is None else (d & v)
+                mask = m if mask is None else (mask & m)
+            gid = jnp.zeros((), dtype=jnp.int64)
+            first = True
+            for idx, r in zip(group_refs, radices):
+                codes, valid = slots[idx]
+                codes = codes.astype(jnp.int64)
+                codes = jnp.clip(codes, 0, r - 2)
+                if valid is not None:
+                    codes = jnp.where(valid, codes, r - 1)
+                gid = codes if first else gid * r + codes
+                first = False
+            if first:
+                gid = jnp.zeros(datas[0].shape[0] if datas else 1, dtype=jnp.int64)
+            sel = mask if mask is not None else jnp.ones(gid.shape[0], dtype=bool)
+            hit = jax.ops.segment_sum(sel.astype(jnp.int32), gid, domain) > 0
+            outs = []
+            for a in agg_exprs:
+                valid = sel
+                if a.filter is not None:
+                    fd, fv = ev.eval(a.filter, slots)
+                    fm = fd if fv is None else (fd & fv)
+                    valid = valid & fm
+                if a.func == "count_star":
+                    outs.append((jax.ops.segment_sum(
+                        valid.astype(jnp.int64), gid, domain), None))
+                    continue
+                ad, av = ev.eval(a.args[0], slots)
+                v = valid if av is None else (valid & av)
+                if jnp.issubdtype(ad.dtype, jnp.floating):
+                    v = v & ~jnp.isnan(ad)
+                cnt = jax.ops.segment_sum(v.astype(jnp.int64), gid, domain)
+                if a.func == "count":
+                    outs.append((cnt, None))
+                    continue
+                if a.func in ("sum", "avg"):
+                    acc = ad.astype(jnp.int64) if jnp.issubdtype(ad.dtype, jnp.integer) else ad
+                    s = jax.ops.segment_sum(jnp.where(v, acc, jnp.zeros_like(acc)),
+                                            gid, domain)
+                    if a.func == "avg":
+                        outs.append((s.astype(jnp.float64) / jnp.maximum(cnt, 1), cnt > 0))
+                    else:
+                        outs.append((s, cnt > 0))
+                    continue
+                if a.func in ("min", "max"):
+                    if jnp.issubdtype(ad.dtype, jnp.floating):
+                        fill = jnp.array(jnp.inf if a.func == "min" else -jnp.inf,
+                                         dtype=ad.dtype)
+                    else:
+                        info = jnp.iinfo(ad.dtype)
+                        fill = jnp.array(info.max if a.func == "min" else info.min,
+                                         dtype=ad.dtype)
+                    contrib = jnp.where(v, ad, fill)
+                    red = (jax.ops.segment_min if a.func == "min"
+                           else jax.ops.segment_max)(contrib, gid, domain)
+                    outs.append((jnp.where(cnt > 0, red, jnp.zeros_like(red)), cnt > 0))
+                    continue
+                # variance family
+                x = ad.astype(jnp.float64)
+                s1 = jax.ops.segment_sum(jnp.where(v, x, 0.0), gid, domain)
+                s2 = jax.ops.segment_sum(jnp.where(v, x * x, 0.0), gid, domain)
+                ddof = 1 if a.func.endswith("samp") else 0
+                mean = s1 / jnp.maximum(cnt, 1)
+                var = jnp.maximum(s2 - cnt * mean * mean, 0.0) / jnp.maximum(cnt - ddof, 1)
+                out = jnp.sqrt(var) if a.func.startswith("stddev") else var
+                outs.append((out, cnt > ddof))
+            flat = [hit]
+            for d, v in outs:
+                flat.append(d)
+                flat.append(v if v is not None else jnp.ones_like(hit))
+            return tuple(flat)
+
+        return fn
+
+    def run(self) -> Table:
+        datas = [self.table.columns[n].data for n in self.table.column_names]
+        valids = [self.table.columns[n].validity for n in self.table.column_names]
+        flat = self._fn(tuple(datas), tuple(valids))
+        hit = flat[0]
+        present = jnp.nonzero(hit)[0]
+        from ..physical.rel.base import unique_names
+
+        names = unique_names([f.name for f in self.agg.schema])
+        out: Dict[str, Column] = {}
+        # decode group keys from the radix id
+        strides = []
+        s = 1
+        for r in reversed(self.radices):
+            strides.append(s)
+            s *= r
+        strides = list(reversed(strides))
+        for name, col, r, stride in zip(names, self.gcols, self.radices, strides):
+            code = (present // stride) % r
+            is_null = code == (r - 1)
+            validity = ~is_null if bool(is_null.any()) else None
+            code = jnp.minimum(code, r - 2)
+            if col.sql_type in STRING_TYPES:
+                out[name] = Column(code.astype(jnp.int32), col.sql_type, validity,
+                                   col.dictionary)
+            else:
+                out[name] = Column(code == 1, col.sql_type, validity)
+        for i, (a, f) in enumerate(zip(self.agg_exprs,
+                                       self.agg.schema[len(self.gcols):])):
+            d = flat[1 + 2 * i][present]
+            v = flat[2 + 2 * i][present]
+            target = sql_to_np(a.sql_type)
+            d = d.astype(target) if d.dtype != target else d
+            validity = None if bool(v.all()) else v
+            out[names[len(self.gcols) + i]] = Column(d, a.sql_type, validity)
+        return Table(out, int(present.shape[0]))
+
+
+_cache: Dict[Tuple, CompiledAggregate] = {}
+
+
+def try_compiled_aggregate(rel: p.Aggregate, executor) -> Optional[Table]:
+    """Attempt the compiled path for an Aggregate subtree; None to fall back."""
+    if not executor.config.get("sql.compile", True):
+        return None
+    chain = _extract_chain(rel)
+    if chain is None:
+        return None
+    scan, filters, group_exprs, agg_exprs = chain
+    try:
+        table = executor.get_table(scan.schema_name, scan.table_name)
+        if scan.projection is not None:
+            table = table.select(scan.projection)
+        key = (
+            id(executor.context.schema[scan.schema_name].tables.get(scan.table_name)),
+            scan.schema_name, scan.table_name,
+            tuple(scan.projection or ()),
+            tuple(str(f) for f in filters),
+            tuple(str(e) for e in group_exprs),
+            tuple(str(a) for a in agg_exprs),
+            table.num_rows,
+        )
+        compiled = _cache.get(key)
+        if compiled is None:
+            compiled = CompiledAggregate(rel, table, scan, filters, group_exprs, agg_exprs)
+            _cache[key] = compiled
+        else:
+            compiled.table = table
+        return compiled.run()
+    except _Unsupported as e:
+        logger.debug("compiled pipeline unsupported: %s", e)
+        return None

@@ -34,6 +34,11 @@ class AggregatePlugin(BaseRelPlugin):
     class_name = "Aggregate"
 
     def convert(self, rel: p.Aggregate, executor) -> Table:
+        from ...compiled import try_compiled_aggregate
+
+        compiled = try_compiled_aggregate(rel, executor)
+        if compiled is not None:
+            return compiled
         (inp,) = self.assert_inputs(rel, 1, executor)
         n = inp.num_rows
 
