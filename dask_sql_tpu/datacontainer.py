@@ -71,12 +71,19 @@ class ColumnContainer:
         return self.rename_handle_duplicates(self._frontend_columns, new_names)
 
 
+import itertools as _itertools
+
+_dc_serial = _itertools.count()
+
+
 class DataContainer:
     """A device Table + its frontend column view."""
 
     def __init__(self, table: Table, column_container: Optional[ColumnContainer] = None):
         self.table = table
         self.column_container = column_container or ColumnContainer(table.column_names)
+        #: unique serial for compile-cache keys (id() can be recycled)
+        self.uid = next(_dc_serial)
 
     @property
     def df(self) -> Table:  # parity name: reference stores the dask df as .df

@@ -558,8 +558,11 @@ def try_compiled_aggregate(rel: p.Aggregate, executor) -> Optional[Table]:
         table = executor.get_table(scan.schema_name, scan.table_name)
         if scan.projection is not None:
             table = table.select(scan.projection)
+        dc = executor.context.schema[scan.schema_name].tables.get(scan.table_name)
+        if dc is None:
+            return None  # view-backed scans take the eager path
         key = (
-            id(executor.context.schema[scan.schema_name].tables.get(scan.table_name)),
+            dc.uid,
             scan.schema_name, scan.table_name,
             tuple(scan.projection or ()),
             tuple(str(f) for f in filters),
