@@ -424,6 +424,7 @@ class CompiledAggregate:
         radices = self.radices
         domain = self.domain
         n_cols = len(self.table.column_names)
+        n_rows = self.table.num_rows
 
         def fn(datas, valids):
             slots = {i: (datas[i], valids[i]) for i in range(n_cols)}
@@ -444,8 +445,8 @@ class CompiledAggregate:
                 gid = codes if first else gid * r + codes
                 first = False
             if first:
-                gid = jnp.zeros(datas[0].shape[0] if datas else 1, dtype=jnp.int64)
-            sel = mask if mask is not None else jnp.ones(gid.shape[0], dtype=bool)
+                gid = jnp.zeros(n_rows, dtype=jnp.int64)
+            sel = mask if mask is not None else jnp.ones(n_rows, dtype=bool)
             hit = jax.ops.segment_sum(sel.astype(jnp.int32), gid, domain) > 0
             outs = []
             for a in agg_exprs:
