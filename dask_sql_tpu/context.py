@@ -141,8 +141,15 @@ class Context:
         dc = InputUtil.to_dc(input_table, table_name, format=format,
                              persist=persist, **kwargs)
         self.schema[schema_name].tables[table_name] = dc
-        if statistics is None and dc.table.num_rows:
-            statistics = Statistics(float(dc.table.num_rows))
+        from .datacontainer import LazyParquetContainer
+
+        if statistics is None:
+            if isinstance(dc, LazyParquetContainer):
+                # footer row counts, no data scan (parity: context.py:281-289)
+                if dc.statistics and dc.statistics.get("num-rows"):
+                    statistics = Statistics(float(dc.statistics["num-rows"]))
+            elif dc.table.num_rows:
+                statistics = Statistics(float(dc.table.num_rows))
         if statistics is not None:
             self.schema[schema_name].statistics[table_name] = statistics
         filepath = getattr(dc, "filepath", None)
@@ -335,11 +342,16 @@ class Context:
             catalog.add_schema(schema_name)
             cschema = catalog.schemas[schema_name]
             for table_name, dc in container.tables.items():
-                fields = [
-                    Field(name, col.sql_type, col.validity is not None or
-                          col.sql_type in (SqlType.FLOAT, SqlType.DOUBLE))
-                    for name, col in dc.table.columns.items()
-                ]
+                from .datacontainer import LazyParquetContainer
+
+                if isinstance(dc, LazyParquetContainer):
+                    fields = list(dc.fields)
+                else:
+                    fields = [
+                        Field(name, col.sql_type, col.validity is not None or
+                              col.sql_type in (SqlType.FLOAT, SqlType.DOUBLE))
+                        for name, col in dc.table.columns.items()
+                    ]
                 stats = container.statistics.get(table_name)
                 from .planner.catalog import Statistics as PStats
 

@@ -102,6 +102,50 @@ class DataContainer:
         return self.assign().to_pandas()
 
 
+class LazyParquetContainer(DataContainer):
+    """Location-backed table that stays on disk until scanned.
+
+    Parity: the reference's non-persisted location tables (input_utils
+    convert.py:70: `persist=False` keeps the dask read graph lazy, letting
+    `filters=` pushdown reach pyarrow).  `scan()` reads only the projected
+    columns with row-group filters — the IO half of predicate pushdown.
+    """
+
+    def __init__(self, location: str, fields, statistics=None, file_format: str = "parquet"):
+        self.location = location
+        self.file_format = file_format
+        self.fields = list(fields)
+        self.statistics = statistics
+        self._table: Optional[Table] = None
+        self.column_container = ColumnContainer([f.name for f in self.fields])
+        self.uid = next(_dc_serial)
+
+    @property
+    def table(self) -> Table:
+        if self._table is None:
+            self._table = self.scan()
+        return self._table
+
+    @table.setter
+    def table(self, value):  # pragma: no cover - compat shim
+        self._table = value
+
+    def scan(self, columns=None, filters=None) -> Table:
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        from .physical.utils.statistics import _paths_for
+
+        paths = _paths_for(self.location)
+        tables = [pq.read_table(p, columns=list(columns) if columns else None,
+                                filters=filters) for p in paths]
+        at = pa.concat_tables(tables) if len(tables) > 1 else tables[0]
+        return Table.from_arrow(at)
+
+    def assign(self) -> Table:
+        return self.table
+
+
 @dataclass
 class SchemaContainer:
     """Parity: reference SchemaContainer (datacontainer.py:281)."""

@@ -43,7 +43,8 @@ class InputUtil:
             except Exception:
                 matches = False
             if matches:
-                dc = plugin.to_dc(input_item, table_name, format=format, **kwargs)
+                dc = plugin.to_dc(input_item, table_name, format=format,
+                                  persist=persist, **kwargs)
                 dc.filepath = filepath  # plan-time pruning hook (DaskTable.filepath parity)
                 return dc
         raise ValueError(f"Do not understand the input type {type(input_item)}")

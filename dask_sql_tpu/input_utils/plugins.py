@@ -96,7 +96,18 @@ class LocationInputPlugin(BaseInputPlugin):
         if not paths:
             raise FileNotFoundError(input_item)
         if fmt in ("parquet", "pq"):
-            return self._read_parquet(paths, **kwargs)
+            if not kwargs.get("persist", True):
+                # lazy registration: footers only; IO happens at scan time
+                # with projection + row-group filters (predicate pushdown)
+                from ..datacontainer import LazyParquetContainer
+                from ..physical.utils.statistics import (parquet_schema_fields,
+                                                         parquet_statistics)
+
+                fields = parquet_schema_fields(input_item)
+                stats = parquet_statistics(input_item)
+                return LazyParquetContainer(input_item, fields, stats)
+            return self._read_parquet(paths, **{k: v for k, v in kwargs.items()
+                                                if k != "persist"})
         if fmt == "csv":
             return self._read_csv(paths, **kwargs)
         if fmt == "json":

@@ -28,9 +28,24 @@ class TableScanPlugin(BaseRelPlugin):
     class_name = "TableScan"
 
     def convert(self, rel: p.TableScan, executor) -> Table:
-        table = executor.get_table(rel.schema_name, rel.table_name)
-        if rel.projection is not None:
-            table = table.select(rel.projection)
+        from ....datacontainer import LazyParquetContainer
+
+        dc = executor.context.schema.get(rel.schema_name)
+        dc = dc.tables.get(rel.table_name) if dc is not None else None
+        if isinstance(dc, LazyParquetContainer):
+            # lazy parquet: read only projected columns; convertible filter
+            # conjuncts prune row groups at the IO layer (pyarrow `filters=`,
+            # parity: reference table_scan.py:80-119 DNF pushdown)
+            from ....physical.utils.filter import filters_to_pyarrow
+
+            names = rel.projection if rel.projection is not None else [
+                f.name for f in dc.fields]
+            pa_filters, _ = filters_to_pyarrow(rel.filters, list(names))
+            table = dc.scan(columns=rel.projection, filters=pa_filters)
+        else:
+            table = executor.get_table(rel.schema_name, rel.table_name)
+            if rel.projection is not None:
+                table = table.select(rel.projection)
         if rel.filters:
             # filters are bound against the *projected* schema
             mask = None
