@@ -24,6 +24,7 @@ from ..columnar.dtypes import (
     SqlType,
     parse_sql_type,
     promote,
+    similar_type,
 )
 from . import plan as p
 from . import sqlast as a
@@ -934,6 +935,20 @@ class _OuterRef(ColumnRef):
 
 def _has_unresolved(e: Expr) -> bool:
     return any(isinstance(x, _OuterRef) for x in walk(e))
+
+
+def _pick_overload(fns, args):
+    """Choose the registered overload whose arity matches (parity: the
+    reference's DaskFunction signature map, function.rs)."""
+    n = len(args)
+    exact = [fd for fd in fns if len(fd.parameters) == n]
+    if exact:
+        # prefer type-compatible signatures
+        for fd in exact:
+            if all(similar_type(a.sql_type, p_[1]) for a, p_ in zip(args, fd.parameters)):
+                return fd
+        return exact[0]
+    return fns[0]
 
 
 def _split_alias(alias):

@@ -1,0 +1,64 @@
+"""Shared utilities (parity: reference dask_sql/utils.py — Pluggable registry
+base utils.py:61, convert_sql_kwargs utils.py:144, LoggableDataFrame
+utils.py:121-141, new_temporary_column)."""
+from __future__ import annotations
+
+import uuid
+from typing import Any, Dict
+
+
+class Pluggable:
+    """Registry base: subclasses share a class-level plugin dict."""
+
+    __plugins: Dict[type, Dict[str, Any]] = {}
+
+    @classmethod
+    def add_plugin(cls, name: str, plugin: Any, replace: bool = True) -> None:
+        registry = Pluggable.__plugins.setdefault(cls, {})
+        if name in registry and not replace:
+            return
+        registry[name] = plugin
+
+    @classmethod
+    def get_plugin(cls, name: str) -> Any:
+        return Pluggable.__plugins.setdefault(cls, {})[name]
+
+    @classmethod
+    def get_plugins(cls):
+        return list(Pluggable.__plugins.setdefault(cls, {}).values())
+
+
+def convert_sql_kwargs(sql_kwargs) -> Dict[str, Any]:
+    """Normalize parsed WITH(...) kwargs (nested maps/lists/scalars) into
+    plain python values (parity: utils.py:144)."""
+    if isinstance(sql_kwargs, dict):
+        return {k: convert_sql_kwargs(v) for k, v in sql_kwargs.items()}
+    if isinstance(sql_kwargs, (list, tuple)):
+        return [convert_sql_kwargs(v) for v in sql_kwargs]
+    return sql_kwargs
+
+
+def new_temporary_column(table) -> str:
+    """Unique backend column name (parity: utils.py new_temporary_column)."""
+    while True:
+        name = f"__tmp_{uuid.uuid4().hex[:12]}"
+        if name not in getattr(table, "columns", {}):
+            return name
+
+
+class LoggableDataFrame:
+    """Lazy repr wrapper so logging never materializes a frame
+    (parity: utils.py:121-141)."""
+
+    def __init__(self, df):
+        self.df = df
+
+    def __str__(self):
+        df = self.df
+        if hasattr(df, "column_names"):
+            return f"Table[{getattr(df, 'num_rows', '?')} rows, cols={df.column_names}]"
+        if hasattr(df, "columns"):
+            return f"DataFrame[cols={list(df.columns)}]"
+        return f"{type(df).__name__}"
+
+    __repr__ = __str__

@@ -1,0 +1,70 @@
+"""UDF / UDAF registration tests (parity: reference test_function.py)."""
+import numpy as np
+import pandas as pd
+import pytest
+
+from tests.utils import assert_eq
+
+
+def test_scalar_udf(c, df):
+    def f(x):
+        return x ** 2
+
+    c.register_function(f, "f", [("x", np.float64)], np.float64)
+    result = c.sql("SELECT f(b) AS r FROM df").compute()
+    np.testing.assert_allclose(result["r"], df.b ** 2)
+
+def test_udf_two_args(c, df):
+    def g(x, y):
+        return x + 10 * y
+
+    c.register_function(g, "g", [("x", np.float64), ("y", np.float64)], np.float64)
+    result = c.sql("SELECT g(a, b) AS r FROM df").compute()
+    np.testing.assert_allclose(result["r"], df.a + 10 * df.b)
+
+def test_udf_in_where_and_groupby(c, df):
+    def h(x):
+        return x * 2
+
+    c.register_function(h, "h", [("x", np.float64)], np.float64)
+    result = c.sql("SELECT SUM(h(b)) AS s FROM df WHERE h(a) > 2").compute()
+    sel = df[df.a * 2 > 2]
+    np.testing.assert_allclose(result["s"][0], (sel.b * 2).sum())
+
+def test_udf_replace_and_overload_guard(c):
+    def f1(x):
+        return x + 1
+
+    c.register_function(f1, "dup", [("x", np.float64)], np.float64)
+    with pytest.raises(ValueError):
+        c.register_function(f1, "dup", [("x", np.float64)], np.float64)
+    c.register_function(f1, "dup", [("x", np.float64)], np.float64, replace=True)
+
+def test_row_udf(c, df):
+    def row_f(row):
+        return row["x"] + row["y"]
+
+    c.register_function(row_f, "row_f", [("x", np.float64), ("y", np.float64)],
+                        np.float64, row_udf=True)
+    result = c.sql("SELECT row_f(a, b) AS r FROM df").compute()
+    np.testing.assert_allclose(result["r"], df.a + df.b)
+
+def test_udaf(c, df):
+    def my_range(grouped):
+        return grouped.max() - grouped.min()
+
+    c.register_aggregation(my_range, "my_range", [("x", np.float64)], np.float64)
+    result = c.sql("SELECT a, my_range(b) AS r FROM df GROUP BY a").compute()
+    expected = (df.groupby("a").b.max() - df.groupby("a").b.min()).reset_index(name="r")
+    assert_eq(result.sort_values("a").reset_index(drop=True),
+              expected, check_dtype=False, check_names=False)
+
+def test_jax_traceable_udf(c, df):
+    import jax.numpy as jnp
+
+    def smooth(x):
+        return jnp.tanh(x / 10.0)
+
+    c.register_function(smooth, "smooth", [("x", np.float64)], np.float64)
+    result = c.sql("SELECT smooth(b) AS r FROM df").compute()
+    np.testing.assert_allclose(result["r"], np.tanh(df.b / 10.0), rtol=1e-12)

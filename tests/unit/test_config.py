@@ -1,0 +1,43 @@
+"""Config system tests (parity: reference tests/unit/test_config.py)."""
+import pytest
+
+
+def test_defaults_present():
+    from dask_sql_tpu import config
+
+    assert config.get("sql.identifier.case_sensitive") is True
+    assert config.get("sql.optimize") is True
+    assert config.get("sql.sort.topk-nelem-limit") == 1000000
+    assert config.get("sql.predicate_pushdown") is True
+    assert config.get("sql.dynamic_partition_pruning") is True
+    assert config.get("sql.optimizer.fact_dimension_ratio") == 0.7
+
+
+def test_set_context_manager():
+    from dask_sql_tpu import config
+
+    assert config.get("sql.optimize") is True
+    with config.set({"sql.optimize": False}):
+        assert config.get("sql.optimize") is False
+        with config.set({"sql.optimize": True}):
+            assert config.get("sql.optimize") is True
+        assert config.get("sql.optimize") is False
+    assert config.get("sql.optimize") is True
+
+
+def test_unknown_key_default():
+    from dask_sql_tpu import config
+
+    assert config.get("sql.not-a-key", 42) == 42
+
+
+def test_per_query_config_options():
+    import pandas as pd
+
+    from dask_sql_tpu import Context
+
+    c = Context()
+    c.create_table("t", pd.DataFrame({"a": [1, 2, 3]}))
+    result = c.sql("SELECT SUM(a) AS s FROM t",
+                   config_options={"sql.optimize": False}, return_futures=False)
+    assert result["s"][0] == 6

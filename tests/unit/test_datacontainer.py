@@ -1,0 +1,71 @@
+"""ColumnContainer/DataContainer semantics (parity: reference
+tests/unit/test_datacontainer.py)."""
+import numpy as np
+import pandas as pd
+import pytest
+
+
+def _table():
+    from dask_sql_tpu.columnar import Table
+
+    return Table.from_pandas(pd.DataFrame({"a": [1, 2], "b": [3.0, 4.0], "c": ["x", "y"]}))
+
+
+def test_column_container_rename_no_copy():
+    from dask_sql_tpu.datacontainer import ColumnContainer
+
+    cc = ColumnContainer(["a", "b", "c"])
+    cc2 = cc.rename({"a": "x"})
+    assert cc2.columns == ["x", "b", "c"]
+    assert cc2.get_backend_by_frontend_name("x") == "a"
+    assert cc.columns == ["a", "b", "c"]  # original untouched
+
+
+def test_column_container_limit_to():
+    from dask_sql_tpu.datacontainer import ColumnContainer
+
+    cc = ColumnContainer(["a", "b", "c"]).limit_to(["c", "a"])
+    assert cc.columns == ["c", "a"]
+    assert cc.get_backend_by_frontend_index(0) == "c"
+
+
+def test_column_container_add_and_unique():
+    from dask_sql_tpu.datacontainer import ColumnContainer
+
+    cc = ColumnContainer(["a"]).add("d", "a")
+    assert cc.columns == ["a", "d"]
+    assert cc.get_backend_by_frontend_name("d") == "a"
+    uniq = cc.make_unique()
+    assert uniq.columns == ["col_0", "col_1"]
+
+
+def test_data_container_assign():
+    from dask_sql_tpu.datacontainer import ColumnContainer, DataContainer
+
+    t = _table()
+    cc = ColumnContainer(["b", "a"], {"b": "b", "a": "a"})
+    dc = DataContainer(t, cc)
+    out = dc.assign()
+    assert out.column_names == ["b", "a"]
+    assert list(out.to_pandas()["a"]) == [1, 2]
+
+
+def test_statistics():
+    from dask_sql_tpu.datacontainer import Statistics
+
+    s = Statistics(100.0)
+    assert s.row_count == 100.0
+
+
+def test_pluggable():
+    from dask_sql_tpu.utils import Pluggable
+
+    class MyRegistry(Pluggable):
+        pass
+
+    MyRegistry.add_plugin("x", 1)
+    assert MyRegistry.get_plugin("x") == 1
+    MyRegistry.add_plugin("x", 2, replace=False)
+    assert MyRegistry.get_plugin("x") == 1
+    MyRegistry.add_plugin("x", 2)
+    assert MyRegistry.get_plugin("x") == 2

@@ -1,0 +1,80 @@
+"""Type mapping tests (parity: reference tests/unit/test_mapping.py)."""
+import numpy as np
+import pytest
+
+
+def test_np_to_sql():
+    from dask_sql_tpu.columnar.dtypes import SqlType, np_to_sql
+
+    assert np_to_sql(np.dtype(np.int64)) == SqlType.BIGINT
+    assert np_to_sql(np.dtype(np.int32)) == SqlType.INTEGER
+    assert np_to_sql(np.dtype(np.float64)) == SqlType.DOUBLE
+    assert np_to_sql(np.dtype(np.float32)) == SqlType.FLOAT
+    assert np_to_sql(np.dtype(np.bool_)) == SqlType.BOOLEAN
+    assert np_to_sql(np.dtype("datetime64[ns]")) == SqlType.TIMESTAMP
+    assert np_to_sql(np.dtype("timedelta64[ns]")) == SqlType.INTERVAL_DAY_TIME
+    assert np_to_sql(np.dtype(object)) == SqlType.VARCHAR
+
+
+def test_python_to_sql():
+    from dask_sql_tpu.columnar.dtypes import SqlType, python_to_sql_type
+
+    assert python_to_sql_type(True) == SqlType.BOOLEAN
+    assert python_to_sql_type(3) == SqlType.BIGINT
+    assert python_to_sql_type(3.5) == SqlType.DOUBLE
+    assert python_to_sql_type("x") == SqlType.VARCHAR
+
+
+def test_parse_sql_type():
+    from dask_sql_tpu.columnar.dtypes import SqlType, parse_sql_type
+
+    assert parse_sql_type("BIGINT") == SqlType.BIGINT
+    assert parse_sql_type("int") == SqlType.INTEGER
+    assert parse_sql_type("VARCHAR(20)") == SqlType.VARCHAR
+    assert parse_sql_type("DECIMAL(10,2)") == SqlType.DECIMAL
+    assert parse_sql_type("timestamp without time zone") == SqlType.TIMESTAMP
+    assert parse_sql_type("DOUBLE PRECISION") == SqlType.DOUBLE
+
+
+def test_promotion():
+    from dask_sql_tpu.columnar.dtypes import SqlType, promote
+
+    assert promote(SqlType.INTEGER, SqlType.BIGINT) == SqlType.BIGINT
+    assert promote(SqlType.BIGINT, SqlType.FLOAT) == SqlType.DOUBLE
+    assert promote(SqlType.INTEGER, SqlType.DOUBLE) == SqlType.DOUBLE
+    assert promote(SqlType.NULL, SqlType.VARCHAR) == SqlType.VARCHAR
+    assert promote(SqlType.DATE, SqlType.TIMESTAMP) == SqlType.TIMESTAMP
+    assert promote(SqlType.TIMESTAMP, SqlType.INTERVAL_DAY_TIME) == SqlType.TIMESTAMP
+
+
+def test_similar_type():
+    from dask_sql_tpu.columnar.dtypes import SqlType, similar_type
+
+    assert similar_type(SqlType.INTEGER, SqlType.BIGINT)
+    assert similar_type(SqlType.FLOAT, SqlType.DOUBLE)
+    assert not similar_type(SqlType.INTEGER, SqlType.VARCHAR)
+
+
+def test_cast_column_roundtrip():
+    import jax.numpy as jnp
+
+    from dask_sql_tpu.columnar import Column, SqlType
+
+    col = Column.from_numpy(np.array([1.9, -2.9, 3.5]))
+    as_int = col.cast(SqlType.BIGINT)
+    assert list(np.asarray(as_int.data)) == [1, -2, 3]  # truncation toward zero
+    back = as_int.cast(SqlType.DOUBLE)
+    assert back.sql_type == SqlType.DOUBLE
+    as_str = col.cast(SqlType.VARCHAR)
+    assert as_str.sql_type == SqlType.VARCHAR
+    as_bool = Column.from_numpy(np.array([0, 1, 2])).cast(SqlType.BOOLEAN)
+    assert list(np.asarray(as_bool.data)) == [False, True, True]
+
+
+def test_string_cast_to_number():
+    from dask_sql_tpu.columnar import Column, SqlType
+
+    col = Column.from_numpy(np.array(["1", "2.5", "bad"], dtype=object))
+    as_f = col.cast(SqlType.DOUBLE)
+    vals = as_f.to_numpy()
+    assert vals[0] == 1.0 and vals[1] == 2.5 and np.isnan(vals[2])
