@@ -130,16 +130,29 @@ class Context:
         statistics: Optional[Statistics] = None,
         backend: Optional[str] = None,
         gpu: bool = False,
+        distributed: bool = False,
         **kwargs,
     ) -> None:
         """Register a table (parity: context.py:168).  `backend='tpu'`
         (default) lands columns in device HBM; the reference's `gpu=` flag is
-        accepted and treated as a backend hint."""
+        accepted and treated as a backend hint.  `distributed=True` shards the
+        column buffers row-wise over the default device mesh so kernels run
+        SPMD with XLA-placed collectives."""
         schema_name = schema_name or self.schema_name
         if schema_name not in self.schema:
             raise KeyError(f"Schema {schema_name} not found")
         dc = InputUtil.to_dc(input_table, table_name, format=format,
                              persist=persist, **kwargs)
+        if distributed:
+            from .datacontainer import LazyParquetContainer
+            from .parallel.distribute import shard_table
+
+            if isinstance(dc, LazyParquetContainer):
+                from .datacontainer import DataContainer
+
+                dc = DataContainer(shard_table(dc.table))
+            else:
+                dc.table = shard_table(dc.table)
         self.schema[schema_name].tables[table_name] = dc
         from .datacontainer import LazyParquetContainer
 

@@ -1,0 +1,10 @@
+from .mesh import AXIS, default_mesh, make_mesh, row_sharding, set_default_mesh, shard_rows
+
+__all__ = [
+    "AXIS",
+    "default_mesh",
+    "make_mesh",
+    "row_sharding",
+    "set_default_mesh",
+    "shard_rows",
+]
