@@ -338,7 +338,7 @@ class Context:
             raise
         if self.config.get("sql.optimize", True):
             try:
-                plan = optimize_plan(plan, self.config, catalog)
+                plan = optimize_plan(plan, self.config, catalog, context=self)
             except Exception:
                 # parity: optimizer failure falls back to the unoptimized plan
                 # (context.py:857-864)

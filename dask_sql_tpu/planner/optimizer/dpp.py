@@ -1,32 +1,42 @@
-"""Dynamic partition pruning (parity: reference
-src/sql/optimizer/dynamic_partition_pruning.rs — for fact ⋈ dim inner joins,
-read the smaller table's join-key values *at plan time* and inject InList
-filters into the fact table's scan so IO skips non-matching row groups).
+"""Dynamic partition pruning.
 
-Here: when one join side is a (filtered) scan of a table whose registered
-row count is below `fact_dimension_ratio` of the other side, the dim-side
-key values are computed at plan time (they are already device-resident —
-no parquet re-read needed, unlike the reference) and an InList filter is
-planted on the fact scan.
+Role parity: reference src/sql/optimizer/dynamic_partition_pruning.rs — for
+fact ⋈ dim inner joins it reads the *smaller* side's join-key values at plan
+time and injects InList filters into the fact table's scan so IO skips
+non-matching row groups (dynamic_partition_pruning.rs:1-8; gated by
+`sql.dynamic_partition_pruning` and `fact_dimension_ratio`).
+
+Here the dim side is evaluated with a scoped executor at plan time (the
+reference reads parquet directly at plan time, the same plan/execute blur),
+and the distinct key values become an InListExpr on the fact TableScan —
+which the lazy-parquet scan path then converts into a pyarrow row-group
+filter (physical/utils/filter.py), completing the IO pruning.
 """
 from __future__ import annotations
 
-from typing import Optional
+import logging
+from typing import List, Optional
+
+import numpy as np
 
 from .. import plan as p
-from ..expressions import ColumnRef, InListExpr, Literal, referenced_columns
+from ..expressions import ColumnRef, InListExpr, Literal, walk
 
-_MAX_INLIST = 10_000
+logger = logging.getLogger(__name__)
+
+_MAX_INLIST = 50_000
 
 
-def apply(plan, config, catalog):
+def apply(plan, config, catalog, context=None):
+    if context is None:
+        return plan
     ratio = float(config.get("sql.optimizer.fact_dimension_ratio", 0.7)) or 0.7
 
     def go(node):
         kids = [go(k) for k in node.inputs()]
         node = node.with_inputs(kids) if kids else node
-        if isinstance(node, p.Join) and node.join_type == "INNER" and len(node.on) == 1:
-            node = _try_prune(node, catalog, ratio) or node
+        if isinstance(node, p.Join) and node.join_type == "INNER" and node.on:
+            node = _try_prune(node, catalog, context, ratio) or node
         return node
 
     return go(plan)
@@ -48,15 +58,129 @@ def _rows(scan: Optional[p.TableScan], catalog) -> Optional[float]:
         return None
 
 
-def _try_prune(join: p.Join, catalog, ratio):
+def _has_filters(node) -> bool:
+    while isinstance(node, (p.SubqueryAlias, p.Projection)):
+        node = node.inputs()[0]
+    if isinstance(node, p.Filter):
+        return True
+    return isinstance(node, p.TableScan) and bool(node.filters)
+
+
+def _try_prune(join: p.Join, catalog, context, ratio):
     lscan, rscan = _scan_of(join.left), _scan_of(join.right)
     lrows, rrows = _rows(lscan, catalog), _rows(rscan, catalog)
-    if lrows is None or rrows is None:
+    if lrows is None or rrows is None or not lrows or not rrows:
         return None
-    lkey, rkey = join.on[0]
-    # fact = big side; dim = small side
-    if rrows <= lrows * (1 - ratio) and isinstance(lkey, ColumnRef) and lscan is not None:
-        return None  # plan-time value collection is wired in via the executor
-        # (the runtime join kernel already prunes; scan-level injection is a
-        # parquet-IO optimization applied in TableScanPlugin)
+    nleft = len(join.left.schema)
+    for key_pair in join.on:
+        lkey, rkey = key_pair
+        # fact = the big side; dim = the small *filtered* side
+        if rrows / lrows <= (1 - ratio) and _has_filters(join.right) \
+                and isinstance(lkey, ColumnRef) and lscan is not None:
+            new_left = _inject(join.left, lscan, lkey, join.right, rkey, nleft,
+                               context, side="right")
+            if new_left is not None:
+                return p.Join(new_left, join.right, join.join_type, join.on,
+                              join.filter, join.schema)
+        if lrows / rrows <= (1 - ratio) and _has_filters(join.left) \
+                and isinstance(rkey, ColumnRef) and rscan is not None:
+            new_right = _inject(join.right, rscan, rkey, join.left, lkey, 0,
+                                context, side="left")
+            if new_right is not None:
+                return p.Join(join.left, new_right, join.join_type, join.on,
+                              join.filter, join.schema)
     return None
+
+
+def _inject(fact_side, fact_scan: p.TableScan, fact_key: ColumnRef,
+            dim_side, dim_key, dim_base: int, context, side: str):
+    """Evaluate the dim side now, collect distinct key values, filter fact scan."""
+    try:
+        from ...physical.executor import Executor
+
+        executor = Executor(context)
+        dim_table = executor.execute(dim_side)
+        if side == "right":
+            key_expr = _rebase(dim_key, len(fact_side.schema))
+        else:
+            key_expr = dim_key
+        col = executor.eval_expr(key_expr, dim_table)
+        vals = col.to_numpy()
+        vals = vals[~_isnull(vals)]
+        uniq = np.unique(vals)
+        if len(uniq) == 0 or len(uniq) > _MAX_INLIST:
+            return None
+        from ...columnar.dtypes import np_to_sql
+
+        sql_t = col.sql_type
+        items = tuple(Literal(_pyval(v, sql_t), sql_t) for v in uniq)
+        # the fact key must resolve inside the scan (column ref path only)
+        scan_idx = fact_key.index
+        if side == "left":
+            scan_idx = fact_key.index - dim_base if fact_key.index >= dim_base else fact_key.index
+        # map through any projections between scan and join input
+        ref = _resolve_to_scan(fact_side, scan_idx)
+        if ref is None:
+            return None
+        in_filter = InListExpr(ref, items, False)
+        new_scan = p.TableScan(fact_scan.schema_name, fact_scan.table_name,
+                               fact_scan.schema, fact_scan.projection,
+                               list(fact_scan.filters) + [in_filter])
+        return _replace_scan(fact_side, fact_scan, new_scan)
+    except Exception as e:  # noqa: BLE001 - DPP must never break planning
+        logger.debug("DPP skipped: %s", e)
+        return None
+
+
+def _rebase(expr, nleft):
+    from ..expressions import shift_columns
+
+    return shift_columns(expr, -nleft)
+
+
+def _resolve_to_scan(node, index: int) -> Optional[ColumnRef]:
+    """Trace a column index at `node`'s output down to the scan schema."""
+    while True:
+        if isinstance(node, (p.Filter, p.SubqueryAlias)):
+            node = node.inputs()[0]
+            continue
+        if isinstance(node, p.Projection):
+            e = node.exprs[index]
+            if not (isinstance(e, ColumnRef) and type(e) is ColumnRef):
+                return None
+            index = e.index
+            node = node.input
+            continue
+        if isinstance(node, p.TableScan):
+            f = node.schema[index]
+            return ColumnRef(index, f.name, f.sql_type, f.nullable)
+        return None
+
+
+def _replace_scan(node, old_scan, new_scan):
+    if node is old_scan:
+        return new_scan
+    kids = node.inputs()
+    if not kids:
+        return node
+    return node.with_inputs([_replace_scan(k, old_scan, new_scan) for k in kids])
+
+
+def _isnull(vals: np.ndarray) -> np.ndarray:
+    if vals.dtype == object:
+        return np.array([v is None for v in vals])
+    if vals.dtype.kind == "f":
+        return np.isnan(vals)
+    if vals.dtype.kind == "M":
+        return np.isnat(vals)
+    return np.zeros(len(vals), dtype=bool)
+
+
+def _pyval(v, sql_t):
+    from ...columnar.dtypes import DATETIME_TYPES
+
+    if sql_t in DATETIME_TYPES:
+        return int(np.datetime64(v, "ns").astype(np.int64))
+    if isinstance(v, np.generic):
+        return v.item()
+    return v

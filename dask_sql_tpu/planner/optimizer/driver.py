@@ -30,7 +30,7 @@ def _load_rules():
     return _RULES
 
 
-def optimize_plan(plan, config, catalog):
+def optimize_plan(plan, config, catalog, context=None):
     rules = _load_rules()
     verbose = bool(config.get("sql.optimizer.verbose", False))
     # two passes: pushdowns expose new opportunities (e.g. cross-join
@@ -49,5 +49,5 @@ def optimize_plan(plan, config, catalog):
     if config.get("sql.dynamic_partition_pruning", True):
         from . import dpp
 
-        plan = dpp.apply(plan, config, catalog)
+        plan = dpp.apply(plan, config, catalog, context)
     return plan
