@@ -475,6 +475,43 @@ class Binder:
 
     # ------------------------------------------------------------ aggregate
     def _bind_aggregate(self, q, plan, scope, proj_exprs, having_expr):
+        # GROUPING SETS / ROLLUP / CUBE expand into a union of aggregates
+        # (parity: aggregate.rs getGroupSets — the reference surfaces group
+        # sets from DataFusion; we lower them during binding)
+        plain_asts: List[a.Expr] = []
+        construct = None
+        for ge in q.group_by:
+            if isinstance(ge, (a.GroupingSets, a.Rollup, a.Cube)):
+                construct = ge
+            else:
+                plain_asts.append(ge)
+        sets: Optional[List[List[int]]] = None
+        if construct is not None:
+            n_plain = len(plain_asts)
+            if isinstance(construct, a.Rollup):
+                extra = list(construct.exprs)
+                raw_sets = [list(range(k)) for k in range(len(extra), -1, -1)]
+            elif isinstance(construct, a.Cube):
+                extra = list(construct.exprs)
+                m = len(extra)
+                raw_sets = [[i for i in range(m) if mask & (1 << i)]
+                            for mask in range(2 ** m - 1, -1, -1)]
+            else:
+                # GROUPING SETS: dedupe expressions structurally via binding
+                extra = []
+                raw_sets = []
+                bound_cache = {}
+                for s in construct.sets:
+                    idxs = []
+                    for e in s:
+                        b = self.bind_expr(e, scope)
+                        if b not in bound_cache:
+                            bound_cache[b] = len(extra)
+                            extra.append(e)
+                        idxs.append(bound_cache[b])
+                    raw_sets.append(idxs)
+            q = a.Select(**{**q.__dict__, "group_by": plain_asts + extra})
+            sets = [list(range(n_plain)) + [n_plain + i for i in s] for s in raw_sets]
         group_exprs: List[Expr] = []
         for ge in q.group_by:
             if isinstance(ge, a.Literal) and isinstance(ge.value, int):
@@ -511,7 +548,28 @@ class Binder:
                         for i, e in enumerate(group_exprs)]
         agg_fields = [Field(f"__agg{i}", x.sql_type, True) for i, x in enumerate(agg_calls)]
         out_fields = group_fields + agg_fields
-        agg_plan = p.Aggregate(plan, group_exprs, agg_calls, out_fields)
+        if sets is None:
+            agg_plan = p.Aggregate(plan, group_exprs, agg_calls, out_fields)
+        else:
+            # union of one aggregate per grouping set, NULL-padded to the full
+            # group layout
+            out_fields = [Field(f.name, f.sql_type, True) for f in group_fields] + agg_fields
+            branches = []
+            for s in sets:
+                sub_groups = [group_exprs[i] for i in s]
+                sub_fields = ([group_fields[i] for i in s] + agg_fields)
+                sub_agg = p.Aggregate(plan, sub_groups, agg_calls, sub_fields)
+                proj = []
+                for gi, gf in enumerate(group_fields):
+                    if gi in s:
+                        pos = s.index(gi)
+                        proj.append(ColumnRef(pos, gf.name, gf.sql_type, True))
+                    else:
+                        proj.append(Cast(Literal(None, SqlType.NULL), gf.sql_type))
+                for ai, af in enumerate(agg_fields):
+                    proj.append(ColumnRef(len(s) + ai, af.name, af.sql_type, True))
+                branches.append(p.Projection(sub_agg, proj, out_fields))
+            agg_plan = p.Union(branches, True, out_fields)
 
         # rewrite post-agg expressions: replace group-expr / agg subtrees with refs
         mapping: Dict[Expr, ColumnRef] = {}

@@ -417,9 +417,9 @@ class Parser:
         if self.at_keyword("GROUP"):
             self.next()
             self.expect_keyword("BY")
-            sel.group_by = [self.parse_expr()]
+            sel.group_by = [self._parse_group_item()]
             while self.accept(","):
-                sel.group_by.append(self.parse_expr())
+                sel.group_by.append(self._parse_group_item())
         if self.accept_keyword("HAVING"):
             sel.having = self.parse_expr()
         if self.at_keyword("DISTRIBUTE"):
@@ -429,6 +429,45 @@ class Parser:
             while self.accept(","):
                 sel.distribute_by.append(self.parse_expr())
         return sel
+
+    def _parse_group_item(self) -> a.Expr:
+        if self.at_keyword("GROUPING") and self.peek(1).upper == "SETS":
+            self.next()
+            self.next()
+            self.expect("(")
+            sets = []
+            while True:
+                if self.accept("("):
+                    items = []
+                    if not self.accept(")"):
+                        items.append(self.parse_expr())
+                        while self.accept(","):
+                            items.append(self.parse_expr())
+                        self.expect(")")
+                    sets.append(items)
+                else:
+                    sets.append([self.parse_expr()])
+                if not self.accept(","):
+                    break
+            self.expect(")")
+            return a.GroupingSets(sets)
+        if self.at_keyword("ROLLUP") and self.peek(1).value == "(":
+            self.next()
+            self.expect("(")
+            exprs = [self.parse_expr()]
+            while self.accept(","):
+                exprs.append(self.parse_expr())
+            self.expect(")")
+            return a.Rollup(exprs)
+        if self.at_keyword("CUBE") and self.peek(1).value == "(":
+            self.next()
+            self.expect("(")
+            exprs = [self.parse_expr()]
+            while self.accept(","):
+                exprs.append(self.parse_expr())
+            self.expect(")")
+            return a.Cube(exprs)
+        return self.parse_expr()
 
     def parse_projections(self) -> List[a.SelectItem]:
         items = [self.parse_select_item()]

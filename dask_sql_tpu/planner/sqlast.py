@@ -213,6 +213,21 @@ class Alias(Expr):
 # Query structure
 # ---------------------------------------------------------------------------
 @dataclass
+class GroupingSets(Expr):
+    sets: List[List[Expr]]
+
+
+@dataclass
+class Rollup(Expr):
+    exprs: List[Expr]
+
+
+@dataclass
+class Cube(Expr):
+    exprs: List[Expr]
+
+
+@dataclass
 class OrderItem:
     expr: Expr
     ascending: bool = True

@@ -1,0 +1,52 @@
+"""GROUPING SETS / ROLLUP / CUBE tests (parity: aggregate.rs getGroupSets)."""
+import numpy as np
+import pandas as pd
+import pytest
+
+
+@pytest.fixture
+def gdf(c):
+    df = pd.DataFrame({
+        "g1": ["a", "a", "b", "b"],
+        "g2": ["x", "y", "x", "y"],
+        "v": [1, 2, 3, 4],
+    })
+    c.create_table("gs", df)
+    return df
+
+
+def test_rollup(c, gdf):
+    result = c.sql(
+        "SELECT g1, g2, SUM(v) AS s FROM gs GROUP BY ROLLUP (g1, g2)"
+    ).compute()
+    # (g1,g2): 4 rows, (g1): 2 rows, (): 1 row
+    assert len(result) == 7
+    total = result[pd.isna(result.g1) & pd.isna(result.g2)]
+    assert total["s"].iloc[0] == 10
+    g1_only = result[~pd.isna(result.g1) & pd.isna(result.g2)].sort_values("g1")
+    assert list(g1_only["s"]) == [3, 7]
+
+
+def test_cube(c, gdf):
+    result = c.sql(
+        "SELECT g1, g2, SUM(v) AS s FROM gs GROUP BY CUBE (g1, g2)"
+    ).compute()
+    # 4 + 2 + 2 + 1
+    assert len(result) == 9
+    g2_only = result[pd.isna(result.g1) & ~pd.isna(result.g2)].sort_values("g2")
+    assert list(g2_only["s"]) == [4, 6]
+
+
+def test_grouping_sets(c, gdf):
+    result = c.sql(
+        "SELECT g1, g2, SUM(v) AS s FROM gs GROUP BY GROUPING SETS ((g1), (g2), ())"
+    ).compute()
+    assert len(result) == 2 + 2 + 1
+    assert result["s"].sum() == 10 * 3  # each set sums to 10
+
+
+def test_rollup_with_order(c, gdf):
+    result = c.sql(
+        "SELECT g1, SUM(v) AS s FROM gs GROUP BY ROLLUP (g1) ORDER BY s DESC"
+    ).compute()
+    assert list(result["s"]) == [10, 7, 3]
