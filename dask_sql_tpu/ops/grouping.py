@@ -60,11 +60,23 @@ def radix_gid(cols: Sequence[Column], max_domain: int = 1 << 22):
     maps group ids back to per-column Columns (for key materialization).
     """
     radices = []
+    offsets = []
     for c in cols:
         if c.sql_type in STRING_TYPES and c.dictionary is not None:
             radices.append(len(c.dictionary) + 1)  # +1 slot for NULL
+            offsets.append(0)
         elif c.data.dtype == jnp.bool_:
             radices.append(3)
+            offsets.append(0)
+        elif jnp.issubdtype(c.data.dtype, jnp.integer) and len(c):
+            # small-range ints: value-offset codes (one host sync for bounds)
+            lo = int(jnp.min(c.data))
+            hi = int(jnp.max(c.data))
+            span = hi - lo + 1
+            if span <= 0 or span > max_domain:
+                return None
+            radices.append(span + 1)
+            offsets.append(lo)
         else:
             return None
     domain = 1
@@ -73,25 +85,22 @@ def radix_gid(cols: Sequence[Column], max_domain: int = 1 << 22):
     if domain > max_domain:
         return None
     gid = None
-    codes_list = []
-    for c, r in zip(cols, radices):
-        codes = c.data.astype(jnp.int64) if c.data.dtype != jnp.bool_ else c.data.astype(jnp.int64)
+    for c, r, off in zip(cols, radices, offsets):
+        codes = c.data.astype(jnp.int64) - off
         codes = jnp.clip(codes, 0, r - 2)
         if c.validity is not None:
             codes = jnp.where(c.validity, codes, r - 1)  # NULL -> last slot
-        codes_list.append(codes)
         gid = codes if gid is None else gid * r + codes
 
     def decode(gids: jnp.ndarray) -> List[Column]:
         out = []
-        rem = gids
         strides = []
         s = 1
         for r in reversed(radices):
             strides.append(s)
             s *= r
         strides = list(reversed(strides))
-        for c, r, stride in zip(cols, radices, strides):
+        for c, r, off, stride in zip(cols, radices, offsets, strides):
             code = (gids // stride) % r
             validity = None
             is_null = code == (r - 1)
@@ -101,9 +110,11 @@ def radix_gid(cols: Sequence[Column], max_domain: int = 1 << 22):
             if c.sql_type in STRING_TYPES:
                 out.append(Column(code.astype(jnp.int32), c.sql_type, validity,
                                   c.dictionary))
+            elif c.data.dtype == jnp.bool_:
+                out.append(Column(code == 1, c.sql_type, validity))
             else:
-                out.append(Column(code.astype(c.data.dtype) if c.data.dtype != jnp.bool_
-                                  else (code == 1), c.sql_type, validity))
+                out.append(Column((code + off).astype(c.data.dtype), c.sql_type,
+                                  validity))
         return out
 
     return gid.astype(jnp.int32) if domain < 2**31 else gid, domain, decode

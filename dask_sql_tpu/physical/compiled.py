@@ -383,8 +383,9 @@ class CompiledAggregate:
         self.agg_exprs = agg_exprs
         ev = _TraceEval(table)
 
-        # radix group-id plan (compile-time): group keys must be dict/bool cols
+        # radix group-id plan (compile-time): dict/bool/small-int group keys
         radices = []
+        offsets = []
         gcols: List[Column] = []
         for e in group_exprs:
             if not (isinstance(e, ColumnRef) and type(e) is ColumnRef):
@@ -392,8 +393,18 @@ class CompiledAggregate:
             c = ev.col(e.index)
             if c.sql_type in STRING_TYPES and c.dictionary is not None:
                 radices.append(len(c.dictionary) + 1)
+                offsets.append(0)
             elif c.data.dtype == jnp.bool_:
                 radices.append(3)
+                offsets.append(0)
+            elif jnp.issubdtype(c.data.dtype, jnp.integer) and len(c):
+                lo = int(jnp.min(c.data))
+                hi = int(jnp.max(c.data))
+                span = hi - lo + 1
+                if span <= 0 or span > (1 << 22):
+                    raise _Unsupported("integer key range too large")
+                radices.append(span + 1)
+                offsets.append(lo)
             else:
                 raise _Unsupported("non-dictionary group key")
             gcols.append(c)
@@ -404,10 +415,14 @@ class CompiledAggregate:
             raise _Unsupported("group domain too large")
         self.domain = max(domain, 1)
         self.radices = radices
+        self.offsets = offsets
         self.gcols = gcols
         for a in agg_exprs:
             if a.func not in _SUPPORTED_AGGS or a.distinct:
                 raise _Unsupported(f"agg {a.func}")
+            if a.args and a.args[0].sql_type in STRING_TYPES:
+                # string min/max needs dictionary-order handling (eager path)
+                raise _Unsupported("string-typed aggregate argument")
             for x in list(a.args) + ([a.filter] if a.filter is not None else []):
                 for sub in walk(x):
                     if isinstance(sub, AggExpr) and sub is not x:
@@ -422,6 +437,7 @@ class CompiledAggregate:
         filters = self.filters
         agg_exprs = self.agg_exprs
         radices = self.radices
+        offsets_ = self.offsets
         domain = self.domain
         n_cols = len(self.table.column_names)
         n_rows = self.table.num_rows
@@ -436,9 +452,9 @@ class CompiledAggregate:
                 mask = m if mask is None else (mask & m)
             gid = jnp.zeros((), dtype=jnp.int64)
             first = True
-            for idx, r in zip(group_refs, radices):
+            for idx, r, off in zip(group_refs, radices, offsets_):
                 codes, valid = slots[idx]
-                codes = codes.astype(jnp.int64)
+                codes = codes.astype(jnp.int64) - off
                 codes = jnp.clip(codes, 0, r - 2)
                 if valid is not None:
                     codes = jnp.where(valid, codes, r - 1)
@@ -523,7 +539,8 @@ class CompiledAggregate:
             strides.append(s)
             s *= r
         strides = list(reversed(strides))
-        for name, col, r, stride in zip(names, self.gcols, self.radices, strides):
+        for name, col, r, off, stride in zip(names, self.gcols, self.radices,
+                                             self.offsets, strides):
             code = (present // stride) % r
             is_null = code == (r - 1)
             validity = ~is_null if bool(is_null.any()) else None
@@ -531,8 +548,11 @@ class CompiledAggregate:
             if col.sql_type in STRING_TYPES:
                 out[name] = Column(code.astype(jnp.int32), col.sql_type, validity,
                                    col.dictionary)
-            else:
+            elif col.data.dtype == jnp.bool_:
                 out[name] = Column(code == 1, col.sql_type, validity)
+            else:
+                out[name] = Column((code + off).astype(col.data.dtype),
+                                   col.sql_type, validity)
         for i, (a, f) in enumerate(zip(self.agg_exprs,
                                        self.agg.schema[len(self.gcols):])):
             d = flat[1 + 2 * i][present]
