@@ -1,0 +1,15 @@
+"""Public exception types (parity: reference src/error.rs DaskPlannerError and
+sql/exceptions.rs ParsingException/OptimizationException)."""
+from __future__ import annotations
+
+from .planner.binder import BindError
+from .planner.lexer import LexError
+from .planner.parser import ParsingException
+
+
+class OptimizationException(RuntimeError):
+    """Raised when optimization fails irrecoverably (the driver normally
+    falls back to the unoptimized plan instead, context.py:857 parity)."""
+
+
+__all__ = ["ParsingException", "OptimizationException", "BindError", "LexError"]

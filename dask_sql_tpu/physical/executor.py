@@ -20,10 +20,15 @@ from .rex.convert import RexConverter
 class Executor:
     _plugins: Dict[str, BaseRelPlugin] = {}
 
-    def __init__(self, context):
+    def __init__(self, context, trace: bool = False):
         self.context = context
         self.rex = RexConverter(self)
         self._memo: Dict[int, Table] = {}
+        from ..tracing import Tracer
+
+        self.tracer = Tracer()
+        if trace:
+            self.tracer.start()
 
     @classmethod
     def add_plugin_class(cls, plugin_class):
@@ -38,7 +43,12 @@ class Executor:
         plugin = self._plugins.get(rel.node_type)
         if plugin is None:
             raise NotImplementedError(f"No rel plugin for node type {rel.node_type!r}")
-        out = plugin.convert(rel, self)
+        if self.tracer.enabled:
+            with self.tracer.node(rel) as ctx:
+                out = plugin.convert(rel, self)
+                ctx.rows = out.num_rows
+        else:
+            out = plugin.convert(rel, self)
         self._memo[key] = out
         return out
 

@@ -307,6 +307,14 @@ class ExplainPlugin(BaseRelPlugin):
     class_name = "Explain"
 
     def convert(self, rel: p.Explain, executor) -> Table:
-        text = rel.input.explain()
+        if rel.analyze:
+            # EXPLAIN ANALYZE: run the plan with per-node tracing
+            from ...executor import Executor
+
+            traced = Executor(executor.context, trace=True)
+            traced.execute(rel.input)
+            text = traced.tracer.root.format() if traced.tracer.root else ""
+        else:
+            text = rel.input.explain()
         lines = np.array(text.split("\n"), dtype=object)
         return Table({"PLAN": Column.from_numpy(lines)}, len(lines))

@@ -260,7 +260,14 @@ class Binder:
                 continue
             e = self.bind_expr(item.expr, scope)
             proj_exprs.append(e)
-            proj_names.append(item.alias or self._derive_name(item.expr))
+            if item.alias:
+                proj_names.append(item.alias)
+            elif isinstance(e, ColumnRef):
+                # preserve the table's column spelling (matters when
+                # identifiers are matched case-insensitively)
+                proj_names.append(e.name)
+            else:
+                proj_names.append(self._derive_name(item.expr))
         having_expr = self.bind_expr(q.having, scope) if q.having is not None else None
 
         # ORDER BY items: positions / select aliases resolve to outputs, the

@@ -1,0 +1,60 @@
+"""Execution tracing.
+
+Role parity: SURVEY.md §5 — the reference has no dedicated tracer (it points
+users at the dask dashboard and logs per-rule optimizer traces).  Here the
+executor records per-plan-node wall time and output rows, surfaced through
+`EXPLAIN ANALYZE` and `Context.last_trace`.
+"""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+
+@dataclass
+class NodeTrace:
+    node_type: str
+    label: str
+    wall_ms: float
+    rows: int
+    children: List["NodeTrace"] = field(default_factory=list)
+
+    def format(self, indent: int = 0) -> str:
+        pad = "  " * indent
+        lines = [f"{pad}{self.label}  [{self.wall_ms:.2f} ms, {self.rows} rows]"]
+        for child in self.children:
+            lines.append(child.format(indent + 1))
+        return "\n".join(lines)
+
+
+class Tracer:
+    def __init__(self):
+        self.enabled = False
+        self._stack: List[List[NodeTrace]] = [[]]
+        self.root: Optional[NodeTrace] = None
+
+    def start(self):
+        self.enabled = True
+        self._stack = [[]]
+        self.root = None
+
+    def node(self, rel):
+        tracer = self
+
+        class _Ctx:
+            def __enter__(self):
+                self.t0 = time.perf_counter()
+                tracer._stack.append([])
+                return self
+
+            def __exit__(self, exc_type, exc, tb):
+                elapsed = (time.perf_counter() - self.t0) * 1000.0
+                children = tracer._stack.pop()
+                trace = NodeTrace(rel.node_type, rel._label(), elapsed,
+                                  getattr(self, "rows", -1), children)
+                tracer._stack[-1].append(trace)
+                tracer.root = trace
+                return False
+
+        return _Ctx()

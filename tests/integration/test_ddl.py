@@ -113,3 +113,24 @@ def test_sample(c, df):
 def test_multiple_statements(c, df):
     result = c.sql("CREATE TABLE ms1 AS (SELECT a FROM df); SELECT COUNT(*) AS n FROM ms1")
     assert result.compute()["n"][0] == len(df)
+
+def test_explain_analyze(c, df):
+    result = c.sql("EXPLAIN ANALYZE SELECT a, SUM(b) AS s FROM df GROUP BY a").compute()
+    text = "\n".join(result["PLAN"])
+    assert "ms" in text and "rows" in text
+    assert "Aggregate" in text
+
+def test_case_insensitive_identifiers(c, df):
+    result = c.sql("SELECT A FROM DF LIMIT 1",
+                   config_options={"sql.identifier.case_sensitive": False}).compute()
+    assert list(result.columns) == ["a"]
+
+def test_exceptions_exported():
+    from dask_sql_tpu.exceptions import BindError, LexError, ParsingException
+
+    import pytest as _pytest
+    from dask_sql_tpu import Context
+
+    c2 = Context()
+    with _pytest.raises(ParsingException):
+        c2.sql("SELEC 1")
