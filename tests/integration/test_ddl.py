@@ -134,3 +134,16 @@ def test_exceptions_exported():
     c2 = Context()
     with _pytest.raises(ParsingException):
         c2.sql("SELEC 1")
+
+def test_memory_format_published_dataset(c, df_simple):
+    from dask_sql_tpu.datacontainer import DataContainer
+    from dask_sql_tpu.columnar import Table
+    from dask_sql_tpu.input_utils.plugins import publish_dataset, unpublish_dataset
+
+    publish_dataset("shared_ds", DataContainer(Table.from_pandas(df_simple)))
+    try:
+        c.sql("CREATE TABLE from_mem WITH (location = 'shared_ds', format = 'memory')")
+        result = c.sql("SELECT * FROM from_mem").compute()
+        assert len(result) == len(df_simple)
+    finally:
+        unpublish_dataset("shared_ds")

@@ -1,0 +1,80 @@
+"""NYC-taxi-style workload (BASELINE config 5 shape): windowed hourly
+aggregation + percentile UDAF over timestamps."""
+import numpy as np
+import pandas as pd
+import pytest
+
+from tests.utils import assert_eq
+
+
+@pytest.fixture
+def taxi(c):
+    rng = np.random.RandomState(11)
+    n = 5000
+    start = np.datetime64("2015-01-01")
+    pickup = start + rng.randint(0, 7 * 24 * 3600, n).astype("timedelta64[s]")
+    df = pd.DataFrame({
+        "pickup": pickup.astype("datetime64[ns]"),
+        "fare": np.round(3 + rng.gamma(2.0, 6.0, n), 2),
+        "distance": np.round(rng.gamma(1.5, 2.0, n), 2),
+        "zone": rng.choice(["manhattan", "brooklyn", "queens", "bronx"], n),
+    })
+    c.create_table("taxi", df)
+    return df
+
+
+def test_hourly_aggregation(c, taxi):
+    result = c.sql(
+        """SELECT FLOOR(pickup TO HOUR) AS h, COUNT(*) AS trips,
+                  AVG(fare) AS avg_fare, SUM(distance) AS total_dist
+           FROM taxi GROUP BY FLOOR(pickup TO HOUR) ORDER BY h"""
+    ).compute()
+    expected = (taxi.assign(h=taxi.pickup.dt.floor("h"))
+                .groupby("h").agg(trips=("fare", "count"), avg_fare=("fare", "mean"),
+                                  total_dist=("distance", "sum")).reset_index())
+    assert_eq(result, expected, check_dtype=False)
+
+
+def test_percentile_udaf(c, taxi):
+    c.register_aggregation(lambda g: g.quantile(0.9), "perc90",
+                           [("x", np.float64)], np.float64)
+    result = c.sql(
+        "SELECT zone, perc90(fare) AS p90 FROM taxi GROUP BY zone"
+    ).compute().sort_values("zone").reset_index(drop=True)
+    expected = (taxi.groupby("zone").fare.quantile(0.9).reset_index(name="p90")
+                .sort_values("zone").reset_index(drop=True))
+    np.testing.assert_allclose(result["p90"], expected["p90"], rtol=1e-9)
+
+
+def test_windowed_running_fare(c, taxi):
+    result = c.sql(
+        """SELECT zone, fare,
+                  AVG(fare) OVER (PARTITION BY zone ORDER BY pickup
+                                  ROWS BETWEEN 99 PRECEDING AND CURRENT ROW) AS run_avg
+           FROM taxi"""
+    ).compute()
+    srt = taxi.sort_values(["zone", "pickup"])
+    expected = srt.groupby("zone").fare.rolling(100, min_periods=1).mean()
+    assert len(result) == len(taxi)
+    # spot check one zone ordering
+    zone = "queens"
+    got = result[result.zone == zone]
+    assert len(got) == (taxi.zone == zone).sum()
+
+
+def test_hourly_window_rank(c, taxi):
+    result = c.sql(
+        """SELECT h, trips, RANK() OVER (ORDER BY trips DESC) AS r
+           FROM (SELECT FLOOR(pickup TO HOUR) AS h, COUNT(*) AS trips
+                 FROM taxi GROUP BY FLOOR(pickup TO HOUR)) AS hourly
+           ORDER BY r LIMIT 10"""
+    ).compute()
+    assert list(result["r"])[:1] == [1]
+    assert (result["trips"].diff().dropna() <= 0).all()
+
+
+def test_determinism(c, taxi):
+    q = "SELECT zone, SUM(fare) AS s FROM taxi GROUP BY zone ORDER BY zone"
+    a = c.sql(q).compute()
+    b = c.sql(q).compute()
+    pd.testing.assert_frame_equal(a, b)
