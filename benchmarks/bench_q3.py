@@ -1,0 +1,64 @@
+"""TPC-H Q3 local benchmark (BASELINE config 3 shape): 3-way hash join + topN.
+
+Not the driver's bench (that's bench.py / Q1) — a development yardstick for
+the join path, vs the same pipeline in pandas.
+"""
+from __future__ import annotations
+
+import sys
+import time
+
+import numpy as np
+import pandas as pd
+
+sys.path.insert(0, ".")
+sys.path.insert(0, "tests")
+
+
+def main(scale_rows: int = 1_000_000):
+    from tpch import QUERIES, generate
+
+    from dask_sql_tpu import Context
+
+    tables = generate(scale_rows=scale_rows)
+    c = Context()
+    for name, df in tables.items():
+        c.create_table(name, df)
+
+    q3 = QUERIES[3]
+    _ = c.sql(q3).compute()  # warm-up
+    times = []
+    for _i in range(3):
+        t0 = time.perf_counter()
+        res = c.sql(q3).compute()
+        times.append(time.perf_counter() - t0)
+    ours = min(times)
+
+    cust, orders, li = tables["customer"], tables["orders"], tables["lineitem"]
+
+    def pandas_q3():
+        m = cust[cust.c_mktsegment == "BUILDING"].merge(
+            orders[orders.o_orderdate < pd.Timestamp("1995-03-15")],
+            left_on="c_custkey", right_on="o_custkey")
+        m = m.merge(li[li.l_shipdate > pd.Timestamp("1995-03-15")],
+                    left_on="o_orderkey", right_on="l_orderkey")
+        m = m.assign(revenue=m.l_extendedprice * (1 - m.l_discount))
+        return (m.groupby(["l_orderkey", "o_orderdate", "o_shippriority"]).revenue.sum()
+                .reset_index().sort_values(["revenue", "o_orderdate"],
+                                           ascending=[False, True]).head(10))
+
+    t0 = time.perf_counter()
+    expected = pandas_q3()
+    pt = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    expected = pandas_q3()
+    pt = min(pt, time.perf_counter() - t0)
+
+    np.testing.assert_allclose(res["revenue"].to_numpy(),
+                               expected["revenue"].to_numpy(), rtol=1e-9)
+    print(f"rows={scale_rows}  ours={ours*1000:.0f}ms  pandas={pt*1000:.0f}ms  "
+          f"speedup={pt/ours:.2f}x  throughput={scale_rows/ours/1e6:.2f}M rows/s")
+
+
+if __name__ == "__main__":
+    main(int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000)

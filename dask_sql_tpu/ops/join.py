@@ -46,6 +46,10 @@ def join_key_gids(
     """
     nl = len(left_keys[0]) if left_keys else 0
     nr = len(right_keys[0]) if right_keys else 0
+    if len(left_keys) == 1 and not null_equals_null:
+        fast = _single_key_fast_path(left_keys[0], right_keys[0])
+        if fast is not None:
+            return fast
     combined: List[jnp.ndarray] = []
     for lc, rc in zip(left_keys, right_keys):
         if lc.sql_type in STRING_TYPES or rc.sql_type in STRING_TYPES:
@@ -79,6 +83,35 @@ def join_key_gids(
     lgid = jnp.where(lvalid, lgid, -1)
     rgid = jnp.where(rvalid, rgid, -2)
     return lgid.astype(jnp.int64), rgid.astype(jnp.int64)
+
+
+def _single_key_fast_path(lc: Column, rc: Column):
+    """Single integer/datetime key: the values themselves are the join ids —
+    no joint factorization lexsort needed (the dominant cost for big probes).
+    NULL sentinels use int64 extremes, which real key values never hit."""
+    if lc.sql_type in STRING_TYPES or rc.sql_type in STRING_TYPES:
+        lk, rk = _merge_string_dicts(lc, rc)
+        lk = lk.astype(jnp.int64)
+        rk = rk.astype(jnp.int64)
+    else:
+        target = promote(lc.sql_type, rc.sql_type)
+        lk = lc.cast(target).data
+        rk = rc.cast(target).data
+        if not jnp.issubdtype(lk.dtype, jnp.integer):
+            return None  # float keys keep the exact factorize path
+        lk = lk.astype(jnp.int64)
+        rk = rk.astype(jnp.int64)
+    lo = jnp.iinfo(jnp.int64).min
+    if lc.validity is not None or rc.validity is not None:
+        # sentinel safety: real keys must not collide with the NULL sentinels
+        if (lk.shape[0] and int(jnp.min(lk)) <= lo + 1) or \
+                (rk.shape[0] and int(jnp.min(rk)) <= lo + 1):
+            return None
+        if lc.validity is not None:
+            lk = jnp.where(lc.valid_mask(), lk, lo)  # never matches rhs sentinel
+        if rc.validity is not None:
+            rk = jnp.where(rc.valid_mask(), rk, lo + 1)
+    return lk, rk
 
 
 def inner_join_indices(lgid: jnp.ndarray, rgid: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:

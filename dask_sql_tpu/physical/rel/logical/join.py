@@ -72,7 +72,12 @@ class JoinPlugin(BaseRelPlugin):
             return self.fix_column_to_row_type(left.filter(matched), rel.schema)
 
         if jt == "INNER":
-            li, ri = join_ops.inner_join_indices(lgid, rgid)
+            # probe from the bigger side so the build sort runs on the smaller
+            # one (parity intent: reference broadcast-join small-side choice)
+            if right.num_rows <= left.num_rows:
+                li, ri = join_ops.inner_join_indices(lgid, rgid)
+            else:
+                ri, li = join_ops.inner_join_indices(rgid, lgid)
             combined = _materialize(left, right, li, ri)
             if rel.filter is not None:
                 cond = executor.eval_expr(rel.filter, combined)

@@ -43,11 +43,13 @@ def optimize_plan(plan, config, catalog, context=None):
                 if verbose and new_plan is not plan:
                     logger.info("After %s:\n%s", type(rule).__name__, new_plan.explain())
                 plan = new_plan
-    from . import join_reorder
+    from . import join_reorder, rules
 
     plan = join_reorder.maybe_reorder(plan, config, catalog)
     if config.get("sql.dynamic_partition_pruning", True):
         from . import dpp
 
         plan = dpp.apply(plan, config, catalog, context)
+    # reorder/DPP introduce projections and filters of their own — prune again
+    plan = rules.PushDownProjection().apply(plan, config, catalog)
     return plan

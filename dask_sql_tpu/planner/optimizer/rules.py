@@ -568,7 +568,16 @@ def _prune(plan, required: Set[int]) -> Tuple[p.LogicalPlan, Dict[int, int]]:
         return p.SubqueryAlias(new_child, plan.alias,
                                list_fields(plan, new_child, cmap)), mapping
 
-    # default: no pruning through this node (Union/Distinct/Window/etc.)
+    # default: this node's own schema stays intact, but children still get a
+    # pruning pass with full requirements (lets scans below Union/Window/
+    # Distinct/Explain drop unused columns via their own chains)
+    kids = plan.inputs()
+    if kids:
+        new_kids = [
+            _prune(k, set(range(len(k.schema))))[0] for k in kids
+        ]
+        if any(a is not b for a, b in zip(kids, new_kids)):
+            plan = plan.with_inputs(new_kids)
     return plan, ident
 
 
