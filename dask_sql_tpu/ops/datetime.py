@@ -81,12 +81,11 @@ def extract(unit: str, ns):
         # ISO week number
         doy = days - days_from_civil(y, jnp.ones_like(m), jnp.ones_like(d)) + 1
         dow_iso = _iso_dow(days)
-        week = _floordiv(doy - dow_iso + 10, 7)
-        # clamp weeks 0 / 53 edge cases to neighbouring years' counts
-        week = jnp.where(week < 1, 52 + ((_is_long_year(y - 1))).astype(week.dtype), week)
-        week = jnp.where(week > 52 + (_is_long_year(y)).astype(week.dtype),
-                         1, week)
-        return week
+        raw = _floordiv(doy - dow_iso + 10, 7)
+        # weeks 0 / 53 belong to the neighbouring ISO year
+        prev_weeks = 52 + _is_long_year(y - 1).astype(raw.dtype)
+        this_weeks = 52 + _is_long_year(y).astype(raw.dtype)
+        return jnp.where(raw < 1, prev_weeks, jnp.where(raw > this_weeks, 1, raw))
     if unit == "dow":
         # Calcite/reference convention: 1 = Sunday ... 7 = Saturday
         return (days + 4) % 7 + 1

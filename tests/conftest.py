@@ -17,6 +17,15 @@ flags = os.environ.get("XLA_FLAGS", "")
 if "host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
 
+# If a sitecustomize already imported jax (e.g. a TPU plugin environment),
+# steer the (possibly pending) backend selection to CPU as well.
+try:  # pragma: no cover - environment dependent
+    import jax as _jax
+
+    _jax.config.update("jax_platforms", "cpu")
+except Exception:
+    pass
+
 import numpy as np
 import pandas as pd
 import pytest
