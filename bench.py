@@ -70,8 +70,27 @@ def run_pandas(df):
     return out
 
 
+def _ensure_backend():
+    """Fall back to CPU when the configured accelerator backend is broken."""
+    import os
+
+    import jax
+
+    try:
+        jax.devices()
+    except Exception:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
+        jax.devices()
+
+
 def main():
     import jax
+
+    _ensure_backend()
 
     from dask_sql_tpu import Context
 
