@@ -147,21 +147,65 @@ class LocationInputPlugin(BaseInputPlugin):
 
 
 class HiveInputPlugin(BaseInputPlugin):
-    """Hive cursor input (parity: reference hive.py:27).  Gated on pyhive."""
+    """Hive cursor input (parity: reference hive.py:27 — reads table metadata
+    via ``DESCRIBE FORMATTED``, reconstructs the storage location and format,
+    and registers the underlying files; partitioned tables are unioned over
+    their partition locations).  Gated on a pyhive/sqlalchemy-hive cursor."""
 
     def is_correct_input(self, input_item, table_name, format=None, **kwargs):
         type_name = ".".join([type(input_item).__module__, type(input_item).__name__])
-        return "pyhive" in type_name or "hive" in type_name.lower() and hasattr(input_item, "execute")
+        return ("pyhive" in type_name
+                or ("hive" in type_name.lower() and hasattr(input_item, "execute")))
+
+    def _fetch_kv(self, cursor, sql: str):
+        cursor.execute(sql)
+        rows = cursor.fetchall()
+        out = {}
+        for row in rows:
+            key = str(row[0]).strip().rstrip(":")
+            val = str(row[1]).strip() if len(row) > 1 and row[1] is not None else ""
+            if key:
+                out[key] = val
+        return out, rows
 
     def to_dc(self, input_item, table_name, format=None, **kwargs):
         cursor = input_item
         hive_table = kwargs.get("hive_table_name", table_name)
         schema = kwargs.get("hive_schema_name", "default")
-        cursor.execute(f"DESCRIBE FORMATTED {schema}.{hive_table}")
-        raise NotImplementedError(
-            "Hive metastore ingestion requires pyhive at runtime; register the "
-            "underlying files directly (parquet/csv locations) instead."
-        )
+        info, rows = self._fetch_kv(cursor, f"DESCRIBE FORMATTED {schema}.{hive_table}")
+        location = info.get("Location", "")
+        in_fmt = info.get("InputFormat", "").lower()
+        if "parquet" in in_fmt:
+            fmt = "parquet"
+        elif "text" in in_fmt or "csv" in in_fmt:
+            fmt = "csv"
+        else:
+            raise NotImplementedError(f"Unsupported hive storage format {in_fmt!r}")
+        location = location.replace("file:", "")
+        # partitioned tables: union all partition locations
+        try:
+            cursor.execute(f"SHOW PARTITIONS {schema}.{hive_table}")
+            partitions = [r[0] for r in cursor.fetchall()]
+        except Exception:
+            partitions = []
+        plugin = LocationInputPlugin()
+        if not partitions:
+            return plugin.to_dc(location.rstrip("/") + "/*", table_name, format=fmt,
+                                persist=True)
+        import pandas as pd
+
+        frames = []
+        for part in partitions:
+            part_path = location.rstrip("/") + "/" + part
+            dc = plugin.to_dc(part_path.rstrip("/") + "/*", table_name, format=fmt,
+                              persist=True)
+            frame = dc.table.to_pandas()
+            for piece in part.split("/"):
+                key, _, val = piece.partition("=")
+                frame[key] = val
+            frames.append(frame)
+        df = pd.concat(frames, ignore_index=True)
+        return DataContainer(Table.from_pandas(df))
 
 
 class IntakeCatalogInputPlugin(BaseInputPlugin):
