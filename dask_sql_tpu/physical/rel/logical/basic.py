@@ -180,13 +180,27 @@ def _intersect_except(rel, executor, plugin, anti: bool) -> Table:
         return left
     # NULLs compare equal in set operations (IS NOT DISTINCT semantics)
     lgid, rgid = join_key_gids(lcols, rcols, null_equals_null=True)
+    if rel.all:
+        # multiset semantics: INTERSECT ALL -> min(count_l, count_r) copies,
+        # EXCEPT ALL -> max(count_l - count_r, 0) copies of each distinct row.
+        # lgid/rgid are already dense joint ids (null_equals_null path), so
+        # counting needs no second factorize
+        num = int(jnp.maximum(lgid.max(), rgid.max() if right.num_rows else 0)) + 1
+        gl, gr = lgid, rgid
+        cl = jax.ops.segment_sum(jnp.ones_like(gl, dtype=jnp.int64), gl, num)
+        cr = jax.ops.segment_sum(jnp.ones_like(gr, dtype=jnp.int64), gr, num)
+        out_counts = jnp.maximum(cl - cr, 0) if anti else jnp.minimum(cl, cr)
+        first = group_first_indices(gl, num)
+        present = jnp.nonzero((out_counts > 0) & (first < left.num_rows))[0]
+        reps = out_counts[present]
+        rows = jnp.repeat(first[present], reps, total_repeat_length=int(reps.sum()))
+        return left.take(rows)
     mask = semi_join_mask(lgid, rgid, anti=anti)
     out = left.filter(mask)
-    if not rel.all:
-        keys = key_arrays([out.columns[n] for n in out.column_names])
-        if out.num_rows:
-            gid, _, num = factorize(keys)
-            out = out.take(jnp.sort(group_first_indices(gid, num)))
+    keys = key_arrays([out.columns[n] for n in out.column_names])
+    if out.num_rows:
+        gid, _, num = factorize(keys)
+        out = out.take(jnp.sort(group_first_indices(gid, num)))
     return out
 
 

@@ -110,3 +110,13 @@ def test_distinct(c, user_table_1):
 def test_wildcard_qualified(c, user_table_1):
     result = c.sql("SELECT u.* FROM user_table_1 u").compute()
     assert_eq(result, user_table_1, check_dtype=False)
+
+def test_intersect_except_all_multiset(c):
+    import pandas as pd
+
+    c.create_table("ml1", pd.DataFrame({"x": [1, 1, 1, 2, 3]}))
+    c.create_table("ml2", pd.DataFrame({"x": [1, 1, 2, 2]}))
+    result = c.sql("SELECT x FROM ml1 INTERSECT ALL SELECT x FROM ml2").compute()
+    assert sorted(result["x"]) == [1, 1, 2]
+    result = c.sql("SELECT x FROM ml1 EXCEPT ALL SELECT x FROM ml2").compute()
+    assert sorted(result["x"]) == [1, 3]
