@@ -210,6 +210,36 @@ def make_hash_shuffle(mesh: Optional[Mesh] = None, capacity_per_peer: int = 4096
 
 
 # ---------------------------------------------------------------------------
+# Broadcast join: replicate the small (build) side, probe locally — no
+# shuffle of the big side at all (parity: reference broadcast joins,
+# join.py:228 + `sql.join.broadcast` config)
+# ---------------------------------------------------------------------------
+def make_broadcast_join_count(mesh: Optional[Mesh] = None):
+    """Jitted broadcast equijoin match-count: the probe side stays put
+    (row-sharded); the build side is all_gather'ed to every device over ICI.
+    Returns per-probe-row match counts, row-sharded like the probe input."""
+    mesh = mesh or default_mesh()
+
+    def per_shard(probe_keys, probe_valid, build_keys, build_valid):
+        # build side arrives shard-local; replicate it
+        all_bk = jax.lax.all_gather(build_keys, AXIS).reshape(-1)
+        all_bv = jax.lax.all_gather(build_valid, AXIS).reshape(-1)
+        big = jnp.iinfo(all_bk.dtype).max
+        b_sorted = jnp.sort(jnp.where(all_bv, all_bk, big))
+        start = jnp.searchsorted(b_sorted, probe_keys, side="left")
+        end = jnp.searchsorted(b_sorted, probe_keys, side="right")
+        counts = jnp.where(probe_valid, end - start, 0)
+        return counts
+
+    fn = shard_map(
+        per_shard, mesh=mesh,
+        in_specs=(P(AXIS), P(AXIS), P(AXIS), P(AXIS)),
+        out_specs=P(AXIS),
+    )
+    return jax.jit(fn)
+
+
+# ---------------------------------------------------------------------------
 # Distributed hash join: shuffle both sides, local sort/searchsorted probe
 # ---------------------------------------------------------------------------
 def make_dist_join_count(mesh: Optional[Mesh] = None, capacity_per_peer: int = 4096):

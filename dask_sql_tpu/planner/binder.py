@@ -998,10 +998,6 @@ class _OuterRef(ColumnRef):
     """
 
 
-def _has_unresolved(e: Expr) -> bool:
-    return any(isinstance(x, _OuterRef) for x in walk(e))
-
-
 def _pick_overload(fns, args):
     """Choose the registered overload whose arity matches (parity: the
     reference's DaskFunction signature map, function.rs)."""

@@ -867,10 +867,6 @@ def _outer_to_local(e: Expr) -> Expr:
     return transform(e, fn)
 
 
-def _demote_projection(e: Expr, sub) -> Expr:
-    return e
-
-
 def _nullable_expr(e: Expr) -> bool:
     for x in walk(e):
         if isinstance(x, ColumnRef) and x.nullable:

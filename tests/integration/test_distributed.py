@@ -101,3 +101,22 @@ def test_dist_join_count(mesh):
     assert not bool(np.asarray(overflow).any())
     expected_total = sum((rk_np == k).sum() for k in lk_np)
     assert int(np.asarray(totals).sum()) == expected_total
+
+
+def test_broadcast_join_count(mesh):
+    from dask_sql_tpu.parallel import collectives as coll
+    from dask_sql_tpu.parallel.mesh import shard_rows
+
+    ndev = mesh.devices.size
+    rng = np.random.RandomState(3)
+    n_probe, n_build = 64 * ndev, 8 * ndev
+    pk_np = rng.randint(0, 30, n_probe).astype(np.int64)
+    bk_np = rng.randint(0, 30, n_build).astype(np.int64)
+    pk = shard_rows(jnp.asarray(pk_np), mesh)
+    bk = shard_rows(jnp.asarray(bk_np), mesh)
+    pv = shard_rows(jnp.ones(n_probe, dtype=bool), mesh)
+    bv = shard_rows(jnp.ones(n_build, dtype=bool), mesh)
+    kernel = coll.make_broadcast_join_count(mesh)
+    counts = kernel(pk, pv, bk, bv)
+    expected = np.array([(bk_np == k).sum() for k in pk_np])
+    np.testing.assert_array_equal(np.asarray(counts), expected)
