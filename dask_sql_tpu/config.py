@@ -31,6 +31,9 @@ DEFAULTS: Dict[str, Any] = {
     # TPU-native additions
     "sql.backend.default": "tpu",
     "sql.shuffle.num_buckets": None,  # None = number of devices
+    "sql.compile": True,  # whole-pipeline jit for hot aggregation shapes
+    "sql.streaming.enabled": True,  # out-of-core parquet batch aggregation
+    "sql.streaming.batch_rows": 2_000_000,
 }
 
 
@@ -56,13 +59,16 @@ class Config:
         options = dict(options or {})
         options.update(kwargs)
         with self._lock:
-            saved = {k: self._values.get(k, DEFAULTS.get(k)) for k in options}
+            saved = {k: self._values[k] for k in options if k in self._values}
+            missing = [k for k in options if k not in self._values]
             self._values.update(options)
         try:
             yield self
         finally:
             with self._lock:
                 self._values.update(saved)
+                for k in missing:
+                    self._values.pop(k, None)
 
 
 #: process-global config (parity: dask.config global)

@@ -48,11 +48,15 @@ class TpuFrame:
     (return_futures=True default, context.py:508).
     """
 
-    def __init__(self, context: "Context", plan, field_names: List[str]):
+    def __init__(self, context: "Context", plan, field_names: List[str],
+                 config_options: Optional[Dict[str, Any]] = None):
         self._context = context
         self._plan = plan
         self._field_names = field_names
         self._result: Optional[Table] = None
+        #: per-query overrides re-applied at execution time (lazy compute
+        #: happens after Context.sql's config scope has exited)
+        self._config_options = dict(config_options or {})
 
     @property
     def plan(self):
@@ -67,8 +71,9 @@ class TpuFrame:
         if self._result is None:
             from .physical.executor import Executor
 
-            executor = Executor(self._context)
-            self._result = executor.execute(self._plan)
+            with self._context.config.set(self._config_options):
+                executor = Executor(self._context)
+                self._result = executor.execute(self._plan)
         return self._result
 
     def compute(self):
@@ -284,14 +289,14 @@ class Context:
             statements = parse_sql(sql)
             result = None
             for stmt in statements:
-                result = self._run_statement(stmt)
+                result = self._run_statement(stmt, config_options)
             if result is None:
                 return None
             if return_futures:
                 return result
             return result.compute()
 
-    def _run_statement(self, stmt) -> Optional[TpuFrame]:
+    def _run_statement(self, stmt, config_options=None) -> Optional[TpuFrame]:
         plan = self._get_ral(stmt)
         if isinstance(plan, plan_nodes.CustomNode) and not isinstance(
                 plan, (plan_nodes.PredictModelNode,)):
@@ -302,10 +307,10 @@ class Context:
             table = Executor(self).execute(plan)
             if not table.columns:
                 return None
-            frame = TpuFrame(self, plan, list(table.column_names))
+            frame = TpuFrame(self, plan, list(table.column_names), config_options)
             frame._result = table
             return frame
-        return TpuFrame(self, plan, [f.name for f in plan.schema])
+        return TpuFrame(self, plan, [f.name for f in plan.schema], config_options)
 
     def explain(self, sql: str, dataframes: Optional[Dict[str, Any]] = None) -> str:
         """Return the optimized logical plan as a string (parity context.py:535)."""

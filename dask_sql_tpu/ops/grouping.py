@@ -258,6 +258,31 @@ def seg_last(values, valid, gid, num_groups):
     return values[safe], cnt > 0
 
 
+def seg_percentile(values, valid, gid, num_groups, q: float):
+    """Exact per-group quantile: one lexsort by (group, validity, value), then
+    a linear-interpolated pick at the group offset (PERCENTILE_CONT rule).
+    TPU-shaped: sort + gathers, no per-group loops."""
+    n = values.shape[0]
+    if n == 0:
+        return (jnp.zeros(num_groups, dtype=jnp.float64),
+                jnp.zeros(num_groups, dtype=bool))
+    x = values.astype(jnp.float64)
+    x = jnp.where(valid, x, jnp.inf)  # invalid (and NaN-masked) sort last
+    order = jnp.lexsort((x, (~valid).astype(jnp.int32), gid))
+    sorted_gid = gid[order]
+    sorted_val = x[order]
+    idx = jnp.arange(n, dtype=jnp.int64)
+    starts = jnp.full(num_groups, n, dtype=jnp.int64).at[sorted_gid].min(idx)
+    cnt = seg_count(valid, gid, num_groups)
+    k = jnp.maximum(cnt - 1, 0).astype(jnp.float64) * q
+    lo = jnp.floor(k).astype(jnp.int64)
+    hi = jnp.ceil(k).astype(jnp.int64)
+    frac = k - lo
+    safe = lambda i: jnp.clip(starts + i, 0, max(n - 1, 0))
+    v = sorted_val[safe(lo)] * (1.0 - frac) + sorted_val[safe(hi)] * frac
+    return v, cnt > 0
+
+
 def _extreme(dtype, maximum: bool):
     if jnp.issubdtype(dtype, jnp.floating):
         return jnp.array(jnp.inf if maximum else -jnp.inf, dtype=dtype)

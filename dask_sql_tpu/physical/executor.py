@@ -29,6 +29,8 @@ class Executor:
         self.tracer = Tracer()
         if trace:
             self.tracer.start()
+        #: (schema, table) -> Table substitutions (streaming batch execution)
+        self.table_overrides: Dict[tuple, Table] = {}
 
     @classmethod
     def add_plugin_class(cls, plugin_class):

@@ -35,7 +35,11 @@ class AggregatePlugin(BaseRelPlugin):
 
     def convert(self, rel: p.Aggregate, executor) -> Table:
         from ...compiled import try_compiled_aggregate
+        from ...streaming import try_streaming_aggregate
 
+        streamed = try_streaming_aggregate(rel, executor)
+        if streamed is not None:
+            return streamed
         compiled = try_compiled_aggregate(rel, executor)
         if compiled is not None:
             return compiled
@@ -164,6 +168,15 @@ class AggregatePlugin(BaseRelPlugin):
         if func == "last_value":
             vals, ok = g.seg_last(values, valid, gid, num_groups)
             return _mk_like(vals, ok, col, agg.sql_type)
+        if func == "percentile":
+            # MEDIAN(x) / APPROX_PERCENTILE(x, q) / PERCENTILE_CONT..WITHIN GROUP
+            q = 0.5
+            if len(args) > 1:
+                qv = np.asarray(args[1].data).reshape(-1)
+                if qv.size:
+                    q = float(qv[0])
+            vals, ok = g.seg_percentile(_numeric(values), valid, gid, num_groups, q)
+            return _mk(vals, ok, SqlType.DOUBLE)
         if func == "approx_count_distinct":
             cols = [col]
             return self._count_distinct(cols, valid, gid, num_groups)

@@ -30,9 +30,14 @@ class TableScanPlugin(BaseRelPlugin):
     def convert(self, rel: p.TableScan, executor) -> Table:
         from ....datacontainer import LazyParquetContainer
 
+        override = executor.table_overrides.get((rel.schema_name, rel.table_name))
         dc = executor.context.schema.get(rel.schema_name)
         dc = dc.tables.get(rel.table_name) if dc is not None else None
-        if isinstance(dc, LazyParquetContainer):
+        if override is not None:
+            table = override
+            if rel.projection is not None:
+                table = table.select([c for c in rel.projection if c in table.columns])
+        elif isinstance(dc, LazyParquetContainer):
             # lazy parquet: read only projected columns; convertible filter
             # conjuncts prune row groups at the IO layer (pyarrow `filters=`,
             # parity: reference table_scan.py:80-119 DNF pushdown)

@@ -1,0 +1,254 @@
+"""Out-of-core aggregation: stream parquet batches through partial->final states.
+
+Role parity: the reference's partitioned execution — dask runs the chunk/agg/
+finalize triple of `dd.Aggregation` per partition and tree-combines
+(aggregate.py:117-160, split_every).  Here the "partitions" are parquet
+row-group batches: each batch is scanned (with projection + IO filters),
+filtered/projected on device, partially aggregated, and only the small
+partial-state tables stay resident — rows never all live in HBM at once.
+This is the row-axis scaling story (SURVEY.md §5 "long-context" analogue).
+
+Eligibility: the same scan→filter/project→aggregate chains the compiled
+pipeline handles, with partial-izable aggregates (sum/count/avg/min/max/
+var/std family).  Ineligible shapes silently fall back to the in-memory path.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+
+from ..columnar.column import Column
+from ..columnar.dtypes import SqlType
+from ..columnar.table import Table
+from ..datacontainer import LazyParquetContainer
+from ..planner import plan as p
+from ..planner.expressions import AggExpr
+from .compiled import _extract_chain
+
+logger = logging.getLogger(__name__)
+
+#: (partial_name, partial_func) sets per supported aggregate
+_PARTIALIZABLE = {
+    "sum": ("sum",),
+    "count": ("count",),
+    "count_star": ("count_star",),
+    "avg": ("sum", "count"),
+    "min": ("min",),
+    "max": ("max",),
+    "var_samp": ("count", "sum", "sumsq"),
+    "var_pop": ("count", "sum", "sumsq"),
+    "stddev_samp": ("count", "sum", "sumsq"),
+    "stddev_pop": ("count", "sum", "sumsq"),
+}
+
+
+def try_streaming_aggregate(rel: p.Aggregate, executor) -> Optional[Table]:
+    config = executor.config
+    if not config.get("sql.streaming.enabled", True):
+        return None
+    chain = _extract_chain(rel)
+    if chain is None:
+        return None
+    scan = chain[0]
+    dc = executor.context.schema.get(scan.schema_name)
+    dc = dc.tables.get(scan.table_name) if dc is not None else None
+    if not isinstance(dc, LazyParquetContainer):
+        return None
+    batch_rows = int(config.get("sql.streaming.batch_rows", 2_000_000))
+    total = (dc.statistics or {}).get("num-rows", 0)
+    if not total or total <= batch_rows:
+        return None  # fits comfortably; the compiled in-memory path is faster
+    for agg in rel.agg_exprs:
+        if agg.func not in _PARTIALIZABLE or agg.distinct:
+            return None
+
+    # -- build the per-batch partial plan over the scan schema --------------
+    # partial aggs: dedup (func, args, filter) structurally
+    partial_specs: List[Tuple[str, AggExpr]] = []
+    spec_index: Dict = {}
+
+    def partial_of(agg: AggExpr, kind: str) -> int:
+        if kind == "count_star":
+            probe = AggExpr("count_star", (), SqlType.BIGINT, False, agg.filter)
+        elif kind == "sumsq":
+            probe = AggExpr("sumsq", agg.args, SqlType.DOUBLE, False, agg.filter)
+        else:
+            out_t = SqlType.BIGINT if kind == "count" else (
+                SqlType.DOUBLE if kind in ("sum",) else agg.sql_type)
+            probe = AggExpr(kind, agg.args, out_t, False, agg.filter)
+        key = (probe.func, probe.args, probe.filter)
+        if key not in spec_index:
+            spec_index[key] = len(partial_specs)
+            partial_specs.append((kind, probe))
+        return spec_index[key]
+
+    finalize: List[Tuple[str, List[int]]] = []
+    for agg in rel.agg_exprs:
+        kinds = _PARTIALIZABLE[agg.func]
+        finalize.append((agg.func, [partial_of(agg, k) for k in kinds]))
+
+    from ..ops import grouping as g
+
+    # -- stream batches ------------------------------------------------------
+    from .executor import Executor
+
+    names = scan.projection if scan.projection is not None else [
+        f.name for f in dc.fields]
+    from .utils.filter import filters_to_pyarrow
+
+    pa_filters, _ = filters_to_pyarrow(scan.filters, list(names))
+
+    partial_tables: List[Table] = []
+    ngroups = len(rel.group_exprs)
+    for batch in _iter_batches(dc, names, pa_filters, batch_rows):
+        sub = Executor(executor.context)
+        sub.table_overrides[(scan.schema_name, scan.table_name)] = batch
+        # execute the original subtree up to (excluding) the aggregate
+        inp_table = sub.execute(rel.input)
+        gcols = [sub.eval_expr(e, inp_table) for e in rel.group_exprs]
+        if inp_table.num_rows == 0:
+            continue
+        gid, order, num_groups = (g.factorize(g.key_arrays(gcols))
+                                  if gcols else
+                                  (jnp.zeros(inp_table.num_rows, dtype=jnp.int32),
+                                   None, 1))
+        cols: Dict[str, Column] = {}
+        if gcols and num_groups > 0:
+            first = g.group_first_indices(gid, num_groups)
+            for i, col in enumerate(gcols):
+                cols[f"__g{i}"] = col.take(first)
+        for j, (kind, probe) in enumerate(partial_specs):
+            cols[f"__p{j}"] = _partial_kernel(kind, probe, inp_table, gid,
+                                              num_groups, sub)
+        partial_tables.append(Table(cols, num_groups))
+
+    if not partial_tables:
+        # no rows anywhere: fall back to the normal path for correct empties
+        return None
+
+    # -- final combine -------------------------------------------------------
+    combined = Table.concat(partial_tables)
+    gcols = [combined.columns[f"__g{i}"] for i in range(ngroups)]
+    if gcols:
+        gid, order, num_groups = g.factorize(g.key_arrays(gcols))
+        first = g.group_first_indices(gid, num_groups)
+    else:
+        gid = jnp.zeros(combined.num_rows, dtype=jnp.int32)
+        num_groups = 1
+        first = jnp.zeros(1, dtype=jnp.int64)
+
+    from .rel.base import unique_names
+
+    out_names = unique_names([f.name for f in rel.schema])
+    out: Dict[str, Column] = {}
+    for name, col in zip(out_names, gcols):
+        out[name] = col.take(first)
+
+    def combine(j: int, how: str):
+        col = combined.columns[f"__p{j}"]
+        if col.dictionary is not None:
+            col = col.compact_dictionary()  # sorted codes = lexicographic order
+        valid = col.valid_mask()
+        if how == "sum":
+            vals, ok = g.seg_sum(col.data, valid, gid, num_groups)
+        elif how == "min":
+            vals, ok = g.seg_min(col.data, valid, gid, num_groups)
+        else:
+            vals, ok = g.seg_max(col.data, valid, gid, num_groups)
+        return vals, ok, col
+
+    for name, agg, (func, idxs) in zip(out_names[ngroups:], rel.agg_exprs, finalize):
+        if func in ("sum", "count", "count_star"):
+            vals, ok = combine(idxs[0], "sum")[:2]
+            out[name] = _typed(vals, ok if func == "sum" else None, agg.sql_type)
+        elif func == "avg":
+            s = combine(idxs[0], "sum")[0]
+            cnt = combine(idxs[1], "sum")[0]
+            ok = cnt > 0
+            out[name] = _typed(s.astype(jnp.float64) / jnp.maximum(cnt, 1), ok,
+                               SqlType.DOUBLE)
+        elif func in ("min", "max"):
+            vals, ok, src_col = combine(idxs[0], func)
+            validity = None if bool(ok.all()) else ok
+            out[name] = Column(vals, agg.sql_type, validity, src_col.dictionary)
+        else:  # variance family from (count, sum, sumsq)
+            cnt = combine(idxs[0], "sum")[0]
+            s = combine(idxs[1], "sum")[0]
+            s2 = combine(idxs[2], "sum")[0]
+            ddof = 1 if func.endswith("samp") else 0
+            mean = s / jnp.maximum(cnt, 1)
+            var = jnp.maximum(s2 - cnt * mean * mean, 0.0) / jnp.maximum(cnt - ddof, 1)
+            vals = jnp.sqrt(var) if func.startswith("stddev") else var
+            out[name] = _typed(vals, cnt > ddof, SqlType.DOUBLE)
+    logger.info("streaming aggregate over %d batches", len(partial_tables))
+    return Table(out, num_groups)
+
+
+def _typed(vals, ok, sql_type: SqlType) -> Column:
+    from ..columnar.dtypes import sql_to_np
+
+    target = sql_to_np(sql_type)
+    vals = vals.astype(target) if vals.dtype != target else vals
+    validity = None if ok is None or bool(ok.all()) else ok
+    return Column(vals, sql_type, validity)
+
+
+def _partial_kernel(kind: str, probe: AggExpr, inp: Table, gid, num_groups, sub) -> Column:
+    from ..ops import grouping as g
+
+    n = inp.num_rows
+    fmask = None
+    if probe.filter is not None:
+        fc = sub.eval_expr(probe.filter, inp)
+        fmask = fc.data & fc.valid_mask()
+    if kind == "count_star":
+        valid = jnp.ones(n, dtype=bool) if fmask is None else fmask
+        return Column(g.seg_count(valid, gid, num_groups), SqlType.BIGINT)
+    col = sub.eval_expr(probe.args[0], inp)
+    valid = col.valid_mask()
+    if fmask is not None:
+        valid = valid & fmask
+    if jnp.issubdtype(col.data.dtype, jnp.floating):
+        valid = valid & ~jnp.isnan(col.data)
+    if kind == "count":
+        return Column(g.seg_count(valid, gid, num_groups), SqlType.BIGINT)
+    if kind == "sum":
+        # preserve exact int64 accumulation (parity with the in-memory path)
+        if jnp.issubdtype(col.data.dtype, jnp.integer) or col.data.dtype == jnp.bool_:
+            vals, ok = g.seg_sum(col.data.astype(jnp.int64), valid, gid, num_groups)
+            return _typed(vals, ok, SqlType.BIGINT)
+        vals, ok = g.seg_sum(col.data.astype(jnp.float64), valid, gid, num_groups)
+        return _typed(vals, ok, SqlType.DOUBLE)
+    if kind == "sumsq":
+        x = col.data.astype(jnp.float64)
+        vals, ok = g.seg_sum(x * x, valid, gid, num_groups)
+        return _typed(vals, ok, SqlType.DOUBLE)
+    if col.dictionary is not None:
+        # sorted dictionary => code order == lexicographic order per batch
+        col = col.compact_dictionary()
+        valid = col.valid_mask() if fmask is None else (col.valid_mask() & fmask)
+    if kind == "min":
+        vals, ok = g.seg_min(col.data, valid, gid, num_groups)
+        return Column(vals, col.sql_type, None if bool(ok.all()) else ok, col.dictionary)
+    vals, ok = g.seg_max(col.data, valid, gid, num_groups)
+    return Column(vals, col.sql_type, None if bool(ok.all()) else ok, col.dictionary)
+
+
+def _iter_batches(dc: LazyParquetContainer, columns, pa_filters, batch_rows: int):
+    """Stream record batches through the dataset scanner — rows with a filter
+    are pruned per row group and never fully materialized on the host."""
+    import pyarrow as pa
+    import pyarrow.dataset as ds
+    import pyarrow.parquet as pq
+
+    from .utils.statistics import _paths_for
+
+    expr = pq.filters_to_expression(pa_filters) if pa_filters else None
+    dataset = ds.dataset(_paths_for(dc.location), format="parquet")
+    scanner = dataset.scanner(columns=list(columns) if columns else None,
+                              filter=expr, batch_size=batch_rows)
+    for record_batch in scanner.to_batches():
+        if record_batch.num_rows:
+            yield Table.from_arrow(pa.Table.from_batches([record_batch]))

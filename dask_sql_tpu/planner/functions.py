@@ -145,6 +145,11 @@ AGGREGATE_FUNCTIONS: Dict[str, Tuple[str, str]] = {
     "REGR_SXX": ("regr_sxx", "double"),
     "REGR_SYY": ("regr_syy", "double"),
     "APPROX_COUNT_DISTINCT": ("approx_count_distinct", "bigint"),
+    # percentile family (BASELINE config 5; device sort-based exact quantiles)
+    "MEDIAN": ("percentile", "double"),
+    "APPROX_PERCENTILE": ("percentile", "double"),
+    "PERCENTILE_CONT": ("percentile", "double"),
+    "QUANTILE": ("percentile", "double"),
 }
 
 #: pure window functions (aggregates are also usable OVER windows)

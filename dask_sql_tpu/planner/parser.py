@@ -996,6 +996,29 @@ class Parser:
             ignore_nulls = True
         elif self.accept_keyword("RESPECT"):
             self.expect_keyword("NULLS")
+        if self.at_keyword("WITHIN"):
+            # PERCENTILE_CONT(q) WITHIN GROUP (ORDER BY x) — rewrite to (x, q)
+            self.next()
+            self.expect_keyword("GROUP")
+            self.expect("(")
+            self.expect_keyword("ORDER")
+            self.expect_keyword("BY")
+            order_expr = self.parse_expr()
+            desc = False
+            if self.accept_keyword("DESC"):
+                desc = True
+            else:
+                self.accept_keyword("ASC")
+            self.expect(")")
+            if args and isinstance(args[0], a.Literal) and isinstance(args[0].value, (int, float)):
+                q = args[0].value
+                if desc:
+                    q = 1.0 - float(q)
+                args = [order_expr, a.Literal(float(q))]
+            else:
+                raise ParsingException(
+                    "WITHIN GROUP requires a numeric literal fraction, e.g. "
+                    "PERCENTILE_CONT(0.5) WITHIN GROUP (ORDER BY x)")
         filter_expr = None
         if self.at_keyword("FILTER") and self.peek(1).value == "(":
             self.next()

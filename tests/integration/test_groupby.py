@@ -120,3 +120,21 @@ def test_group_by_distinct_agg(c, user_table_1):
 def test_distinct_plain(c, df):
     result = c.sql("SELECT DISTINCT a FROM df").compute()
     assert sorted(result["a"]) == [1.0, 2.0, 3.0]
+
+def test_percentile_aggregates(c, df):
+    result = c.sql(
+        """SELECT a, MEDIAN(b) AS med, APPROX_PERCENTILE(b, 0.9) AS p90,
+                  PERCENTILE_CONT(0.25) WITHIN GROUP (ORDER BY b) AS q1
+           FROM df GROUP BY a"""
+    ).compute().sort_values("a").reset_index(drop=True)
+    g = df.groupby("a").b
+    np.testing.assert_allclose(result["med"], g.quantile(0.5).values, rtol=1e-9)
+    np.testing.assert_allclose(result["p90"], g.quantile(0.9).values, rtol=1e-9)
+    np.testing.assert_allclose(result["q1"], g.quantile(0.25).values, rtol=1e-9)
+
+def test_median_with_nulls(c):
+    df = pd.DataFrame({"g": [1, 1, 1, 2], "v": [1.0, None, 3.0, 5.0]})
+    c.create_table("mednull", df)
+    result = c.sql("SELECT g, MEDIAN(v) AS m FROM mednull GROUP BY g").compute()
+    result = result.sort_values("g").reset_index(drop=True)
+    assert list(result["m"]) == [2.0, 5.0]
