@@ -1,0 +1,56 @@
+"""End-to-end tour of dask-sql-tpu.
+
+Run: env PYTHONPATH=.. JAX_PLATFORMS=cpu python demo.py   (from examples/)
+"""
+import numpy as np
+import pandas as pd
+
+from dask_sql_tpu import Context
+
+
+def main():
+    c = Context()
+    rng = np.random.RandomState(0)
+    n = 100_000
+    orders = pd.DataFrame({
+        "region": rng.choice(["emea", "amer", "apac"], n),
+        "amount": np.round(rng.gamma(2.0, 50.0, n), 2),
+        "placed": (np.datetime64("2024-01-01")
+                   + rng.randint(0, 365 * 24 * 3600, n).astype("timedelta64[s]")),
+    })
+    c.create_table("orders", orders)
+
+    print("-- aggregate --")
+    print(c.sql("""
+        SELECT region, COUNT(*) AS orders, SUM(amount) AS revenue,
+               MEDIAN(amount) AS median_ticket
+        FROM orders GROUP BY region ORDER BY revenue DESC
+    """, return_futures=False))
+
+    print("-- window --")
+    print(c.sql("""
+        SELECT region, month, revenue,
+               revenue - LAG(revenue) OVER (PARTITION BY region ORDER BY month) AS delta
+        FROM (SELECT region, FLOOR(placed TO MONTH) AS month, SUM(amount) AS revenue
+              FROM orders GROUP BY region, FLOOR(placed TO MONTH)) AS monthly
+        ORDER BY region, month LIMIT 8
+    """, return_futures=False))
+
+    print("-- ML --")
+    c.sql("""
+        CREATE MODEL spend_cluster WITH (model_class = 'KMeans', n_clusters = 3)
+        AS (SELECT amount, EXTRACT(HOUR FROM placed) AS hr FROM orders LIMIT 10000)
+    """)
+    print(c.sql("""
+        SELECT target AS cluster, COUNT(*) AS n
+        FROM PREDICT(MODEL spend_cluster,
+                     SELECT amount, EXTRACT(HOUR FROM placed) AS hr FROM orders LIMIT 10000)
+        GROUP BY target ORDER BY n DESC
+    """, return_futures=False))
+
+    print("-- plan --")
+    print(c.explain("SELECT region, SUM(amount) FROM orders WHERE amount > 100 GROUP BY region"))
+
+
+if __name__ == "__main__":
+    main()
