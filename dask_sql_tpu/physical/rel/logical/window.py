@@ -216,8 +216,21 @@ def _compute_window(w: WindowExpr, args: List[Column], lay: _SortedLayout) -> Co
         default = args[2] if len(args) > 2 else None
         xs = x.data[lay.perm]
         xv = x.valid_mask()[lay.perm]
-        j = i - off if func == "lag" else i + off
-        inside = (j >= lay.seg_start) & (j < lay.seg_end)
+        if w.ignore_nulls:
+            # k-th previous/next VALID value: rank rows among valid ones
+            P = jnp.cumsum(xv.astype(jnp.int64))  # valids among rows [0..i]
+            valid_pos = jnp.nonzero(xv)[0]
+            nvalid = int(valid_pos.shape[0])
+            if func == "lag":
+                rank = P - xv.astype(jnp.int64) - off  # 0-based among prior valids
+            else:
+                rank = P + off - 1  # 0-based among valids up to target
+            ok = (rank >= 0) & (rank < nvalid)
+            j = valid_pos[jnp.clip(rank, 0, max(nvalid - 1, 0))] if nvalid else jnp.zeros(n, dtype=jnp.int64)
+            inside = ok & (j >= lay.seg_start) & (j < lay.seg_end)
+        else:
+            j = i - off if func == "lag" else i + off
+            inside = (j >= lay.seg_start) & (j < lay.seg_end)
         j_safe = jnp.clip(j, 0, n - 1)
         vals = xs[j_safe]
         valid = xv[j_safe] & inside
@@ -236,11 +249,24 @@ def _compute_window(w: WindowExpr, args: List[Column], lay: _SortedLayout) -> Co
         x = args[0]
         xs = x.data[lay.perm]
         xv = x.valid_mask()[lay.perm]
-        if func == "first_value":
+        if w.ignore_nulls and func in ("first_value", "last_value"):
+            idx64 = jnp.arange(n, dtype=jnp.int64)
+            if func == "first_value":
+                # next valid index at-or-after each position (reverse cummin)
+                marked = jnp.where(xv, idx64, n)
+                nxt = jax.lax.cummin(marked[::-1])[::-1]
+                j = nxt[jnp.clip(lo, 0, n - 1)]
+            else:
+                marked = jnp.where(xv, idx64, -1)
+                prev = jax.lax.cummax(marked)
+                j = prev[jnp.clip(hi - 1, 0, n - 1)]
+        elif func == "first_value":
             j = lo
         elif func == "last_value":
             j = hi - 1
         else:
+            if w.ignore_nulls:
+                raise NotImplementedError("NTH_VALUE ... IGNORE NULLS is not supported")
             k = int(np.asarray(args[1].data)[0])
             j = lo + (k - 1)
         inside = (j >= lo) & (j < hi) & (hi > lo)

@@ -234,6 +234,15 @@ class Binder:
     # ---------------------------------------------------------- select core
     def _bind_select_core(self, q: a.Select, outer: Optional[Scope],
                           order_by: Optional[List[a.OrderItem]] = None) -> Tuple[p.LogicalPlan, Scope]:
+        # named windows are per-SELECT; nested subquery binds must not clobber
+        prev_windows = getattr(self, "_named_windows", {})
+        try:
+            return self._bind_select_core_inner(q, outer, order_by)
+        finally:
+            self._named_windows = prev_windows
+
+    def _bind_select_core_inner(self, q: a.Select, outer: Optional[Scope],
+                                order_by: Optional[List[a.OrderItem]] = None) -> Tuple[p.LogicalPlan, Scope]:
         if q.values is not None:
             return self._bind_values(q)
         # FROM
@@ -246,6 +255,7 @@ class Binder:
         if q.where is not None:
             pred = self._coerce_bool(self.bind_expr(q.where, scope))
             plan = p.Filter(plan, pred, plan.schema)
+        self._named_windows = dict(q.named_windows or {})
         # bind select items (pre-aggregate binding; aggs collected after)
         proj_exprs: List[Expr] = []
         proj_names: List[str] = []
@@ -904,6 +914,15 @@ class Binder:
 
     def _bind_window_call(self, name, args, e: a.FunctionCall, scope: Scope) -> WindowExpr:
         spec = e.over
+        if isinstance(spec, str):
+            named = getattr(self, "_named_windows", {})
+            if spec in named:
+                spec = named[spec]
+            elif not self.case_sensitive and spec.lower() in {
+                    k.lower() for k in named}:
+                spec = next(v for k, v in named.items() if k.lower() == spec.lower())
+            else:
+                raise BindError(f"Unknown window name {spec!r}")
         partition = tuple(self.bind_expr(x, scope) for x in spec.partition_by)
         order = tuple(
             SortKey(self.bind_expr(it.expr, scope), it.ascending, it.nulls_first)
@@ -939,7 +958,8 @@ class Binder:
                 wspec = WindowSpec(partition, order, "ROWS",
                                    WindowFrameBound("UNBOUNDED_PRECEDING"),
                                    WindowFrameBound("UNBOUNDED_FOLLOWING"), False)
-        return WindowExpr(func, tuple(a_ for a_ in args if a_ is not None), wspec, sql_type)
+        return WindowExpr(func, tuple(a_ for a_ in args if a_ is not None), wspec,
+                          sql_type, e.ignore_nulls)
 
     # ------------------------------------------------------------- coercion
     def _coerce_bool(self, e: Expr) -> Expr:

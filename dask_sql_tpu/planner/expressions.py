@@ -206,6 +206,7 @@ class WindowExpr(Expr):
     args: Tuple[Expr, ...]
     spec: WindowSpec
     sql_type: SqlType
+    ignore_nulls: bool = False
 
     def children(self):
         return (list(self.args) + list(self.spec.partition_by)

@@ -422,6 +422,16 @@ class Parser:
                 sel.group_by.append(self._parse_group_item())
         if self.accept_keyword("HAVING"):
             sel.having = self.parse_expr()
+        if self.at_keyword("WINDOW") and self.peek(1).type in (
+                TokenType.IDENT, TokenType.QUOTED_IDENT) \
+                and self.peek(2).upper == "AS":
+            self.next()
+            while True:
+                wname = self.parse_identifier()
+                self.expect_keyword("AS")
+                sel.named_windows[wname] = self._parse_window_spec()
+                if not self.accept(","):
+                    break
         if self.at_keyword("DISTRIBUTE"):
             self.next()
             self.expect_keyword("BY")
@@ -1028,7 +1038,10 @@ class Parser:
             self.expect(")")
         over = None
         if self.accept_keyword("OVER"):
-            over = self._parse_window_spec()
+            if self.peek().value == "(":
+                over = self._parse_window_spec()
+            else:
+                over = self.parse_identifier()  # named window, resolved in binder
         return a.FunctionCall(name.upper(), args, distinct, filter_expr, over, ignore_nulls)
 
     def _parse_window_spec(self) -> a.WindowSpec:

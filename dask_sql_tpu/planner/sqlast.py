@@ -294,6 +294,7 @@ class Select:
     set_op: Optional[Tuple[str, bool, "Select"]] = None  # (UNION|INTERSECT|EXCEPT, all, rhs)
     distribute_by: List[Expr] = field(default_factory=list)
     values: Optional[List[List[Expr]]] = None  # VALUES (...) , (...)
+    named_windows: Dict[str, "WindowSpec"] = field(default_factory=dict)  # WINDOW w AS (...)
 
 
 # ---------------------------------------------------------------------------

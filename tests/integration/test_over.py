@@ -122,3 +122,30 @@ def test_percent_rank_cume_dist(c):
     np.testing.assert_allclose(result["p"], [0, 1 / 3, 2 / 3, 1.0])
     np.testing.assert_allclose(result["cd"], [0.25, 0.5, 0.75, 1.0])
     assert list(result["nt"]) == [1, 1, 2, 2]
+
+def test_ignore_nulls_lag_first(c):
+    df = pd.DataFrame({
+        "g": ["a"] * 5,
+        "o": [1, 2, 3, 4, 5],
+        "v": [10.0, None, None, 40.0, 50.0],
+    })
+    c.create_table("ign", df)
+    result = c.sql(
+        """SELECT o, LAG(v) IGNORE NULLS OVER (PARTITION BY g ORDER BY o) AS lg,
+                  FIRST_VALUE(v) IGNORE NULLS OVER (PARTITION BY g ORDER BY o
+                      ROWS BETWEEN 1 FOLLOWING AND UNBOUNDED FOLLOWING) AS fv,
+                  LEAD(v) IGNORE NULLS OVER (PARTITION BY g ORDER BY o) AS ld
+           FROM ign"""
+    ).compute().sort_values("o").reset_index(drop=True)
+    assert list(result["lg"].fillna(-1)) == [-1, 10.0, 10.0, 10.0, 40.0]
+    assert list(result["ld"].fillna(-1)) == [40.0, 40.0, 40.0, 50.0, -1]
+    assert list(result["fv"].fillna(-1)) == [40.0, 40.0, 40.0, 50.0, -1]
+
+def test_named_window(c, win_df):
+    result = c.sql(
+        """SELECT g, x, SUM(x) OVER w AS cs, ROW_NUMBER() OVER w AS rn
+           FROM win WINDOW w AS (PARTITION BY g ORDER BY x)"""
+    ).compute().sort_values(["g", "x"]).reset_index(drop=True)
+    srt = win_df.sort_values(["g", "x"])
+    assert list(result["cs"]) == list(srt.groupby("g").x.cumsum())
+    assert list(result["rn"]) == list(srt.groupby("g").cumcount() + 1)
