@@ -311,6 +311,19 @@ def _minmax_n(fn):
         target = cols[0].sql_type
         for c in cols[1:]:
             target = promote(target, c.sql_type)
+        if target in STRING_TYPES:
+            # lexicographic element-wise min/max via the host (dictionaries
+            # differ per column; NULL propagates)
+            take_min = fn is jnp.minimum
+            arrs = [c.to_numpy() for c in cols]
+            out = np.empty(len(arrs[0]), dtype=object)
+            for i in range(len(out)):
+                vals = [a[i] for a in arrs]
+                if any(v is None for v in vals):
+                    out[i] = None
+                else:
+                    out[i] = min(vals) if take_min else max(vals)
+            return Column.from_numpy(out)
         cs = [c.cast(target) for c in cols]
         data = cs[0].data
         for c in cs[1:]:

@@ -701,6 +701,12 @@ class Binder:
             arg = self.bind_expr(e.operand, scope)
             low = self.bind_expr(e.low, scope)
             high = self.bind_expr(e.high, scope)
+            if e.symmetric:
+                # bounds may arrive in either order; bound exprs are shared so
+                # embedded subquery plans stay single-execution (executor memo)
+                t = promote(low.sql_type, high.sql_type)
+                low, high = (ScalarFunc("least", (low, high), t),
+                             ScalarFunc("greatest", (low, high), t))
             arg_l, low = self._coerce_pair(arg, low)
             arg_h, high = self._coerce_pair(arg, high)
             cond = ScalarFunc("and", (

@@ -646,11 +646,11 @@ class Parser:
             if self.accept_keyword("NOT"):
                 negated = True
             if self.accept_keyword("BETWEEN"):
-                self.accept_keyword("SYMMETRIC")
+                symmetric = self.accept_keyword("SYMMETRIC")
                 low = self.parse_comparison()
                 self.expect_keyword("AND")
                 high = self.parse_comparison()
-                left = a.Between(left, low, high, negated)
+                left = a.Between(left, low, high, negated, symmetric)
                 continue
             if self.accept_keyword("IN"):
                 self.expect("(")

@@ -103,6 +103,7 @@ class Between(Expr):
     low: Expr
     high: Expr
     negated: bool = False
+    symmetric: bool = False
 
 
 @dataclass

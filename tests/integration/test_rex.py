@@ -167,3 +167,16 @@ def test_greatest_least(c, df):
     result = c.sql("SELECT GREATEST(a, b) AS g, LEAST(a, b) AS l FROM df").compute()
     np.testing.assert_allclose(result["g"], np.maximum(df.a, df.b))
     np.testing.assert_allclose(result["l"], np.minimum(df.a, df.b))
+
+def test_between_symmetric(c):
+    c.create_table("sym", pd.DataFrame({"x": [1, 3, 5, 7], "s": ["alice", "bob", "carol", "zed"]}))
+    result = c.sql("SELECT x FROM sym WHERE x BETWEEN SYMMETRIC 6 AND 2").compute()
+    assert sorted(result["x"]) == [3, 5]
+    result = c.sql("SELECT s FROM sym WHERE s BETWEEN SYMMETRIC 'bob' AND 'alice'").compute()
+    assert sorted(result["s"]) == ["alice", "bob"]
+
+def test_least_greatest_strings(c):
+    c.create_table("lgs", pd.DataFrame({"p": ["pear", "apple"], "q": ["fig", "quince"]}))
+    result = c.sql("SELECT LEAST(p, q) AS lo, GREATEST(p, q) AS hi FROM lgs").compute()
+    assert list(result["lo"]) == ["fig", "apple"]
+    assert list(result["hi"]) == ["pear", "quince"]
