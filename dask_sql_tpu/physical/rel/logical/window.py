@@ -90,6 +90,27 @@ class _SortedLayout:
         # segment/peer end (exclusive): next start, scanned from the right
         self.seg_end = _next_start(self.new_seg, n)
         self.peer_end = _next_start(self.new_peer, n)
+        # single numeric/datetime order key: value source for RANGE offsets
+        # (materialized lazily — only RANGE-offset frames pay for it)
+        self._order_col = order_cols[0] if len(order_cols) == 1 else None
+        self._order_asc = ascendings[0] if ascendings else True
+        self._order_sorted = None
+
+    def order_values(self):
+        """Ascending-within-segment order-key values, or None when RANGE
+        offsets are unsupported (multi-key, strings, bools, NULLs/NaNs —
+        the binary-search invariant needs a monotone segment)."""
+        if self._order_sorted is not None:
+            return self._order_sorted
+        col = self._order_col
+        if col is None or col.dictionary is not None \
+                or col.data.dtype == jnp.bool_ or col.validity is not None:
+            return None
+        if jnp.issubdtype(col.data.dtype, jnp.floating) and bool(jnp.isnan(col.data).any()):
+            return None
+        v = col.data[self.perm]
+        self._order_sorted = v if self._order_asc else -v
+        return self._order_sorted
 
     def scatter_back(self, sorted_vals, validity=None):
         data = sorted_vals[self.inv]
@@ -126,6 +147,33 @@ def _prefix(vals):
     return jnp.concatenate([jnp.zeros(1, dtype=vals.dtype), jnp.cumsum(vals)])
 
 
+def _segmented_searchsorted(vals, lo_bound, hi_bound, targets, side: str):
+    """Per-row binary search of `targets[i]` within vals[lo_bound[i]:hi_bound[i]].
+
+    `vals` is sorted ascending within each segment; a fixed log2(n) round count
+    of gathers keeps everything vectorized (no per-segment slices).
+    """
+    n = vals.shape[0]
+    lo = lo_bound.astype(jnp.int64)
+    hi = hi_bound.astype(jnp.int64)
+    rounds = max(int(np.ceil(np.log2(max(n, 2)))) + 1, 1)
+
+    def body(_, state):
+        lo, hi = state
+        mid = (lo + hi) // 2
+        mv = vals[jnp.clip(mid, 0, n - 1)]
+        if side == "left":
+            go_right = mv < targets
+        else:
+            go_right = mv <= targets
+        new_lo = jnp.where((lo < hi) & go_right, mid + 1, lo)
+        new_hi = jnp.where((lo < hi) & ~go_right, mid, hi)
+        return (new_lo, new_hi)
+
+    lo, hi = jax.lax.fori_loop(0, rounds, body, (lo, hi))
+    return lo
+
+
 def _frame_bounds(w: WindowExpr, lay: _SortedLayout):
     """Per sorted row: [lo, hi) frame range."""
     n = lay.n
@@ -145,6 +193,26 @@ def _frame_bounds(w: WindowExpr, lay: _SortedLayout):
                 lo = lay.seg_start
             if e.kind == "CURRENT_ROW":
                 hi = lay.peer_end
+            if s.kind in ("PRECEDING", "FOLLOWING") and s.offset is not None \
+                    or e.kind in ("PRECEDING", "FOLLOWING") and e.offset is not None:
+                # value-based offsets: per-segment binary search on the order key
+                v = lay.order_values()
+                if v is None:
+                    raise NotImplementedError(
+                        "RANGE offset frames need a single non-null numeric/datetime "
+                        "ORDER BY key")
+                if s.kind == "PRECEDING":
+                    lo = _segmented_searchsorted(v, lay.seg_start, lay.seg_end,
+                                                 v - s.offset, "left")
+                elif s.kind == "FOLLOWING":
+                    lo = _segmented_searchsorted(v, lay.seg_start, lay.seg_end,
+                                                 v + s.offset, "left")
+                if e.kind == "PRECEDING":
+                    hi = _segmented_searchsorted(v, lay.seg_start, lay.seg_end,
+                                                 v - e.offset, "right")
+                elif e.kind == "FOLLOWING":
+                    hi = _segmented_searchsorted(v, lay.seg_start, lay.seg_end,
+                                                 v + e.offset, "right")
         return lo, hi
     # ROWS frames
     s, e = w.spec.start, w.spec.end

@@ -950,8 +950,8 @@ class Binder:
             raise BindError(f"Unknown window function {name!r}")
         if spec.frame is not None:
             units = spec.frame.units
-            start = _bind_bound(spec.frame.start)
-            end = _bind_bound(spec.frame.end)
+            start = _bind_bound(spec.frame.start, units)
+            end = _bind_bound(spec.frame.end, units)
             wspec = WindowSpec(partition, order, units, start, end, True)
         else:
             # default frame: RANGE UNBOUNDED PRECEDING..CURRENT ROW when ordered,
@@ -1046,13 +1046,25 @@ def _split_alias(alias):
     return alias, None
 
 
-def _bind_bound(bound) -> WindowFrameBound:
+def _bind_bound(bound, units: str) -> WindowFrameBound:
     kind, offset = bound
     off = None
     if offset is not None:
-        if not isinstance(offset, a.Literal) or not isinstance(offset.value, int):
-            raise BindError("Window frame offsets must be integer literals")
-        off = offset.value
+        if isinstance(offset, a.IntervalLiteral):
+            if units != "RANGE":
+                raise BindError("Interval frame offsets require RANGE frames")
+            lit = _bind_interval(offset)
+            if lit.sql_type == SqlType.INTERVAL_YEAR_MONTH:
+                raise BindError(
+                    "Year-month intervals are not supported as RANGE offsets; "
+                    "use day-time intervals (e.g. INTERVAL '30' DAY)")
+            off = lit.value  # day-time interval: nanoseconds
+        elif isinstance(offset, a.Literal) and isinstance(offset.value, (int, float)):
+            if units == "ROWS" and not isinstance(offset.value, int):
+                raise BindError("ROWS frame offsets must be integer literals")
+            off = offset.value
+        else:
+            raise BindError("Window frame offsets must be numeric or interval literals")
     return WindowFrameBound(kind, off)
 
 

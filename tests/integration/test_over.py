@@ -149,3 +149,33 @@ def test_named_window(c, win_df):
     srt = win_df.sort_values(["g", "x"])
     assert list(result["cs"]) == list(srt.groupby("g").x.cumsum())
     assert list(result["rn"]) == list(srt.groupby("g").cumcount() + 1)
+
+def test_range_offset_frames(c):
+    df = pd.DataFrame({
+        "g": ["a"] * 6 + ["b"] * 3,
+        "v": [1, 2, 4, 7, 8, 20, 1, 5, 6],
+        "w": [1.0, 1.0, 1.0, 1.0, 1.0, 1.0, 2.0, 2.0, 2.0],
+    })
+    c.create_table("rng_t", df)
+    result = c.sql(
+        """SELECT g, v, SUM(w) OVER (PARTITION BY g ORDER BY v
+               RANGE BETWEEN 2 PRECEDING AND CURRENT ROW) AS s,
+               COUNT(*) OVER (PARTITION BY g ORDER BY v
+               RANGE BETWEEN 1 PRECEDING AND 1 FOLLOWING) AS n
+           FROM rng_t"""
+    ).compute().sort_values(["g", "v"]).reset_index(drop=True)
+    # group a: values 1,2,4,7,8,20 — window [v-2, v]
+    assert list(result[result.g == "a"]["s"]) == [1.0, 2.0, 2.0, 1.0, 2.0, 1.0]
+    # count over [v-1, v+1]
+    assert list(result[result.g == "a"]["n"]) == [2, 2, 1, 2, 2, 1]
+    assert list(result[result.g == "b"]["n"]) == [1, 2, 2]
+
+def test_range_interval_frame(c, datetime_table):
+    result = c.sql(
+        """SELECT no_timezone,
+                  COUNT(*) OVER (ORDER BY no_timezone
+                      RANGE BETWEEN INTERVAL '8' HOUR PRECEDING AND CURRENT ROW) AS n
+           FROM datetime_table"""
+    ).compute().sort_values("no_timezone").reset_index(drop=True)
+    # rows are 8h apart: each sees itself + the previous one
+    assert list(result["n"]) == [1, 2, 2, 2, 2, 2]
