@@ -1,0 +1,119 @@
+"""Pallas TPU kernels for the hot aggregation path.
+
+Scatter-add (`jax.ops.segment_sum`) serializes on the TPU's scatter unit; the
+MXU-native formulation is a one-hot matmul: `onehot(gid).T @ contribs`.  The
+pallas kernel below streams row blocks HBM→VMEM, materializes the one-hot
+ONLY in VMEM (never in HBM — the [n, domain] matrix would dwarf the data),
+and accumulates the [domain, k] partial result in the output block across
+grid steps.  `segsum_onehot_jnp` is the same math left to XLA (used for
+verification and as the non-pallas fallback); scatter remains the CPU path.
+
+See /opt/skills/guides/pallas_guide.md for the programming model.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+def segsum_onehot_jnp(gid: jnp.ndarray, contribs: jnp.ndarray, domain: int) -> jnp.ndarray:
+    """[n] ids + [n, k] contributions -> [domain, k] sums via one-hot matmul."""
+    onehot = jax.nn.one_hot(gid, domain, dtype=contribs.dtype)
+    return onehot.T @ contribs
+
+
+def segsum_pallas(gid: jnp.ndarray, contribs: jnp.ndarray, domain: int,
+                  block_rows: int = 2048, interpret: bool = False) -> jnp.ndarray:
+    """Pallas segment-sum: one-hot built per block in VMEM, MXU accumulate.
+
+    gid: [n] int32 in [0, domain); contribs: [n, k] float32 (pre-masked).
+    Returns [domain, k] float32.
+    """
+    from jax.experimental import pallas as pl
+
+    n, k = contribs.shape
+    d_pad = max(_round_up(domain, 128), 128)
+    k_pad = max(_round_up(k, 128), 128)
+    # keep the VMEM-resident one-hot block within a ~4MB budget
+    budget_rows = max((4 << 20) // (d_pad * 4), 8)
+    b = max(min(block_rows, _round_up(budget_rows, 8) - 7), 8)
+    n_pad = max(_round_up(n, b), b)
+
+    gid_p = jnp.zeros((n_pad,), dtype=jnp.int32).at[:n].set(gid.astype(jnp.int32))
+    # padded rows carry zero contributions, so their gid (0) adds nothing
+    c_p = jnp.zeros((n_pad, k_pad), dtype=jnp.float32).at[:n, :k].set(
+        contribs.astype(jnp.float32))
+
+    def kernel(gid_ref, c_ref, out_ref):
+        step = pl.program_id(0)
+
+        @pl.when(step == 0)
+        def _init():
+            out_ref[:] = jnp.zeros_like(out_ref)
+
+        ids = gid_ref[:]  # [b]
+        onehot = (ids[:, None] == jax.lax.broadcasted_iota(jnp.int32, (1, d_pad), 1)
+                  ).astype(jnp.float32)  # [b, d_pad], lives only in VMEM
+        out_ref[:] += jax.lax.dot_general(
+            onehot, c_ref[:],
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(n_pad // b,),
+        in_specs=[
+            pl.BlockSpec((b,), lambda i: (i,)),
+            pl.BlockSpec((b, k_pad), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((d_pad, k_pad), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((d_pad, k_pad), jnp.float32),
+        interpret=interpret,
+    )(gid_p, c_p)
+    return out[:domain, :k]
+
+
+def segsum_double_float(gid, contribs64, domain: int, use_pallas: bool = False,
+                        interpret: bool = False) -> jnp.ndarray:
+    """float64-accurate MXU segment sum via hi/lo float32 decomposition.
+
+    Each f64 value is split into hi = f32(x) and lo = f32(x - hi); both halves
+    ride the one-hot matmul and recombine in f64.  This removes the f32
+    *representation* error; the f32 *accumulation* error remains (~1e-8
+    relative in practice), which is why `auto` mode stays on exact scatter and
+    matmul/pallas are explicit speed opt-ins.
+    """
+    x = contribs64.astype(jnp.float64)
+    hi = x.astype(jnp.float32)
+    lo = (x - hi.astype(jnp.float64)).astype(jnp.float32)
+    n, k = x.shape
+    stacked = jnp.concatenate([hi, lo], axis=1)  # [n, 2k]
+    fn = segsum_pallas if use_pallas else segsum_onehot_jnp
+    if use_pallas:
+        out = fn(gid, stacked, domain, interpret=interpret)
+    else:
+        out = fn(gid, stacked, domain)
+    return out[:, :k].astype(jnp.float64) + out[:, k:].astype(jnp.float64)
+
+
+def choose_segsum_impl(config, domain: int) -> str:
+    """'scatter' | 'matmul' | 'pallas' based on config + platform + domain."""
+    mode = str(config.get("sql.compile.segsum", "auto"))
+    if mode in ("scatter", "matmul", "pallas"):
+        return mode
+    if mode != "auto":
+        raise ValueError(
+            f"sql.compile.segsum must be auto/scatter/matmul/pallas, got {mode!r}")
+    # auto keeps the exact scatter path everywhere; the MXU matmul modes are
+    # explicit opt-ins because their f32 accumulation trades ~1e-8 relative
+    # accuracy for throughput
+    return "scatter"

@@ -375,8 +375,9 @@ class CompiledAggregate:
     """One compiled scan→aggregate pipeline bound to a concrete input table."""
 
     def __init__(self, agg: p.Aggregate, table: Table, scan, filters,
-                 group_exprs, agg_exprs):
+                 group_exprs, agg_exprs, config=None):
         self.agg = agg
+        self.segsum_mode = "scatter"
         self.table = table
         self.filters = filters
         self.group_exprs = group_exprs
@@ -428,8 +429,12 @@ class CompiledAggregate:
                     if isinstance(sub, AggExpr) and sub is not x:
                         raise _Unsupported("nested agg")
 
+        if config is not None:
+            from ..ops.pallas_kernels import choose_segsum_impl
+
+            self.segsum_mode = choose_segsum_impl(config, self.domain)
         self._fn = jax.jit(self._build())
-        # warm the cache is left to the caller; tracing happens on first call
+        # warming is left to the caller; tracing happens on first call
 
     def _build(self) -> Callable:
         ev = _TraceEval(self.table)
@@ -441,9 +446,23 @@ class CompiledAggregate:
         domain = self.domain
         n_cols = len(self.table.column_names)
         n_rows = self.table.num_rows
+        segsum_mode = self.segsum_mode
 
         def fn(datas, valids):
             slots = {i: (datas[i], valids[i]) for i in range(n_cols)}
+
+            def ssum(x, seg):
+                # segment reduction strategy: scatter-add, or MXU one-hot
+                # matmul for floating contributions (ints keep scatter for
+                # exactness; floats use the hi/lo double-float decomposition
+                # so accuracy stays ~f64 — see ops/pallas_kernels.py)
+                if segsum_mode == "scatter" or not jnp.issubdtype(x.dtype, jnp.floating):
+                    return jax.ops.segment_sum(x, seg, domain)
+                from ..ops.pallas_kernels import segsum_double_float
+
+                out = segsum_double_float(seg, x[:, None], domain,
+                                          use_pallas=(segsum_mode == "pallas"))
+                return out[:, 0].astype(x.dtype)
             # selection mask (never compacts — static shapes end to end)
             mask = None
             for f in filters:
@@ -463,7 +482,7 @@ class CompiledAggregate:
             if first:
                 gid = jnp.zeros(n_rows, dtype=jnp.int64)
             sel = mask if mask is not None else jnp.ones(n_rows, dtype=bool)
-            hit = jax.ops.segment_sum(sel.astype(jnp.int32), gid, domain) > 0
+            hit = ssum(sel.astype(jnp.int32), gid) > 0
             outs = []
             for a in agg_exprs:
                 valid = sel
@@ -472,21 +491,19 @@ class CompiledAggregate:
                     fm = fd if fv is None else (fd & fv)
                     valid = valid & fm
                 if a.func == "count_star":
-                    outs.append((jax.ops.segment_sum(
-                        valid.astype(jnp.int64), gid, domain), None))
+                    outs.append((ssum(valid.astype(jnp.int64), gid), None))
                     continue
                 ad, av = ev.eval(a.args[0], slots)
                 v = valid if av is None else (valid & av)
                 if jnp.issubdtype(ad.dtype, jnp.floating):
                     v = v & ~jnp.isnan(ad)
-                cnt = jax.ops.segment_sum(v.astype(jnp.int64), gid, domain)
+                cnt = ssum(v.astype(jnp.int64), gid)
                 if a.func == "count":
                     outs.append((cnt, None))
                     continue
                 if a.func in ("sum", "avg"):
                     acc = ad.astype(jnp.int64) if jnp.issubdtype(ad.dtype, jnp.integer) else ad
-                    s = jax.ops.segment_sum(jnp.where(v, acc, jnp.zeros_like(acc)),
-                                            gid, domain)
+                    s = ssum(jnp.where(v, acc, jnp.zeros_like(acc)), gid)
                     if a.func == "avg":
                         outs.append((s.astype(jnp.float64) / jnp.maximum(cnt, 1), cnt > 0))
                     else:
@@ -507,8 +524,8 @@ class CompiledAggregate:
                     continue
                 # variance family
                 x = ad.astype(jnp.float64)
-                s1 = jax.ops.segment_sum(jnp.where(v, x, 0.0), gid, domain)
-                s2 = jax.ops.segment_sum(jnp.where(v, x * x, 0.0), gid, domain)
+                s1 = ssum(jnp.where(v, x, 0.0), gid)
+                s2 = ssum(jnp.where(v, x * x, 0.0), gid)
                 ddof = 1 if a.func.endswith("samp") else 0
                 mean = s1 / jnp.maximum(cnt, 1)
                 var = jnp.maximum(s2 - cnt * mean * mean, 0.0) / jnp.maximum(cnt - ddof, 1)
@@ -591,9 +608,12 @@ def try_compiled_aggregate(rel: p.Aggregate, executor) -> Optional[Table]:
             tuple(str(a) for a in agg_exprs),
             table.num_rows,
         )
+        mode = str(executor.config.get("sql.compile.segsum", "auto"))
+        key = key + (mode,)
         compiled = _cache.get(key)
         if compiled is None:
-            compiled = CompiledAggregate(rel, table, scan, filters, group_exprs, agg_exprs)
+            compiled = CompiledAggregate(rel, table, scan, filters, group_exprs,
+                                         agg_exprs, executor.config)
             _cache[key] = compiled
         else:
             compiled.table = table
