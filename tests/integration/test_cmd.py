@@ -1,0 +1,50 @@
+"""CLI meta-command tests (parity: reference test_cmd.py — handlers exercised
+directly, the interactive loop is driven in the verify harness)."""
+import pandas as pd
+import pytest
+
+
+def test_meta_commands(c, capsys):
+    from dask_sql_tpu.cmd import _handle_meta
+
+    assert _handle_meta(c, "\\l")
+    assert "root" in capsys.readouterr().out
+    assert _handle_meta(c, "\\dt")
+    assert "df_simple" in capsys.readouterr().out
+    assert _handle_meta(c, "\\conf sql.optimize")
+    assert "sql.optimize" in capsys.readouterr().out
+    assert not _handle_meta(c, "\\nonsense")
+
+
+def test_meta_schema_switch(c, capsys):
+    from dask_sql_tpu.cmd import _handle_meta
+
+    c.create_schema("side")
+    assert _handle_meta(c, "\\dss side")
+    assert c.schema_name == "side"
+    _handle_meta(c, "\\dss root")
+    assert _handle_meta(c, "\\dsc root")
+    assert "df_simple" in capsys.readouterr().out
+
+
+def test_run_query_prints_result(c, capsys):
+    from dask_sql_tpu.cmd import _run_query
+
+    _run_query(c, "SELECT 40 + 2 AS answer")
+    out = capsys.readouterr().out
+    assert "42" in out and "answer" in out
+
+
+def test_run_query_prints_error(c, capsys):
+    from dask_sql_tpu.cmd import _run_query
+
+    _run_query(c, "SELECT * FROM not_a_table")
+    err = capsys.readouterr().err
+    assert "ERROR" in err
+
+
+def test_quit_raises(c):
+    from dask_sql_tpu.cmd import _handle_meta
+
+    with pytest.raises(EOFError):
+        _handle_meta(c, "\\q")
