@@ -125,3 +125,54 @@ def test_fuzz_vs_sqlite(seed):
         assert_eq(got, expected, check_dtype=False, check_names=False)
     except AssertionError as e:  # pragma: no cover - debugging aid
         raise AssertionError(f"seed={seed} query={query!r}\n{e}") from e
+
+
+class QueryGen2(QueryGen):
+    """Harder shapes: windows, set ops, subqueries, derived tables."""
+
+    def query(self):
+        kind = self.rng.rand()
+        if kind < 0.25:
+            wf = self.rng.choice(["ROW_NUMBER()", "RANK()", "SUM(b)", "COUNT(*)",
+                                  "AVG(b)", "LAG(b)", "MIN(d)"])
+            return (f"SELECT a, b, {wf} OVER (PARTITION BY a ORDER BY b, d, c) AS w "
+                    f"FROM t ORDER BY a, b, d, c")
+        if kind < 0.45:
+            op = self.rng.choice(["UNION", "UNION ALL", "INTERSECT", "EXCEPT"])
+            return f"SELECT a FROM t WHERE {self.predicate()} {op} SELECT a FROM u"
+        if kind < 0.65:
+            style = self.rng.rand()
+            if style < 0.4:
+                return "SELECT a, d FROM t WHERE a IN (SELECT a FROM u WHERE e > 3)"
+            if style < 0.7:
+                return ("SELECT a, d FROM t WHERE EXISTS "
+                        "(SELECT 1 FROM u WHERE u.a = t.a AND u.e > 2)")
+            return "SELECT a, b - (SELECT AVG(e) FROM u) AS r FROM t"
+        if kind < 0.85:
+            return (f"SELECT s.a, MAX(s.bb) AS m FROM "
+                    f"(SELECT a, b + d AS bb FROM t WHERE {self.predicate()}) AS s "
+                    f"GROUP BY s.a")
+        return f"SELECT DISTINCT a, c FROM t WHERE {self.predicate()} ORDER BY a, c"
+
+
+@pytest.mark.parametrize("seed", range(300, 325))
+def test_fuzz_hard_shapes_vs_sqlite(seed):
+    from dask_sql_tpu import Context
+
+    t, u = _frames(seed)
+    query = QueryGen2(seed).query()
+    conn = sqlite3.connect(":memory:")
+    t.to_sql("t", conn, index=False)
+    u.to_sql("u", conn, index=False)
+    expected = pd.read_sql_query(query, conn)
+    c = Context()
+    c.create_table("t", t)
+    c.create_table("u", u)
+    got = c.sql(query, return_futures=False)
+    if "ORDER BY" not in query:
+        expected = expected.sort_values(list(expected.columns)).reset_index(drop=True)
+        got = got.sort_values(list(got.columns)).reset_index(drop=True)
+    try:
+        assert_eq(got, expected, check_dtype=False, check_names=False)
+    except AssertionError as e:  # pragma: no cover - debugging aid
+        raise AssertionError(f"seed={seed} query={query!r}\n{e}") from e
