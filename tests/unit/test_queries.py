@@ -109,3 +109,101 @@ def test_q13_values(tpch_context):
                 .reset_index(drop=True))
     assert list(result["c_count"]) == list(expected["c_count"])
     assert list(result["custdist"]) == list(expected["custdist"])
+
+
+def test_q4_values(tpch_context):
+    c, t = tpch_context
+    result = c.sql(QUERIES[4]).compute()
+    orders, li = t["orders"], t["lineitem"]
+    sel = orders[(orders.o_orderdate >= pd.Timestamp("1993-07-01"))
+                 & (orders.o_orderdate < pd.Timestamp("1993-10-01"))]
+    late = li[li.l_commitdate < li.l_receiptdate].l_orderkey.unique()
+    sel = sel[sel.o_orderkey.isin(late)]
+    expected = (sel.groupby("o_orderpriority").size().reset_index(name="order_count")
+                .sort_values("o_orderpriority").reset_index(drop=True))
+    assert list(result["o_orderpriority"]) == list(expected["o_orderpriority"])
+    assert list(result["order_count"]) == list(expected["order_count"])
+
+
+def test_q10_values(tpch_context):
+    c, t = tpch_context
+    result = c.sql(QUERIES[10]).compute()
+    cust, orders, li, nation = t["customer"], t["orders"], t["lineitem"], t["nation"]
+    sel_o = orders[(orders.o_orderdate >= pd.Timestamp("1993-10-01"))
+                   & (orders.o_orderdate < pd.Timestamp("1994-01-01"))]
+    m = cust.merge(sel_o, left_on="c_custkey", right_on="o_custkey")
+    m = m.merge(li[li.l_returnflag == "R"], left_on="o_orderkey", right_on="l_orderkey")
+    m = m.merge(nation, left_on="c_nationkey", right_on="n_nationkey")
+    m["revenue"] = m.l_extendedprice * (1 - m.l_discount)
+    expected = (m.groupby(["c_custkey", "c_name", "c_acctbal", "c_phone", "n_name",
+                           "c_address", "c_comment"]).revenue.sum().reset_index()
+                .sort_values("revenue", ascending=False).head(20).reset_index(drop=True))
+    np.testing.assert_allclose(result["revenue"], expected["revenue"], rtol=1e-9)
+    assert list(result["c_custkey"]) == list(expected["c_custkey"])
+
+
+def test_q12_values(tpch_context):
+    c, t = tpch_context
+    result = c.sql(QUERIES[12]).compute()
+    orders, li = t["orders"], t["lineitem"]
+    sel = li[li.l_shipmode.isin(["MAIL", "SHIP"])
+             & (li.l_commitdate < li.l_receiptdate)
+             & (li.l_shipdate < li.l_commitdate)
+             & (li.l_receiptdate >= pd.Timestamp("1994-01-01"))
+             & (li.l_receiptdate < pd.Timestamp("1995-01-01"))]
+    m = sel.merge(orders, left_on="l_orderkey", right_on="o_orderkey")
+    high = m.o_orderpriority.isin(["1-URGENT", "2-HIGH"])
+    expected = (m.assign(h=high.astype(int), l=(~high).astype(int))
+                .groupby("l_shipmode")[["h", "l"]].sum().reset_index()
+                .sort_values("l_shipmode").reset_index(drop=True))
+    assert list(result["l_shipmode"]) == list(expected["l_shipmode"])
+    assert list(result["high_line_count"]) == list(expected["h"])
+    assert list(result["low_line_count"]) == list(expected["l"])
+
+
+def test_q14_values(tpch_context):
+    c, t = tpch_context
+    result = c.sql(QUERIES[14]).compute()
+    li, part = t["lineitem"], t["part"]
+    sel = li[(li.l_shipdate >= pd.Timestamp("1995-09-01"))
+             & (li.l_shipdate < pd.Timestamp("1995-10-01"))]
+    m = sel.merge(part, left_on="l_partkey", right_on="p_partkey")
+    rev = m.l_extendedprice * (1 - m.l_discount)
+    promo = rev.where(m.p_type.str.startswith("PROMO"), 0.0)
+    expected = 100.0 * promo.sum() / rev.sum()
+    np.testing.assert_allclose(result["promo_revenue"][0], expected, rtol=1e-9)
+
+
+def test_q18_values(tpch_context):
+    c, t = tpch_context
+    result = c.sql(QUERIES[18]).compute()
+    cust, orders, li = t["customer"], t["orders"], t["lineitem"]
+    big = li.groupby("l_orderkey").l_quantity.sum()
+    big_keys = big[big > 250].index
+    m = orders[orders.o_orderkey.isin(big_keys)].merge(
+        cust, left_on="o_custkey", right_on="c_custkey")
+    m = m.merge(li, left_on="o_orderkey", right_on="l_orderkey")
+    expected = (m.groupby(["c_name", "c_custkey", "o_orderkey", "o_orderdate",
+                           "o_totalprice"]).l_quantity.sum().reset_index()
+                .sort_values(["o_totalprice", "o_orderdate"], ascending=[False, True])
+                .head(100).reset_index(drop=True))
+    assert list(result["o_orderkey"]) == list(expected["o_orderkey"])
+    np.testing.assert_allclose(result["total_qty"], expected["l_quantity"], rtol=1e-9)
+
+
+def test_q22_values(tpch_context):
+    c, t = tpch_context
+    result = c.sql(QUERIES[22]).compute()
+    cust, orders = t["customer"], t["orders"]
+    codes = ("13", "31", "23", "29", "30", "18", "17")
+    cc = cust.c_phone.str[:2]
+    in_codes = cust[cc.isin(codes)]
+    avg_bal = in_codes[in_codes.c_acctbal > 0].c_acctbal.mean()
+    sel = in_codes[(in_codes.c_acctbal > avg_bal)
+                   & ~in_codes.c_custkey.isin(orders.o_custkey)]
+    expected = (sel.assign(cntrycode=sel.c_phone.str[:2])
+                .groupby("cntrycode").c_acctbal.agg(["count", "sum"]).reset_index()
+                .sort_values("cntrycode").reset_index(drop=True))
+    assert list(result["cntrycode"]) == list(expected["cntrycode"])
+    assert list(result["numcust"]) == list(expected["count"])
+    np.testing.assert_allclose(result["totacctbal"], expected["sum"], rtol=1e-9)
