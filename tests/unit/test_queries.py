@@ -207,3 +207,87 @@ def test_q22_values(tpch_context):
     assert list(result["cntrycode"]) == list(expected["cntrycode"])
     assert list(result["numcust"]) == list(expected["count"])
     np.testing.assert_allclose(result["totacctbal"], expected["sum"], rtol=1e-9)
+
+
+def test_q7_values(tpch_context):
+    c, t = tpch_context
+    result = c.sql(QUERIES[7]).compute()
+    supp, li, orders = t["supplier"], t["lineitem"], t["orders"]
+    cust, nation = t["customer"], t["nation"]
+    m = supp.merge(li, left_on="s_suppkey", right_on="l_suppkey")
+    m = m.merge(orders, left_on="l_orderkey", right_on="o_orderkey")
+    m = m.merge(cust, left_on="o_custkey", right_on="c_custkey")
+    n1 = nation.rename(columns=lambda x: x + "_1")
+    n2 = nation.rename(columns=lambda x: x + "_2")
+    m = m.merge(n1, left_on="s_nationkey", right_on="n_nationkey_1")
+    m = m.merge(n2, left_on="c_nationkey", right_on="n_nationkey_2")
+    m = m[(((m.n_name_1 == "FRANCE") & (m.n_name_2 == "GERMANY"))
+           | ((m.n_name_1 == "GERMANY") & (m.n_name_2 == "FRANCE")))
+          & (m.l_shipdate >= pd.Timestamp("1995-01-01"))
+          & (m.l_shipdate <= pd.Timestamp("1996-12-31"))]
+    m = m.assign(l_year=m.l_shipdate.dt.year,
+                 volume=m.l_extendedprice * (1 - m.l_discount))
+    expected = (m.groupby(["n_name_1", "n_name_2", "l_year"]).volume.sum().reset_index()
+                .sort_values(["n_name_1", "n_name_2", "l_year"]).reset_index(drop=True))
+    assert len(result) == len(expected)
+    if len(expected):
+        np.testing.assert_allclose(result["revenue"], expected["volume"], rtol=1e-9)
+
+
+def test_q15_values(tpch_context):
+    c, t = tpch_context
+    result = c.sql(QUERIES[15]).compute()
+    li, supp = t["lineitem"], t["supplier"]
+    sel = li[(li.l_shipdate >= pd.Timestamp("1996-01-01"))
+             & (li.l_shipdate < pd.Timestamp("1996-04-01"))]
+    rev = (sel.assign(r=sel.l_extendedprice * (1 - sel.l_discount))
+           .groupby("l_suppkey").r.sum())
+    top = rev[np.isclose(rev, rev.max())]
+    expected = supp[supp.s_suppkey.isin(top.index)].sort_values("s_suppkey")
+    assert list(result["s_suppkey"]) == list(expected["s_suppkey"])
+    np.testing.assert_allclose(result["total_revenue"], rev.max(), rtol=1e-9)
+
+
+def test_q19_values(tpch_context):
+    c, t = tpch_context
+    result = c.sql(QUERIES[19]).compute()
+    li, part = t["lineitem"], t["part"]
+    m = li.merge(part, left_on="l_partkey", right_on="p_partkey")
+    def branch(brand, containers, qlo, qhi, smax):
+        return ((m.p_brand == brand) & m.p_container.isin(containers)
+                & (m.l_quantity >= qlo) & (m.l_quantity <= qhi)
+                & (m.p_size >= 1) & (m.p_size <= smax)
+                & m.l_shipmode.isin(["AIR", "REG AIR"])
+                & (m.l_shipinstruct == "DELIVER IN PERSON"))
+    mask = (branch("Brand#12", ["SM CASE", "SM BOX", "SM PACK", "SM PKG"], 1, 11, 5)
+            | branch("Brand#23", ["MED BAG", "MED BOX", "MED PKG", "MED PACK"], 10, 20, 10)
+            | branch("Brand#34", ["LG CASE", "LG BOX", "LG PACK", "LG PKG"], 20, 30, 15))
+    expected = (m[mask].l_extendedprice * (1 - m[mask].l_discount)).sum()
+    got = result["revenue"][0]
+    if pd.isna(got):
+        assert expected == 0
+    else:
+        np.testing.assert_allclose(got, expected, rtol=1e-9)
+
+
+def test_q21_values(tpch_context):
+    c, t = tpch_context
+    result = c.sql(QUERIES[21]).compute()
+    supp, li, orders, nation = t["supplier"], t["lineitem"], t["orders"], t["nation"]
+    l1 = li[li.l_receiptdate > li.l_commitdate]
+    m = supp.merge(l1, left_on="s_suppkey", right_on="l_suppkey")
+    m = m.merge(orders[orders.o_orderstatus == "F"],
+                left_on="l_orderkey", right_on="o_orderkey")
+    m = m.merge(nation[nation.n_name == "SAUDI ARABIA"],
+                left_on="s_nationkey", right_on="n_nationkey")
+    multi = li.groupby("l_orderkey").l_suppkey.nunique()
+    multi_ok = set(multi[multi > 1].index)
+    late = li[li.l_receiptdate > li.l_commitdate]
+    late_multi = late.groupby("l_orderkey").l_suppkey.nunique()
+    only_one_late = set(late_multi[late_multi == 1].index)
+    m = m[m.l_orderkey.isin(multi_ok) & m.l_orderkey.isin(only_one_late)]
+    expected = (m.groupby("s_name").size().reset_index(name="numwait")
+                .sort_values(["numwait", "s_name"], ascending=[False, True])
+                .head(100).reset_index(drop=True))
+    assert list(result["s_name"]) == list(expected["s_name"])
+    assert list(result["numwait"]) == list(expected["numwait"])
