@@ -32,6 +32,8 @@ DEFAULTS: Dict[str, Any] = {
     "sql.backend.default": "tpu",
     "sql.shuffle.num_buckets": None,  # None = number of devices
     "sql.compile": True,  # whole-pipeline jit for hot aggregation shapes
+    "sql.compile.join": "auto",  # jit the shape-stable join probe phase
+    "sql.compile.segsum": "auto",  # scatter | matmul | pallas segment sums
     "sql.streaming.enabled": True,  # out-of-core parquet batch aggregation
     "sql.streaming.batch_rows": 2_000_000,
 }

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 from typing import List, Optional, Sequence, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -114,19 +115,17 @@ def _single_key_fast_path(lc: Column, rc: Column):
     return lk, rk
 
 
-def inner_join_indices(lgid: jnp.ndarray, rgid: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+def inner_join_indices(lgid: jnp.ndarray, rgid: jnp.ndarray,
+                       use_jit: bool = False) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """(left_idx, right_idx) pairs of matches, left-major order."""
-    li, ri, _ = _probe(lgid, rgid)
+    li, ri, _ = _probe(lgid, rgid, use_jit)
     return li, ri
 
 
-def left_join_indices(lgid, rgid):
+def left_join_indices(lgid, rgid, use_jit: bool = False):
     """Left outer: unmatched left rows appear once with right_idx == -1."""
-    r_order = jnp.argsort(rgid)
-    r_sorted = rgid[r_order]
-    start = jnp.searchsorted(r_sorted, lgid, side="left")
-    end = jnp.searchsorted(r_sorted, lgid, side="right")
-    counts = end - start
+    phase = _probe_phase_jit if use_jit else _probe_phase
+    r_order, start, counts, _, _ = phase(lgid, rgid)
     out_counts = jnp.maximum(counts, 1)
     total = int(out_counts.sum())
     offsets = jnp.cumsum(out_counts) - out_counts  # exclusive prefix
@@ -147,8 +146,8 @@ def semi_join_mask(lgid, rgid, anti: bool = False) -> jnp.ndarray:
     return ~matched if anti else matched
 
 
-def full_join_indices(lgid, rgid):
-    li, ri = left_join_indices(lgid, rgid)
+def full_join_indices(lgid, rgid, use_jit: bool = False):
+    li, ri = left_join_indices(lgid, rgid, use_jit)
     r_unmatched = ~semi_join_mask(rgid, lgid)
     extra_r = jnp.nonzero(r_unmatched)[0].astype(jnp.int64)
     li = jnp.concatenate([li, jnp.full(extra_r.shape[0], -1, dtype=jnp.int64)])
@@ -156,14 +155,31 @@ def full_join_indices(lgid, rgid):
     return li, ri
 
 
-def _probe(lgid, rgid):
+def _probe_phase(lgid, rgid):
+    """Shape-stable probe phase: sort, two binary searches, prefix sums.
+
+    Everything up to the data-dependent expansion is static-shaped, so the
+    jitted variant compiles once per (n_l, n_r) signature — removing per-op
+    dispatch round trips, which dominate when the device sits behind a link
+    (TPU).  Selected via `sql.compile.join`.
+    """
     r_order = jnp.argsort(rgid)
     r_sorted = rgid[r_order]
     start = jnp.searchsorted(r_sorted, lgid, side="left")
     end = jnp.searchsorted(r_sorted, lgid, side="right")
     counts = end - start
-    total = int(counts.sum())
     offsets = jnp.cumsum(counts) - counts
+    total = counts.sum()
+    return r_order, start, counts, offsets, total
+
+
+_probe_phase_jit = jax.jit(_probe_phase)
+
+
+def _probe(lgid, rgid, use_jit: bool = False):
+    phase = _probe_phase_jit if use_jit else _probe_phase
+    r_order, start, counts, offsets, total_arr = phase(lgid, rgid)
+    total = int(total_arr)
     li = jnp.repeat(jnp.arange(lgid.shape[0], dtype=jnp.int64), counts,
                     total_repeat_length=total)
     pos_in_row = jnp.arange(total, dtype=jnp.int64) - offsets[li]

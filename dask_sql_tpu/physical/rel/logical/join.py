@@ -46,6 +46,20 @@ class JoinPlugin(BaseRelPlugin):
         left, right = self.assert_inputs(rel, 2, executor)
         nleft = len(rel.left.schema)
         jt = rel.join_type
+        # jitted probe phase: 'auto' enables it on accelerator backends where
+        # per-op dispatch round trips dominate
+        mode = str(executor.config.get("sql.compile.join", "auto")).lower()
+        if mode == "auto":
+            import jax
+
+            use_jit = jax.default_backend() not in ("cpu",)
+        elif mode in ("jit", "true", "on"):
+            use_jit = True
+        elif mode in ("off", "false", "eager"):
+            use_jit = False
+        else:
+            raise ValueError(
+                f"sql.compile.join must be auto/jit/off, got {mode!r}")
 
         if rel.on:
             lkeys = [executor.eval_expr(l, left) for l, _ in rel.on]
@@ -60,7 +74,7 @@ class JoinPlugin(BaseRelPlugin):
             if rel.filter is None:
                 mask = join_ops.semi_join_mask(lgid, rgid, anti=(jt == "LEFTANTI"))
                 return self.fix_column_to_row_type(left.filter(mask), rel.schema)
-            li, ri = join_ops.inner_join_indices(lgid, rgid)
+            li, ri = join_ops.inner_join_indices(lgid, rgid, use_jit)
             combined = _materialize(left, right, li, ri)
             cond = executor.eval_expr(rel.filter, combined)
             keep = cond.data & cond.valid_mask()
@@ -75,9 +89,9 @@ class JoinPlugin(BaseRelPlugin):
             # probe from the bigger side so the build sort runs on the smaller
             # one (parity intent: reference broadcast-join small-side choice)
             if right.num_rows <= left.num_rows:
-                li, ri = join_ops.inner_join_indices(lgid, rgid)
+                li, ri = join_ops.inner_join_indices(lgid, rgid, use_jit)
             else:
-                ri, li = join_ops.inner_join_indices(rgid, lgid)
+                ri, li = join_ops.inner_join_indices(rgid, lgid, use_jit)
             combined = _materialize(left, right, li, ri)
             if rel.filter is not None:
                 cond = executor.eval_expr(rel.filter, combined)
@@ -87,7 +101,7 @@ class JoinPlugin(BaseRelPlugin):
         if jt in ("LEFT", "RIGHT", "FULL"):
             # probe as inner first, apply the residual to matched pairs, then
             # pad outer rows that lost all their matches
-            li, ri = join_ops.inner_join_indices(lgid, rgid)
+            li, ri = join_ops.inner_join_indices(lgid, rgid, use_jit)
             if rel.filter is not None and int(li.shape[0]):
                 combined = _materialize(left, right, li, ri)
                 cond = executor.eval_expr(rel.filter, combined)

@@ -142,3 +142,22 @@ def test_self_join(c, user_table_1):
     ).compute()
     expected = user_table_1.merge(user_table_1, on="user_id")[["user_id"]]
     assert_eq(result, expected, check_dtype=False, sort_results=True)
+
+def test_join_jit_probe_mode(c, user_table_1, user_table_2, monkeypatch):
+    from dask_sql_tpu.ops import join as join_ops
+
+    calls = []
+    orig = join_ops._probe_phase_jit
+    monkeypatch.setattr(join_ops, "_probe_phase_jit",
+                        lambda *a: calls.append(1) or orig(*a))
+    q = ("SELECT lhs.user_id, lhs.b, rhs.c FROM user_table_1 AS lhs "
+         "JOIN user_table_2 AS rhs ON lhs.user_id = rhs.user_id")
+    ref = c.sql(q, config_options={"sql.compile.join": "off"}).compute()
+    assert not calls
+    jit = c.sql(q, config_options={"sql.compile.join": "jit"}).compute()
+    assert calls  # the jitted phase really ran
+    assert_eq(jit.sort_values(list(jit.columns)).reset_index(drop=True),
+              ref.sort_values(list(ref.columns)).reset_index(drop=True),
+              check_dtype=False)
+    with pytest.raises(Exception):
+        c.sql(q, config_options={"sql.compile.join": "bogus"}).compute()
