@@ -291,3 +291,152 @@ def test_q21_values(tpch_context):
                 .head(100).reset_index(drop=True))
     assert list(result["s_name"]) == list(expected["s_name"])
     assert list(result["numwait"]) == list(expected["numwait"])
+
+
+def test_q9_values(tpch_context):
+    c, t = tpch_context
+    result = c.sql(QUERIES[9]).compute()
+    part, supp, li = t["part"], t["supplier"], t["lineitem"]
+    ps, orders, nation = t["partsupp"], t["orders"], t["nation"]
+    m = li.merge(part[part.p_name.str.contains("green")],
+                 left_on="l_partkey", right_on="p_partkey")
+    m = m.merge(supp, left_on="l_suppkey", right_on="s_suppkey")
+    m = m.merge(ps, left_on=["l_suppkey", "l_partkey"],
+                right_on=["ps_suppkey", "ps_partkey"])
+    m = m.merge(orders, left_on="l_orderkey", right_on="o_orderkey")
+    m = m.merge(nation, left_on="s_nationkey", right_on="n_nationkey")
+    m = m.assign(o_year=m.o_orderdate.dt.year,
+                 amount=m.l_extendedprice * (1 - m.l_discount)
+                        - m.ps_supplycost * m.l_quantity)
+    expected = (m.groupby(["n_name", "o_year"]).amount.sum().reset_index()
+                .sort_values(["n_name", "o_year"], ascending=[True, False])
+                .reset_index(drop=True))
+    assert list(result["nation"]) == list(expected["n_name"])
+    np.testing.assert_allclose(result["sum_profit"], expected["amount"], rtol=1e-9)
+
+
+def test_q11_values(tpch_context):
+    c, t = tpch_context
+    result = c.sql(QUERIES[11]).compute()
+    ps, supp, nation = t["partsupp"], t["supplier"], t["nation"]
+    m = ps.merge(supp, left_on="ps_suppkey", right_on="s_suppkey")
+    m = m.merge(nation[nation.n_name == "GERMANY"],
+                left_on="s_nationkey", right_on="n_nationkey")
+    m = m.assign(value=m.ps_supplycost * m.ps_availqty)
+    grouped = m.groupby("ps_partkey").value.sum()
+    threshold = m.value.sum() * 0.0001
+    expected = (grouped[grouped > threshold].reset_index()
+                .sort_values("value", ascending=False).reset_index(drop=True))
+    assert list(result["ps_partkey"]) == list(expected["ps_partkey"])
+    np.testing.assert_allclose(result["value"], expected["value"], rtol=1e-9)
+
+
+def test_q16_values(tpch_context):
+    c, t = tpch_context
+    result = c.sql(QUERIES[16]).compute()
+    ps, part, supp = t["partsupp"], t["part"], t["supplier"]
+    bad_supp = supp[supp.s_comment.str.contains("Customer.*Complaints")].s_suppkey
+    m = ps[~ps.ps_suppkey.isin(bad_supp)].merge(
+        part, left_on="ps_partkey", right_on="p_partkey")
+    m = m[(m.p_brand != "Brand#45")
+          & ~m.p_type.str.startswith("MEDIUM POLISHED")
+          & m.p_size.isin([49, 14, 23, 45, 19, 3, 36, 9])]
+    expected = (m.groupby(["p_brand", "p_type", "p_size"]).ps_suppkey.nunique()
+                .reset_index(name="supplier_cnt")
+                .sort_values(["supplier_cnt", "p_brand", "p_type", "p_size"],
+                             ascending=[False, True, True, True])
+                .reset_index(drop=True))
+    assert len(result) == len(expected)
+    assert list(result["supplier_cnt"]) == list(expected["supplier_cnt"])
+    assert list(result["p_brand"]) == list(expected["p_brand"])
+
+
+def test_q17_values(tpch_context):
+    c, t = tpch_context
+    result = c.sql(QUERIES[17]).compute()
+    li, part = t["lineitem"], t["part"]
+    sel_p = part[(part.p_brand == "Brand#23") & (part.p_container == "MED BOX")]
+    m = li.merge(sel_p, left_on="l_partkey", right_on="p_partkey")
+    avg_qty = li.groupby("l_partkey").l_quantity.mean()
+    m = m[m.l_quantity < 0.2 * m.l_partkey.map(avg_qty)]
+    expected = m.l_extendedprice.sum() / 7.0
+    got = result["avg_yearly"][0]
+    if pd.isna(got):
+        assert len(m) == 0
+    else:
+        np.testing.assert_allclose(got, expected, rtol=1e-9)
+
+
+def test_q20_values(tpch_context):
+    c, t = tpch_context
+    result = c.sql(QUERIES[20]).compute()
+    supp, nation, ps = t["supplier"], t["nation"], t["partsupp"]
+    part, li = t["part"], t["lineitem"]
+    forest = part[part.p_name.str.startswith("forest")].p_partkey
+    sel_li = li[(li.l_shipdate >= pd.Timestamp("1994-01-01"))
+                & (li.l_shipdate < pd.Timestamp("1995-01-01"))]
+    half = (sel_li.groupby(["l_partkey", "l_suppkey"]).l_quantity.sum() * 0.5)
+    cand = ps[ps.ps_partkey.isin(forest)].copy()
+    key = list(zip(cand.ps_partkey, cand.ps_suppkey))
+    cand = cand[[half.get(k, np.nan) is not np.nan and cand_avail > half.get(k, np.inf)
+                 for k, cand_avail in zip(key, cand.ps_availqty)]] \
+        if len(cand) else cand
+    good_supp = set(cand.ps_suppkey)
+    m = supp[supp.s_suppkey.isin(good_supp)].merge(
+        nation[nation.n_name == "CANADA"], left_on="s_nationkey",
+        right_on="n_nationkey")
+    expected = m.sort_values("s_name").reset_index(drop=True)
+    assert list(result["s_name"]) == list(expected["s_name"])
+
+
+def test_q8_values(tpch_context):
+    c, t = tpch_context
+    result = c.sql(QUERIES[8]).compute()
+    part, supp, li = t["part"], t["supplier"], t["lineitem"]
+    orders, cust, nation, region = t["orders"], t["customer"], t["nation"], t["region"]
+    m = li.merge(part[part.p_type == "ECONOMY ANODIZED STEEL"],
+                 left_on="l_partkey", right_on="p_partkey")
+    m = m.merge(supp, left_on="l_suppkey", right_on="s_suppkey")
+    m = m.merge(orders, left_on="l_orderkey", right_on="o_orderkey")
+    m = m[(m.o_orderdate >= pd.Timestamp("1995-01-01"))
+          & (m.o_orderdate <= pd.Timestamp("1996-12-31"))]
+    m = m.merge(cust, left_on="o_custkey", right_on="c_custkey")
+    n1 = nation.add_suffix("_c")
+    m = m.merge(n1, left_on="c_nationkey", right_on="n_nationkey_c")
+    m = m.merge(region[region.r_name == "AMERICA"],
+                left_on="n_regionkey_c", right_on="r_regionkey")
+    n2 = nation.add_suffix("_s")
+    m = m.merge(n2, left_on="s_nationkey", right_on="n_nationkey_s")
+    m = m.assign(o_year=m.o_orderdate.dt.year,
+                 volume=m.l_extendedprice * (1 - m.l_discount))
+    if len(m) == 0:
+        assert len(result) == 0
+        return
+    g = m.groupby("o_year")
+    expected = (g.apply(lambda x: x[x.n_name_s == "BRAZIL"].volume.sum() / x.volume.sum(),
+                        include_groups=False)
+                .reset_index(name="share").sort_values("o_year").reset_index(drop=True))
+    assert list(result["o_year"]) == list(expected["o_year"])
+    np.testing.assert_allclose(result["mkt_share"], expected["share"], rtol=1e-9)
+
+
+def test_q2_values(tpch_context):
+    c, t = tpch_context
+    result = c.sql(QUERIES[2]).compute()
+    part, supp, ps = t["part"], t["supplier"], t["partsupp"]
+    nation, region = t["nation"], t["region"]
+    europe = nation.merge(region[region.r_name == "EUROPE"],
+                          left_on="n_regionkey", right_on="r_regionkey")
+    esupp = supp.merge(europe, left_on="s_nationkey", right_on="n_nationkey")
+    eps = ps.merge(esupp, left_on="ps_suppkey", right_on="s_suppkey")
+    min_cost = eps.groupby("ps_partkey").ps_supplycost.min()
+    sel_p = part[(part.p_size == 15) & part.p_type.str.endswith("BRASS")]
+    m = eps.merge(sel_p, left_on="ps_partkey", right_on="p_partkey")
+    m = m[m.ps_supplycost == m.ps_partkey.map(min_cost)]
+    expected = (m.sort_values(["s_acctbal", "n_name", "s_name", "p_partkey"],
+                              ascending=[False, True, True, True])
+                .head(100).reset_index(drop=True))
+    assert len(result) == len(expected)
+    if len(expected):
+        assert list(result["p_partkey"]) == list(expected["p_partkey"])
+        np.testing.assert_allclose(result["s_acctbal"], expected["s_acctbal"], rtol=1e-9)
