@@ -287,13 +287,16 @@ class _TraceEval:
             fn = dt_ops.truncate if op == "datetime_floor" else dt_ops.ceil_to
             return (fn(str(unit), ad), av)
         if op == "coalesce":
+            # fold from the last fallback toward the first (highest-precedence)
+            # argument; an always-valid argument resets the chain to all-valid
             out_d, out_v = vals[-1]
             for d, v in reversed(vals[:-1]):
                 if v is None:
-                    return (d, None)
-                out_v_ = jnp.zeros_like(v) if out_v is None else out_v
+                    out_d, out_v = d, None
+                    continue
+                base_valid = jnp.ones_like(v) if out_v is None else out_v
                 out_d = jnp.where(v, d, out_d)
-                out_v = v | out_v_
+                out_v = v | base_valid
             return (out_d, out_v)
         raise _Unsupported(f"op {op}")
 

@@ -176,3 +176,62 @@ def test_fuzz_hard_shapes_vs_sqlite(seed):
         assert_eq(got, expected, check_dtype=False, check_names=False)
     except AssertionError as e:  # pragma: no cover - debugging aid
         raise AssertionError(f"seed={seed} query={query!r}\n{e}") from e
+
+
+def _null_frames(seed):
+    """The standard fuzz frames with ~25% NULLs injected per column."""
+    t, u = _frames(seed)
+    rng = np.random.RandomState(seed + 77)
+
+    def inject(df):
+        out = {}
+        for col in df.columns:
+            vals = df[col].to_numpy().astype(object)
+            vals[rng.rand(len(vals)) < 0.25] = None
+            if pd.api.types.is_numeric_dtype(df[col]):
+                out[col] = pd.array(vals, dtype="float64")
+            else:
+                out[col] = vals
+        return pd.DataFrame(out)
+
+    return inject(t), inject(u)
+
+
+def _explicit_null_order(query: str) -> str:
+    """Align NULL ordering between the engines (sqlite defaults nulls-first
+    ASC; we follow Calcite/Postgres nulls-last ASC) by spelling it out."""
+    return (query
+            .replace("OVER (PARTITION BY a ORDER BY b, d, c)",
+                     "OVER (PARTITION BY a ORDER BY b NULLS FIRST, d NULLS FIRST, c NULLS FIRST)")
+            .replace("ORDER BY b DESC, a, d",
+                     "ORDER BY b DESC NULLS LAST, a NULLS FIRST, d NULLS FIRST")
+            .replace("ORDER BY a, b, d, c",
+                     "ORDER BY a NULLS FIRST, b NULLS FIRST, d NULLS FIRST, c NULLS FIRST")
+            .replace("ORDER BY a, c",
+                     "ORDER BY a NULLS FIRST, c NULLS FIRST"))
+
+
+@pytest.mark.parametrize("seed", range(500, 530))
+def test_fuzz_nulls_vs_sqlite(seed):
+    from dask_sql_tpu import Context
+
+    t, u = _null_frames(seed)
+    gen = (QueryGen2 if seed % 2 else QueryGen)(seed)
+    query = _explicit_null_order(gen.query())
+    # the rewrite is text-coupled to the generators: fail loudly if it no-ops
+    assert "ORDER BY" not in query or "NULLS" in query, query
+    conn = sqlite3.connect(":memory:")
+    t.to_sql("t", conn, index=False)
+    u.to_sql("u", conn, index=False)
+    expected = pd.read_sql_query(query, conn)
+    c = Context()
+    c.create_table("t", t)
+    c.create_table("u", u)
+    got = c.sql(query, return_futures=False)
+    if "ORDER BY" not in query:
+        expected = expected.sort_values(list(expected.columns)).reset_index(drop=True)
+        got = got.sort_values(list(got.columns)).reset_index(drop=True)
+    try:
+        assert_eq(got, expected, check_dtype=False, check_names=False)
+    except AssertionError as e:  # pragma: no cover - debugging aid
+        raise AssertionError(f"seed={seed} query={query!r}\n{e}") from e

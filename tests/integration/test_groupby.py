@@ -138,3 +138,14 @@ def test_median_with_nulls(c):
     result = c.sql("SELECT g, MEDIAN(v) AS m FROM mednull GROUP BY g").compute()
     result = result.sort_values("g").reset_index(drop=True)
     assert list(result["m"]) == [2.0, 5.0]
+
+def test_coalesce_in_compiled_aggregate(c):
+    # regression: the compiled pipeline's COALESCE must treat an always-valid
+    # fallback as valid (rows with NULL inputs still contribute)
+    df = pd.DataFrame({"g": ["x", "x", "y"], "v": [2.0, None, None]})
+    c.create_table("coag", df)
+    result = c.sql(
+        "SELECT g, AVG(COALESCE(v * v, 0)) AS m, COUNT(*) AS n FROM coag GROUP BY g"
+    ).compute().sort_values("g").reset_index(drop=True)
+    assert list(result["n"]) == [2, 1]
+    np.testing.assert_allclose(result["m"], [2.0, 0.0])
