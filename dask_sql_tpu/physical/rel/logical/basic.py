@@ -34,6 +34,9 @@ class TableScanPlugin(BaseRelPlugin):
         dc = executor.context.schema.get(rel.schema_name)
         dc = dc.tables.get(rel.table_name) if dc is not None else None
         if override is not None:
+            # batch-streaming execution: the batch replaces the scan source;
+            # projection subset here, filters apply via the common block below
+            # (the IO layer only pre-filtered the *convertible* conjuncts)
             table = override
             if rel.projection is not None:
                 table = table.select([c for c in rel.projection if c in table.columns])

@@ -24,8 +24,13 @@ from ..columnar.dtypes import SqlType
 from ..columnar.table import Table
 from ..datacontainer import LazyParquetContainer
 from ..planner import plan as p
-from ..planner.expressions import AggExpr
-from .compiled import _extract_chain
+from ..planner.expressions import (
+    AggExpr,
+    ExistsExpr,
+    InSubqueryExpr,
+    ScalarSubqueryExpr,
+    walk,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -44,18 +49,72 @@ _PARTIALIZABLE = {
 }
 
 
+def _find_stream_axis(plan: p.LogicalPlan, context):
+    """Locate the unique lazy-parquet scan and check the path to it is
+    batch-distributive (Filter/Projection/Alias freely; joins only where the
+    streamed side is the preserved/probe side).  Returns
+    (scan, container, off_path_roots) or None."""
+    lazy = []
+    for node in p.walk_plan(plan):
+        if isinstance(node, p.TableScan):
+            dc = context.schema.get(node.schema_name)
+            dc = dc.tables.get(node.table_name) if dc is not None else None
+            if isinstance(dc, LazyParquetContainer):
+                lazy.append((node, dc))
+    if len(lazy) != 1:
+        return None
+    scan, dc = lazy[0]
+
+    def path_to(node):
+        if node is scan:
+            return [node]
+        for child in node.inputs():
+            sub = path_to(child)
+            if sub is not None:
+                return [node] + sub
+        return None
+
+    path = path_to(plan)
+    if path is None:
+        return None
+    # subquery expressions embed whole plans that walk_plan cannot see; their
+    # evaluation inside a batch scope would read the override (wrong results
+    # when they reference the streamed table) — decline conservatively
+    from ..planner.optimizer.rules import _node_exprs
+
+    for node in p.walk_plan(plan):
+        for e in _node_exprs(node):
+            if any(isinstance(x, (ScalarSubqueryExpr, InSubqueryExpr, ExistsExpr))
+                   for x in walk(e)):
+                return None
+    off_path: List[p.LogicalPlan] = []
+    for parent, child in zip(path[:-1], path[1:]):
+        if isinstance(parent, (p.Filter, p.Projection, p.SubqueryAlias)):
+            continue
+        if isinstance(parent, p.Join):
+            on_left = child is parent.left
+            jt = parent.join_type
+            # union over lazy-side batches == full join only when the lazy
+            # side is the preserved/probe side
+            ok = (jt == "INNER"
+                  or (on_left and jt in ("LEFT", "LEFTSEMI", "LEFTANTI"))
+                  or (not on_left and jt == "RIGHT"))
+            if not ok:
+                return None
+            off_path.append(parent.right if on_left else parent.left)
+            continue
+        return None
+    return scan, dc, off_path
+
+
 def try_streaming_aggregate(rel: p.Aggregate, executor) -> Optional[Table]:
     config = executor.config
     if not config.get("sql.streaming.enabled", True):
         return None
-    chain = _extract_chain(rel)
-    if chain is None:
+    axis = _find_stream_axis(rel.input, executor.context)
+    if axis is None:
         return None
-    scan = chain[0]
-    dc = executor.context.schema.get(scan.schema_name)
-    dc = dc.tables.get(scan.table_name) if dc is not None else None
-    if not isinstance(dc, LazyParquetContainer):
-        return None
+    scan, dc, off_path = axis
     batch_rows = int(config.get("sql.streaming.batch_rows", 2_000_000))
     total = (dc.statistics or {}).get("num-rows", 0)
     if not total or total <= batch_rows:
@@ -63,6 +122,8 @@ def try_streaming_aggregate(rel: p.Aggregate, executor) -> Optional[Table]:
     for agg in rel.agg_exprs:
         if agg.func not in _PARTIALIZABLE or agg.distinct:
             return None
+    # non-streamed join sides execute ONCE, shared across batches
+    shared = {id(node): executor.execute(node) for node in off_path}
 
     # -- build the per-batch partial plan over the scan schema --------------
     # partial aggs: dedup (func, args, filter) structurally
@@ -105,6 +166,7 @@ def try_streaming_aggregate(rel: p.Aggregate, executor) -> Optional[Table]:
     for batch in _iter_batches(dc, names, pa_filters, batch_rows):
         sub = Executor(executor.context)
         sub.table_overrides[(scan.schema_name, scan.table_name)] = batch
+        sub._memo.update(shared)
         # execute the original subtree up to (excluding) the aggregate
         inp_table = sub.execute(rel.input)
         gcols = [sub.eval_expr(e, inp_table) for e in rel.group_exprs]

@@ -120,3 +120,75 @@ def test_streaming_aggregate_matches_inmemory(c, tmp_path, monkeypatch):
     sel = df[df.w < 90]
     exact = sel.groupby("g").big.sum().sort_index()
     assert list(streamed["sbig"].astype(np.int64)) == list(exact)
+
+def test_streaming_aggregate_through_join(c, tmp_path, monkeypatch):
+    rng = np.random.RandomState(9)
+    n = 24_000
+    fact = pd.DataFrame({
+        "k": rng.randint(0, 50, n).astype(np.int64),
+        "v": rng.rand(n),
+    })
+    path = str(tmp_path / "factjoin.parquet")
+    fact.to_parquet(path, row_group_size=3000)
+    dim = pd.DataFrame({"k": np.arange(50, dtype=np.int64),
+                        "grp": np.where(np.arange(50) % 2 == 0, "even", "odd"),
+                        "w": rng.rand(50)})
+    c.create_table("sfact", path, persist=False)
+    c.create_table("sdim", dim)
+
+    from dask_sql_tpu.physical import streaming as st
+
+    batches_seen = []
+    orig = st._iter_batches
+
+    def spy(dc, columns, pa_filters, batch_rows):
+        for b in orig(dc, columns, pa_filters, batch_rows):
+            batches_seen.append(b.num_rows)
+            yield b
+
+    monkeypatch.setattr(st, "_iter_batches", spy)
+    q = ("SELECT grp, SUM(v * w) AS s, COUNT(*) AS n FROM sfact "
+         "JOIN sdim ON sfact.k = sdim.k GROUP BY grp")
+    streamed = c.sql(q, config_options={"sql.streaming.batch_rows": 4000}).compute()
+    assert len(batches_seen) > 1, "join subtree did not stream"
+    inmem = c.sql(q, config_options={"sql.streaming.enabled": False}).compute()
+    streamed = streamed.sort_values("grp").reset_index(drop=True)
+    inmem = inmem.sort_values("grp").reset_index(drop=True)
+    assert list(streamed["n"]) == list(inmem["n"])
+    np.testing.assert_allclose(streamed["s"], inmem["s"], rtol=1e-9)
+    # cross-check vs pandas
+    m = fact.merge(dim, on="k")
+    expected = (m.assign(s=m.v * m.w).groupby("grp").s.sum().reset_index()
+                .sort_values("grp").reset_index(drop=True))
+    np.testing.assert_allclose(streamed["s"], expected["s"], rtol=1e-9)
+
+def test_streaming_declines_full_join(c, tmp_path):
+    rng = np.random.RandomState(10)
+    fact = pd.DataFrame({"k": rng.randint(0, 10, 9000).astype(np.int64),
+                         "v": rng.rand(9000)})
+    path = str(tmp_path / "fj.parquet")
+    fact.to_parquet(path, row_group_size=1000)
+    dim = pd.DataFrame({"k": np.arange(12, dtype=np.int64), "w": rng.rand(12)})
+    c.create_table("fjf", path, persist=False)
+    c.create_table("fjd", dim)
+    # FULL join is not batch-distributive: must fall back, still correct
+    q = ("SELECT COUNT(*) AS n FROM fjf FULL JOIN fjd ON fjf.k = fjd.k")
+    got = c.sql(q, config_options={"sql.streaming.batch_rows": 2000}).compute()
+    m = fact.merge(dim, on="k", how="outer")
+    assert got["n"][0] == len(m)
+
+def test_streaming_declines_embedded_subquery(c, tmp_path):
+    rng = np.random.RandomState(11)
+    df = pd.DataFrame({"g": rng.choice(["a", "b"], 9000),
+                       "v": rng.rand(9000)})
+    path = str(tmp_path / "subq.parquet")
+    df.to_parquet(path, row_group_size=1000)
+    c.create_table("subq_t", path, persist=False)
+    # the scalar subquery must see the WHOLE table, not per-batch overrides
+    q = ("SELECT g, MAX(v - (SELECT AVG(v) FROM subq_t)) AS m "
+         "FROM subq_t GROUP BY g")
+    got = c.sql(q, config_options={"sql.streaming.batch_rows": 2000}).compute()
+    expected = (df.assign(m=df.v - df.v.mean()).groupby("g").m.max().reset_index()
+                .sort_values("g").reset_index(drop=True))
+    got = got.sort_values("g").reset_index(drop=True)
+    np.testing.assert_allclose(got["m"], expected["m"], rtol=1e-9)
