@@ -440,3 +440,34 @@ def test_q2_values(tpch_context):
     if len(expected):
         assert list(result["p_partkey"]) == list(expected["p_partkey"])
         np.testing.assert_allclose(result["s_acctbal"], expected["s_acctbal"], rtol=1e-9)
+
+
+@pytest.mark.parametrize("qnum,options", [
+    # compile-off must agree everywhere
+    (1, {"sql.compile": False}),
+    (3, {"sql.compile": False}),
+    (6, {"sql.compile": False}),
+    (13, {"sql.compile": False}),
+    (21, {"sql.compile": False}),
+    # optimizer-off is only *feasible* for explicit-join / single-table
+    # queries (comma-joins rely on cross-join elimination, like the reference)
+    (1, {"sql.optimize": False}),
+    (6, {"sql.optimize": False}),
+    (13, {"sql.optimize": False}),
+])
+def test_config_invariance(tpch_context, qnum, options):
+    """Uncompiled / unoptimized execution must agree with the default path."""
+    c, _ = tpch_context
+    baseline = c.sql(QUERIES[qnum]).compute()
+    variant = c.sql(QUERIES[qnum], config_options=options).compute()
+    assert list(baseline.columns) == list(variant.columns)
+    assert len(baseline) == len(variant)
+    for col in baseline.columns:
+        b = baseline[col]
+        v = variant[col]
+        if b.dtype.kind in ("f", "i"):
+            np.testing.assert_allclose(
+                b.astype(float), v.astype(float), rtol=1e-9,
+                err_msg=f"q{qnum} col {col} options {options}")
+        else:
+            assert list(b.astype(str)) == list(v.astype(str)), (qnum, col, options)
