@@ -79,3 +79,28 @@ def test_server_jdbc_metadata(c):
         assert "df_simple" in names
     finally:
         srv.shutdown()
+
+
+def test_server_concurrent_queries(server):
+    import concurrent.futures
+
+    port = server.port
+
+    def run(i):
+        payload = _follow(port, _post(port, f"SELECT {i} * a AS v FROM df_simple ORDER BY v"))
+        return [row[0] for row in payload["data"]]
+
+    with concurrent.futures.ThreadPoolExecutor(max_workers=6) as pool:
+        results = list(pool.map(run, range(1, 7)))
+    for i, vals in enumerate(results, start=1):
+        assert vals == [i * 1, i * 2, i * 3]
+
+
+def test_visualize_writes_plan(c, tmp_path):
+    path = str(tmp_path / "plan")
+    c.visualize("SELECT a FROM df_simple WHERE a > 1", filename=path)
+    import os
+
+    assert os.path.exists(path + ".txt")
+    with open(path + ".txt") as f:
+        assert "TableScan" in f.read()

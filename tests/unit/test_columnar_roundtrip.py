@@ -1,0 +1,73 @@
+"""Columnar interop round-trips and direct 3VL kernel checks."""
+import numpy as np
+import pandas as pd
+import pytest
+
+
+def test_arrow_roundtrip():
+    import pyarrow as pa
+
+    from dask_sql_tpu.columnar import Table
+
+    df = pd.DataFrame({
+        "i": [1, 2, 3],
+        "f": [1.5, None, 3.5],
+        "s": ["x", None, "z"],
+        "b": [True, False, True],
+        "t": pd.to_datetime(["2020-01-01", "2021-06-01", "2022-12-31"]),
+    })
+    table = Table.from_pandas(df)
+    at = table.to_arrow()
+    assert isinstance(at, pa.Table)
+    back = Table.from_arrow(at).to_pandas()
+    assert list(back["i"]) == [1, 2, 3]
+    assert pd.isna(back["f"][1]) and back["f"][2] == 3.5
+    assert back["s"][0] == "x" and pd.isna(back["s"][1])
+    assert list(back["b"]) == [True, False, True]
+    assert list(pd.to_datetime(back["t"])) == list(df["t"])
+
+
+def test_arrow_dictionary_input():
+    import pyarrow as pa
+
+    from dask_sql_tpu.columnar import Table
+
+    arr = pa.array(["a", "b", "a", None]).dictionary_encode()
+    at = pa.table({"d": arr, "v": pa.array([1, 2, 3, 4])})
+    t = Table.from_arrow(at)
+    out = t.to_pandas()
+    assert list(out["d"][:3]) == ["a", "b", "a"] and pd.isna(out["d"][3])
+
+
+def test_three_valued_logic_kernels():
+    import jax.numpy as jnp
+
+    from dask_sql_tpu.columnar.column import Column
+    from dask_sql_tpu.columnar.dtypes import SqlType
+    from dask_sql_tpu.physical.rex.operations import OPERATION_MAPPING as OPS
+
+    T, F, N = True, False, None  # truth table inputs
+
+    def col(vals):
+        data = jnp.asarray([bool(v) if v is not None else False for v in vals])
+        validity = jnp.asarray([v is not None for v in vals])
+        if bool(validity.all()):
+            return Column(data, SqlType.BOOLEAN)
+        return Column(data, SqlType.BOOLEAN, validity)
+
+    def decode(c):
+        out = []
+        valid = np.asarray(c.valid_mask())
+        data = np.asarray(c.data)
+        for d, v in zip(data, valid):
+            out.append(bool(d) if v else None)
+        return out
+
+    a = col([T, T, T, F, F, F, N, N, N])
+    b = col([T, F, N, T, F, N, T, F, N])
+    assert decode(OPS["and"](a, b)) == [T, F, N, F, F, F, N, F, N]
+    assert decode(OPS["or"](a, b)) == [T, T, T, T, F, N, T, N, N]
+    assert decode(OPS["not"](a)) == [F, F, F, T, T, T, N, N, N]
+    assert decode(OPS["is_null"](a)) == [F, F, F, F, F, F, T, T, T]
+    assert decode(OPS["is_true"](a)) == [T, T, T, F, F, F, F, F, F]
+    assert decode(OPS["is_not_false"](a)) == [T, T, T, F, F, F, T, T, T]
