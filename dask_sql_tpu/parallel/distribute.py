@@ -22,15 +22,29 @@ from .mesh import default_mesh, row_sharding
 def shard_table(table: Table, mesh=None) -> Table:
     """Return the same table with all device buffers row-sharded over mesh.
 
-    Rows are padded internally by XLA when the count does not divide the
-    device count; logical row count is unchanged.
+    `device_put` requires the row count to divide the device count, so
+    non-divisible tables are zero-padded, placed, and sliced back to their
+    logical length (the sliced result keeps a sharded layout; GSPMD pads
+    internally from there).
     """
     mesh = mesh or default_mesh()
     sharding = row_sharding(mesh)
+    ndev = mesh.devices.size
+    n = table.num_rows
+    target = ((n + ndev - 1) // ndev) * ndev
+
+    from .mesh import pad_to_multiple
+
+    def place(arr):
+        if target == n:
+            return jax.device_put(arr, sharding)
+        padded, _ = pad_to_multiple(arr, ndev)
+        return jax.device_put(padded, sharding)[:n]
+
     cols = {}
     for name, col in table.columns.items():
-        data = jax.device_put(col.data, sharding)
-        validity = None if col.validity is None else jax.device_put(col.validity, sharding)
+        data = place(col.data)
+        validity = None if col.validity is None else place(col.validity)
         cols[name] = Column(data, col.sql_type, validity, col.dictionary)
     return Table(cols, table.num_rows)
 
