@@ -471,3 +471,44 @@ def test_config_invariance(tpch_context, qnum, options):
                 err_msg=f"q{qnum} col {col} options {options}")
         else:
             assert list(b.astype(str)) == list(v.astype(str)), (qnum, col, options)
+
+
+@pytest.fixture(scope="module")
+def tpch_distributed_context():
+    import jax
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs the virtual multi-device mesh")
+    from dask_sql_tpu import Context
+
+    c = Context()
+    tables = generate(scale_rows=2000)
+    for name, df in tables.items():
+        c.create_table(name, df, distributed=True)
+    return c, tables
+
+
+@pytest.mark.parametrize("qnum", [1, 3, 5, 6, 13])
+def test_tpch_distributed(tpch_distributed_context, qnum):
+    """TPC-H over mesh-sharded tables must match the single-device answers."""
+    c, tables = tpch_distributed_context
+    result = c.sql(QUERIES[qnum]).compute()
+    ref = Context_single(tables).sql(QUERIES[qnum]).compute()
+    assert list(result.columns) == list(ref.columns)
+    assert len(result) == len(ref)
+    for col in result.columns:
+        a, b = result[col], ref[col]
+        if a.dtype.kind in ("f", "i"):
+            np.testing.assert_allclose(a.astype(float), b.astype(float), rtol=1e-9,
+                                       err_msg=f"q{qnum} col {col}")
+        else:
+            assert list(a.astype(str)) == list(b.astype(str)), (qnum, col)
+
+
+def Context_single(tables):
+    from dask_sql_tpu import Context
+
+    c = Context()
+    for name, df in tables.items():
+        c.create_table(name, df)
+    return c
