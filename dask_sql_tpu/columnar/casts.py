@@ -72,11 +72,16 @@ def _cast_from_string(col: Column, target: SqlType) -> Column:
         vals = np.zeros(len(strs), dtype=np.int64)
         bad = np.zeros(len(strs), dtype=bool)
         for i, s in enumerate(strs):
+            t = s.strip()
             try:
-                vals[i] = int(float(s)) if s.strip() else 0
-                bad[i] = not s.strip()
+                # int(t) first: int(float(t)) loses precision above 2^53
+                vals[i] = int(t) if t else 0
+                bad[i] = not t
             except ValueError:
-                bad[i] = True
+                try:
+                    vals[i] = int(float(t))
+                except (ValueError, OverflowError):
+                    bad[i] = True
         vals = vals.astype(sql_to_np(target))
     elif target in FLOAT_TYPES:
         vals = np.zeros(len(strs), dtype=np.float64)

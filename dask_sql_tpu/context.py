@@ -312,13 +312,15 @@ class Context:
             return frame
         return TpuFrame(self, plan, [f.name for f in plan.schema], config_options)
 
-    def explain(self, sql: str, dataframes: Optional[Dict[str, Any]] = None) -> str:
+    def explain(self, sql: str, dataframes: Optional[Dict[str, Any]] = None,
+                config_options: Optional[Dict[str, Any]] = None) -> str:
         """Return the optimized logical plan as a string (parity context.py:535)."""
         if dataframes is not None:
             for df_name, df in dataframes.items():
                 self.create_table(df_name, df)
-        stmt = parse_sql(sql)[0]
-        plan = self._get_ral(stmt)
+        with self.config.set(config_options or {}):
+            stmt = parse_sql(sql)[0]
+            plan = self._get_ral(stmt)
         if isinstance(plan, plan_nodes.Explain):
             plan = plan.input
         return plan.explain()

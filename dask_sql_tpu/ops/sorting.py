@@ -58,8 +58,15 @@ def sort_table(table, keys: Sequence[SortKey], eval_key):
     return table.take(perm)
 
 
-def topk_permutation(col: Column, ascending: bool, k: int) -> Optional[jnp.ndarray]:
-    """Top-k on a single numeric/ordered key via lax.top_k; None if ineligible."""
+def topk_permutation(col: Column, ascending: bool, k: int,
+                     exact_ties: bool = False) -> Optional[jnp.ndarray]:
+    """Top-k on a single numeric/ordered key via lax.top_k; None if ineligible.
+
+    With ``exact_ties=True`` (needed when secondary sort keys exist), returns
+    None unless every row tied with the boundary value made it into the top-k
+    — otherwise a truncation by the primary key alone could drop rows that
+    secondary keys would have ranked into the final fetch window.
+    """
     if col.sql_type in STRING_TYPES and col.dictionary is not None:
         col = col.compact_dictionary()
     data = col.data
@@ -70,6 +77,11 @@ def topk_permutation(col: Column, ascending: bool, k: int) -> Optional[jnp.ndarr
     vals = data.astype(jnp.float64) if not jnp.issubdtype(data.dtype, jnp.floating) else data
     if ascending:
         vals = -vals
-    k = min(k, int(data.shape[0]))
+    n = int(data.shape[0])
+    k = min(k, n)
     _, idx = jax.lax.top_k(vals, k)
+    if exact_ties and 0 < k < n:
+        boundary = vals[idx[-1]]  # top_k sorts descending: last kept = worst
+        if int((vals == boundary).sum()) != int((vals[idx] == boundary).sum()):
+            return None
     return idx

@@ -184,13 +184,15 @@ def make_hash_shuffle(mesh: Optional[Mesh] = None, capacity_per_peer: int = 4096
         pos_in_bucket = idx - start_of_dest[jnp.clip(ds, 0, ndev)]
         overflow = jnp.any((pos_in_bucket >= C) & vs)
         slot_ok = vs & (pos_in_bucket < C)
-        flat = jnp.clip(ds, 0, ndev - 1) * C + jnp.clip(pos_in_bucket, 0, C - 1)
+        # non-landing rows scatter out-of-bounds so mode="drop" discards them;
+        # a clipped index would nondeterministically clobber a real slot
+        flat = jnp.where(slot_ok, ds * C + pos_in_bucket, ndev * C)
         bk = jnp.zeros((ndev * C,), dtype=keys.dtype).at[flat].set(
-            jnp.where(slot_ok, ks, jnp.zeros_like(ks)), mode="drop")
+            ks, mode="drop")
         bv = jnp.zeros((ndev * C,), dtype=bool).at[flat].set(
-            jnp.where(slot_ok, vs, False), mode="drop")
+            slot_ok, mode="drop")
         bp = jnp.zeros((ndev * C, payload.shape[1]), dtype=payload.dtype).at[flat].set(
-            jnp.where(slot_ok[:, None], ps, jnp.zeros_like(ps)), mode="drop")
+            ps, mode="drop")
         # the collective: exchange bucket b with device b
         bk = bk.reshape(ndev, C)
         bv = bv.reshape(ndev, C)

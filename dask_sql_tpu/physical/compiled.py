@@ -108,6 +108,10 @@ class _TraceEval:
                 raise _Unsupported("string cast in compiled pipeline")
             if src in FLOAT_TYPES and dst in INTEGER_TYPES:
                 d = jnp.nan_to_num(jnp.trunc(d))
+            if src in DATETIME_TYPES and dst == SqlType.DATE:
+                # match the eager cast: truncate epoch-ns to midnight
+                ns_per_day = jnp.int64(86_400_000_000_000)
+                d = (jnp.floor_divide(d, ns_per_day)) * ns_per_day
             if dst == SqlType.BOOLEAN:
                 return (d != 0, v)
             return (d.astype(sql_to_np(dst)), v)
@@ -214,8 +218,10 @@ class _TraceEval:
         if op == "mod":
             (ad, av), (bd, bv) = vals
             ad, bd = _promote_pair(ad, bd)
-            safe = jnp.where(bd == 0, 1, bd) if jnp.issubdtype(ad.dtype, jnp.integer) else bd
-            return (jnp.fmod(ad, safe), _and_valid(av, bv))
+            if jnp.issubdtype(ad.dtype, jnp.integer):
+                safe = jnp.where(bd == 0, 1, bd)
+                return (jnp.fmod(ad, safe), _and_valid(av, bv, bd != 0))
+            return (jnp.fmod(ad, bd), _and_valid(av, bv))
         if op == "and":
             (ad, av), (bd, bv) = vals
             a_t = ad if av is None else (ad & av)

@@ -34,9 +34,19 @@ class AggregatePlugin(BaseRelPlugin):
     class_name = "Aggregate"
 
     def convert(self, rel: p.Aggregate, executor) -> Table:
+        from ....parallel import dist_plan
         from ...compiled import try_compiled_aggregate
         from ...streaming import try_streaming_aggregate
 
+        # collectives-routed path for mesh-sharded inputs (round-2 engine:
+        # the distributed shuffle IS the execution layer, not GSPMD fallout);
+        # when it declines (knob off / non-decomposable agg) fall through to
+        # the streaming/compiled fast paths like any other input
+        if dist_plan.plan_has_sharded_scan(rel.input, executor.context):
+            (inp,) = self.assert_inputs(rel, 1, executor)
+            dist = dist_plan.try_dist_aggregate(rel, executor, inp)
+            if dist is not None:
+                return dist
         streamed = try_streaming_aggregate(rel, executor)
         if streamed is not None:
             return streamed

@@ -127,7 +127,8 @@ class SortPlugin(BaseRelPlugin):
                 and rel.fetch * max(len(inp.columns), 1) <= limit):
             # top-k on the primary key then exact sort of the k survivors —
             # parity: reference topk_sort utils/sort.py:78 eligibility
-            idx = topk_permutation(cols[0], rel.keys[0].ascending, rel.fetch * 4)
+            idx = topk_permutation(cols[0], rel.keys[0].ascending, rel.fetch * 4,
+                                   exact_ties=len(cols) > 1)
             if idx is not None:
                 sub = inp.take(idx)
                 sub_cols = [executor.eval_expr(k.expr, sub) for k in rel.keys]

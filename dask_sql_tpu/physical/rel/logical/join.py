@@ -67,8 +67,44 @@ class JoinPlugin(BaseRelPlugin):
             lgid, rgid = join_ops.join_key_gids(lkeys, rkeys)
         else:
             # no equi keys: every row matches every row (filtered below)
+            lkeys = rkeys = []
             lgid = jnp.zeros(left.num_rows, dtype=jnp.int64)
             rgid = jnp.zeros(right.num_rows, dtype=jnp.int64)
+
+        # collectives-routed distributed join (all_to_all shuffle + local
+        # probe) when an input is mesh-sharded; a small build side instead
+        # stays replicated = broadcast join (`sql.join.broadcast` parity,
+        # reference join.py:228)
+        dist_pairs = None
+        if rel.on:
+            dist_pairs = self._maybe_dist_pairs(
+                executor, left, right, lkeys, rkeys, lgid, rgid)
+        if dist_pairs is not None:
+            li, ri, lmatched = dist_pairs
+            if jt in ("LEFTSEMI", "LEFTANTI"):
+                if rel.filter is None:
+                    mask = jnp.asarray(lmatched)
+                    if jt == "LEFTANTI":
+                        mask = ~mask
+                    return self.fix_column_to_row_type(left.filter(mask), rel.schema)
+                combined = _materialize(left, right, li, ri)
+                cond = executor.eval_expr(rel.filter, combined)
+                keep = cond.data & cond.valid_mask()
+                matched = jnp.zeros(left.num_rows, dtype=bool)
+                if int(li.shape[0]):
+                    matched = matched.at[li].max(keep)
+                if jt == "LEFTANTI":
+                    matched = ~matched
+                return self.fix_column_to_row_type(left.filter(matched), rel.schema)
+            if jt == "INNER":
+                combined = _materialize(left, right, li, ri)
+                if rel.filter is not None:
+                    cond = executor.eval_expr(rel.filter, combined)
+                    combined = combined.filter(cond.data & cond.valid_mask())
+                return self.fix_column_to_row_type(combined, rel.schema)
+            if jt in ("LEFT", "RIGHT", "FULL"):
+                return self._outer_from_pairs(rel, executor, left, right, li, ri, jt)
+            raise NotImplementedError(f"join type {jt}")
 
         if jt in ("LEFTSEMI", "LEFTANTI"):
             if rel.filter is None:
@@ -99,33 +135,64 @@ class JoinPlugin(BaseRelPlugin):
             return self.fix_column_to_row_type(combined, rel.schema)
 
         if jt in ("LEFT", "RIGHT", "FULL"):
-            # probe as inner first, apply the residual to matched pairs, then
-            # pad outer rows that lost all their matches
             li, ri = join_ops.inner_join_indices(lgid, rgid, use_jit)
-            if rel.filter is not None and int(li.shape[0]):
-                combined = _materialize(left, right, li, ri)
-                cond = executor.eval_expr(rel.filter, combined)
-                keep = cond.data & cond.valid_mask()
-                li, ri = li[keep], ri[keep]
-            li2, ri2 = li, ri
-            if jt in ("LEFT", "FULL"):
-                lm = jnp.zeros(left.num_rows, dtype=bool)
-                if int(li.shape[0]):
-                    lm = lm.at[li].set(True)
-                pad = jnp.nonzero(~lm)[0].astype(jnp.int64)
-                li2 = jnp.concatenate([li2, pad])
-                ri2 = jnp.concatenate([ri2, jnp.full(pad.shape[0], -1, dtype=jnp.int64)])
-            if jt in ("RIGHT", "FULL"):
-                rm = jnp.zeros(right.num_rows, dtype=bool)
-                if int(ri.shape[0]):
-                    rm = rm.at[ri].set(True)
-                pad = jnp.nonzero(~rm)[0].astype(jnp.int64)
-                li2 = jnp.concatenate([li2, jnp.full(pad.shape[0], -1, dtype=jnp.int64)])
-                ri2 = jnp.concatenate([ri2, pad])
-            combined = _materialize(left, right, li2, ri2)
-            return self.fix_column_to_row_type(combined, rel.schema)
+            return self._outer_from_pairs(rel, executor, left, right, li, ri, jt)
 
         raise NotImplementedError(f"join type {jt}")
+
+    def _outer_from_pairs(self, rel, executor, left, right, li, ri, jt) -> Table:
+        """Outer join from inner (li, ri) pairs: apply the residual to matched
+        pairs, then pad outer rows that lost all their matches."""
+        if rel.filter is not None and int(li.shape[0]):
+            combined = _materialize(left, right, li, ri)
+            cond = executor.eval_expr(rel.filter, combined)
+            keep = cond.data & cond.valid_mask()
+            li, ri = li[keep], ri[keep]
+        li2, ri2 = li, ri
+        if jt in ("LEFT", "FULL"):
+            lm = jnp.zeros(left.num_rows, dtype=bool)
+            if int(li.shape[0]):
+                lm = lm.at[li].set(True)
+            pad = jnp.nonzero(~lm)[0].astype(jnp.int64)
+            li2 = jnp.concatenate([li2, pad])
+            ri2 = jnp.concatenate([ri2, jnp.full(pad.shape[0], -1, dtype=jnp.int64)])
+        if jt in ("RIGHT", "FULL"):
+            rm = jnp.zeros(right.num_rows, dtype=bool)
+            if int(ri.shape[0]):
+                rm = rm.at[ri].set(True)
+            pad = jnp.nonzero(~rm)[0].astype(jnp.int64)
+            li2 = jnp.concatenate([li2, jnp.full(pad.shape[0], -1, dtype=jnp.int64)])
+            ri2 = jnp.concatenate([ri2, pad])
+        combined = _materialize(left, right, li2, ri2)
+        return self.fix_column_to_row_type(combined, rel.schema)
+
+    def _maybe_dist_pairs(self, executor, left, right, lkeys, rkeys, lgid, rgid):
+        """Collectives-routed equijoin matching, or None for the local path.
+
+        Honors `sql.join.broadcast`: when the smaller side fits under the
+        threshold it stays replicated (no shuffle at all) and the local
+        sort/searchsorted probe runs per shard — the broadcast join."""
+        from ....parallel import dist_plan
+
+        mesh = dist_plan.should_distribute(
+            executor, "sql.distributed.join", left, right)
+        if mesh is None:
+            return None
+        broadcast = executor.config.get("sql.join.broadcast", None)
+        small = min(left.num_rows, right.num_rows)
+        if broadcast is True:
+            return None  # always-broadcast: replicated small side, local probe
+        if broadcast not in (None, False) and small <= float(broadcast):
+            return None
+        lvalid = jnp.ones(left.num_rows, dtype=bool)
+        for c in lkeys:
+            if c.validity is not None:
+                lvalid &= c.valid_mask()
+        rvalid = jnp.ones(right.num_rows, dtype=bool)
+        for c in rkeys:
+            if c.validity is not None:
+                rvalid &= c.valid_mask()
+        return dist_plan.dist_inner_pairs(mesh, lgid, lvalid, rgid, rvalid)
 
 
 @Executor.add_plugin_class

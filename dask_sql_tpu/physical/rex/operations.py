@@ -383,29 +383,89 @@ def _is_const(c: Column) -> bool:
     return hasattr(c, "_lit_value") or len(c) == 1
 
 
+def _col_rows(c: Column, n: int) -> np.ndarray:
+    """Per-row python values of `c` broadcast to n rows, None where NULL."""
+    if c.sql_type in STRING_TYPES:
+        vals = c.to_numpy()
+    else:
+        raw = np.asarray(c.data)
+        valid = None if c.validity is None else np.asarray(c.validity)
+        vals = np.empty(len(raw), dtype=object)
+        for i in range(len(raw)):
+            vals[i] = None if (valid is not None and not valid[i]) else raw[i].item()
+    if len(vals) == 1 and n != 1:
+        vals = np.repeat(vals, n)
+    return vals
+
+
+def _rowwise_fallback(cols, fn, result: str = "str") -> Column:
+    """Row-wise host evaluation for string ops whose non-first arguments are
+    per-row columns (the reference evaluates these via pandas row-wise ops,
+    call.py). Any NULL argument yields a NULL result row."""
+    n = max(len(c) for c in cols)
+    rows = [_col_rows(c, n) for c in cols]
+    out = np.empty(n, dtype=object)
+    for i in range(n):
+        args = [r[i] for r in rows]
+        out[i] = None if any(a is None for a in args) else fn(*args)
+    if result == "str":
+        return Column.from_numpy(out)
+    mask = np.array([v is not None for v in out])
+    if result == "bool":
+        vals = np.array([bool(v) if v is not None else False for v in out])
+        return Column(jnp.asarray(vals), SqlType.BOOLEAN,
+                      None if mask.all() else jnp.asarray(mask))
+    vals = np.array([int(v) if v is not None else 0 for v in out], dtype=np.int64)
+    return Column(jnp.asarray(vals), SqlType.BIGINT,
+                  None if mask.all() else jnp.asarray(mask))
+
+
 def _trim_op(where: str):
+    strip = {"both": str.strip, "left": str.lstrip, "right": str.rstrip}[where]
+
     def op(a: Column, chars: Optional[Column] = None) -> Column:
         a = _require_dict(a)
-        ch = None
-        if chars is not None:
-            ch = str(np.asarray(chars.to_numpy())[0])
-        if where == "both":
-            return str_ops.map_unary(a, lambda x: x.strip(ch))
-        if where == "left":
-            return str_ops.map_unary(a, lambda x: x.lstrip(ch))
-        return str_ops.map_unary(a, lambda x: x.rstrip(ch))
+        if chars is None:
+            return str_ops.map_unary(a, lambda x: strip(x))
+        if _is_const(chars):
+            ch = chars.to_numpy()[0]
+            if ch is None:
+                return _all_null_like(a, a.sql_type)
+            return str_ops.map_unary(a, lambda x: strip(x, str(ch)))
+        return _rowwise_fallback([a, _require_dict(chars)],
+                                 lambda x, ch: strip(x, ch))
 
     return op
+
+
+def _all_null_like(a: Column, sql_type) -> Column:
+    n = len(a)
+    if sql_type in STRING_TYPES:
+        return Column(jnp.zeros(n, jnp.int32), sql_type,
+                      jnp.zeros(n, bool), np.array([""], dtype=object))
+    return Column(jnp.zeros(n, jnp.int64), sql_type, jnp.zeros(n, bool))
 
 
 def _op_like(a: Column, pattern: Column, escape: Optional[Column] = None,
              case_insensitive: bool = False, similar: bool = False) -> Column:
     a = _require_dict(a)
-    pat = str(pattern.to_numpy()[0])
-    esc = str(escape.to_numpy()[0]) if escape is not None else None
-    rx_text = str_ops.similar_to_regex(pat, esc) if similar else str_ops.like_to_regex(pat, esc)
-    rx = re.compile(rx_text, re.IGNORECASE if case_insensitive else 0)
-    return str_ops.map_predicate(a, lambda x: rx.match(x) is not None)
+    flags = re.IGNORECASE if case_insensitive else 0
+    to_rx = str_ops.similar_to_regex if similar else str_ops.like_to_regex
+    if _is_const(pattern) and (escape is None or _is_const(escape)):
+        pat = pattern.to_numpy()[0]
+        esc = escape.to_numpy()[0] if escape is not None else None
+        if pat is None:
+            return _all_null_like(a, SqlType.BOOLEAN)
+        rx = re.compile(to_rx(str(pat), None if esc is None else str(esc)), flags)
+        return str_ops.map_predicate(a, lambda x: rx.match(x) is not None)
+    cols = [a, _require_dict(pattern)]
+    if escape is not None:
+        cols.append(_require_dict(escape))
+
+    def fn(x, p, e=None):
+        return re.compile(to_rx(p, e), flags).match(x) is not None
+
+    return _rowwise_fallback(cols, fn, result="bool")
 
 
 def _op_position(needle: Column, hay: Column) -> Column:
@@ -418,29 +478,88 @@ def _op_position(needle: Column, hay: Column) -> Column:
     return out.cast(SqlType.INTEGER)
 
 
+def _overlay_one(x: str, r: str, s: int, ln) -> str:
+    begin = int(s) - 1
+    ln = len(r) if ln is None else int(ln)
+    return x[:begin] + r + x[begin + ln:]
+
+
 def _op_overlay(a: Column, repl: Column, start: Column, length: Optional[Column] = None) -> Column:
     a = _require_dict(a)
-    r = str(repl.to_numpy()[0])
-    s = int(np.asarray(start.data)[0])
-    ln = int(np.asarray(length.data)[0]) if length is not None else len(r)
+    consts = _is_const(repl) and _is_const(start) and (length is None or _is_const(length))
+    if consts:
+        r = str(repl.to_numpy()[0])
+        s = int(np.asarray(start.data)[0])
+        ln = int(np.asarray(length.data)[0]) if length is not None else None
+        return str_ops.map_unary(a, lambda x: _overlay_one(x, r, s, ln))
+    cols = [a, _require_dict(repl), start] + ([length] if length is not None else [])
+    return _rowwise_fallback(
+        cols, lambda x, r, s, ln=None: _overlay_one(x, r, s, ln))
 
-    def fn(x: str) -> str:
-        begin = s - 1
-        return x[:begin] + r + x[begin + ln :]
 
-    return str_ops.map_unary(a, fn)
+def _split_one(x: str, d: str, k: int) -> str:
+    parts = x.split(d)
+    return parts[k - 1] if 1 <= k <= len(parts) else ""
 
 
 def _op_split_part(a: Column, delim: Column, n: Column) -> Column:
     a = _require_dict(a)
-    d = str(delim.to_numpy()[0])
-    k = int(np.asarray(n.data)[0])
+    if _is_const(delim) and _is_const(n):
+        d = str(delim.to_numpy()[0])
+        k = int(np.asarray(n.data)[0])
+        return str_ops.map_unary(a, lambda x: _split_one(x, d, k))
+    return _rowwise_fallback([a, _require_dict(delim), n],
+                             lambda x, d, k: _split_one(x, d, int(k)))
 
-    def fn(x: str) -> str:
-        parts = x.split(d)
-        return parts[k - 1] if 1 <= k <= len(parts) else ""
 
-    return str_ops.map_unary(a, fn)
+def _op_replace(a: Column, f: Column, t: Column) -> Column:
+    a = _require_dict(a)
+    if _is_const(f) and _is_const(t):
+        fv, tv = f.to_numpy()[0], t.to_numpy()[0]
+        if fv is None or tv is None:
+            return _all_null_like(a, a.sql_type)
+        fv, tv = str(fv), str(tv)
+        return str_ops.map_unary(a, lambda x: x.replace(fv, tv))
+    return _rowwise_fallback([a, _require_dict(f), _require_dict(t)],
+                             lambda x, fv, tv: x.replace(fv, tv))
+
+
+def _left_one(x: str, k: int) -> str:
+    return x[:k] if k >= 0 else x[: max(len(x) + k, 0)]
+
+
+def _right_one(x: str, k: int) -> str:
+    if k == 0:
+        return ""
+    return x[-k:] if k > 0 else x[min(-k, len(x)):]
+
+
+def _str_num_op(a: Column, n: Column, fn) -> Column:
+    """String op with one integer argument; const fast path else row-wise."""
+    a = _require_dict(a)
+    if _is_const(n):
+        k = int(np.asarray(n.data)[0])
+        return str_ops.map_unary(a, lambda x: fn(x, k))
+    return _rowwise_fallback([a, n], lambda x, k: fn(x, int(k)))
+
+
+def _pad_one(x: str, k: int, c: str, left: bool) -> str:
+    if not c:
+        c = " "
+    if left:
+        return (c * k + x)[-k:] if len(x) < k else x[:k]
+    return (x + c * k)[:k]
+
+
+def _pad_op(a: Column, n: Column, p: Optional[Column], left: bool) -> Column:
+    a = _require_dict(a)
+    if _is_const(n) and (p is None or _is_const(p)):
+        k = int(np.asarray(n.data)[0])
+        c = str(p.to_numpy()[0]) if p is not None else " "
+        return str_ops.map_unary(a, lambda x: _pad_one(x, k, c, left))
+    cols = [a, n] + ([_require_dict(p)] if p is not None else [])
+    return _rowwise_fallback(
+        cols, lambda x, k, c=" ": _pad_one(x, int(k), c, left))
 
 
 # ---------------------------------------------------------------------------
@@ -647,22 +766,12 @@ OPERATION_MAPPING: Dict[str, Callable] = {
     "similar": lambda a, p, e=None: _op_like(a, p, e, False, True),
     "position": _op_position,
     "overlay": _op_overlay,
-    "replace": lambda a, f, t: str_ops.map_unary(
-        _require_dict(a), lambda x: x.replace(str(f.to_numpy()[0]), str(t.to_numpy()[0]))),
-    "left": lambda a, n: str_ops.map_unary(
-        _require_dict(a), lambda x, k=int(np.asarray(n.data)[0]): x[:k] if k >= 0 else x[: max(len(x) + k, 0)]),
-    "right": lambda a, n: str_ops.map_unary(
-        _require_dict(a), lambda x, k=int(np.asarray(n.data)[0]): (x[-k:] if k > 0 else x[min(-k, len(x)):]) if k != 0 else ""),
-    "repeat_str": lambda a, n: str_ops.map_unary(
-        _require_dict(a), lambda x, k=int(np.asarray(n.data)[0]): x * max(k, 0)),
-    "lpad": lambda a, n, p=None: str_ops.map_unary(
-        _require_dict(a),
-        lambda x, k=int(np.asarray(n.data)[0]), c=(str(p.to_numpy()[0]) if p is not None else " "):
-            (c * k + x)[-k:] if len(x) < k else x[:k]),
-    "rpad": lambda a, n, p=None: str_ops.map_unary(
-        _require_dict(a),
-        lambda x, k=int(np.asarray(n.data)[0]), c=(str(p.to_numpy()[0]) if p is not None else " "):
-            (x + c * k)[:k]),
+    "replace": lambda a, f, t: _op_replace(a, f, t),
+    "left": lambda a, n: _str_num_op(a, n, _left_one),
+    "right": lambda a, n: _str_num_op(a, n, _right_one),
+    "repeat_str": lambda a, n: _str_num_op(a, n, lambda x, k: x * max(k, 0)),
+    "lpad": lambda a, n, p=None: _pad_op(a, n, p, left=True),
+    "rpad": lambda a, n, p=None: _pad_op(a, n, p, left=False),
     "ascii": lambda a: str_ops.map_unary_value(_require_dict(a),
                                                lambda x: ord(x[0]) if x else 0, np.int32),
     "chr": lambda a: _chr_op(a),

@@ -17,13 +17,18 @@ def _load_rules():
         # Order matters (parity: optimizer.rs:53-98)
         _RULES = [
             rules.SimplifyExpressions(),
+            rules.UnwrapCastInComparison(),
             rules.DecorrelateSubqueries(),
+            rules.SimplifyExpressions(),
+            rules.RewriteDisjunctivePredicate(),
             rules.EliminateCrossJoin(),
             rules.EliminateLimit(),
             rules.FilterNullJoinKeys(),
+            rules.EliminateOuterJoin(),
             rules.PushDownLimit(),
             rules.PushDownFilter(),
             rules.SimplifyExpressions(),
+            rules.UnwrapCastInComparison(),
             rules.PushDownProjection(),
             rules.PushDownLimit(),
         ]

@@ -1,18 +1,30 @@
 """Join reordering (parity: reference src/sql/optimizer/join_reorder.rs — the
 fact/dimension heuristic of "Improving Join Reordering for Large Scale
-Distributed Computing", with knobs fact_dimension_ratio / max_fact_tables /
-preserve_user_order / filter_selectivity).
+Distributed Computing").
 
-Implementation: for a chain of INNER joins, classify base tables by row count
-(from catalog statistics) into fact vs dimension tables, then re-associate so
-dimension tables (smallest first) join the fact table(s) early — shrinking
-intermediate results before the big probes.
+Algorithm (join_reorder.rs:74-188):
+- flatten a filter-free pure-INNER-join subtree into leaf relations + a set
+  of column-equality join conditions (bushy trees supported),
+- classify leaves by catalog row counts: `size/largest > fact_dimension_ratio`
+  => fact table, else dimension (unknown stats assume 100 rows),
+- bail when facts or dims are empty or #facts > `max_fact_tables`,
+- order dimensions: filtered dims (scaled by `filter_selectivity`) sorted by
+  size; unfiltered dims keep user order unless `preserve_user_order=False`
+  (then size-sorted); the two lists merge greedily smallest-first,
+- build a left-deep tree per fact table (dimension-first), join the fact
+  trees, and bail to the original plan if any condition or dimension cannot
+  be placed.
+
+Positional note: our plan uses positional ColumnRefs, so the rebuilt tree is
+wrapped in a Projection restoring the original column order.
 """
 from __future__ import annotations
 
-from typing import List, Optional, Tuple
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
 
 from .. import plan as p
+from ..expressions import ColumnRef, Expr
 
 
 def _table_rows(node, catalog) -> Optional[float]:
@@ -28,59 +40,237 @@ def _table_rows(node, catalog) -> Optional[float]:
     return None
 
 
+def _is_not_null_pred(e: Expr) -> bool:
+    from ..expressions import ScalarFunc
+
+    return isinstance(e, ScalarFunc) and e.op in ("is_not_null", "isnotnull")
+
+
+def _has_real_filter(node) -> bool:
+    """Filters beyond join-key IS NOT NULL guards (join_reorder.rs:217-238)."""
+    from .rules import _conjuncts
+
+    if isinstance(node, p.Filter):
+        if any(not _is_not_null_pred(c) for c in _conjuncts(node.predicate)):
+            return True
+        return _has_real_filter(node.inputs()[0])
+    if isinstance(node, p.TableScan):
+        return any(not _is_not_null_pred(f) for f in node.filters)
+    return any(_has_real_filter(k) for k in node.inputs())
+
+
+def _is_supported_rel(node) -> bool:
+    """Only operators whose output <= input (join_reorder.rs:240-267)."""
+    if isinstance(node, p.Join):
+        return (node.join_type == "INNER" and node.filter is None
+                and _is_supported_rel(node.left) and _is_supported_rel(node.right))
+    if isinstance(node, (p.Filter, p.SubqueryAlias)):
+        return _is_supported_rel(node.inputs()[0])
+    return isinstance(node, p.TableScan)
+
+
+@dataclass
+class _Leaf:
+    plan: object
+    start: int       # column offset in the original flattened schema
+    width: int
+    size: float
+    filtered: bool
+
+
+def _flatten(node, base: int, leaves: List[_Leaf], conds: List[Tuple[int, int]],
+             catalog) -> bool:
+    """Collect leaves (in user order) and global-position equality conds.
+    Returns False when a condition is not a plain column pair."""
+    if isinstance(node, p.Join) and node.join_type == "INNER" and node.filter is None:
+        nleft = len(node.left.schema)
+        if not _flatten(node.left, base, leaves, conds, catalog):
+            return False
+        if not _flatten(node.right, base + nleft, leaves, conds, catalog):
+            return False
+        for l, r in node.on:
+            if not isinstance(l, ColumnRef) or not isinstance(r, ColumnRef):
+                return False
+            conds.append((base + l.index, base + r.index))
+        return True
+    size = _table_rows(node, catalog)
+    leaves.append(_Leaf(node, base, len(node.schema),
+                        100.0 if size is None else float(size),
+                        _has_real_filter(node)))
+    return True
+
+
 def maybe_reorder(plan, config, catalog):
-    """Greedy smallest-first reordering of pure inner-join chains.
-
-    Only fires when every statistic is known and user order preservation is
-    off or a clear fact/dimension split exists (ratio knob) — conservative,
-    like the reference (inner joins only, join_reorder.rs:60).
-    """
-    preserve = bool(config.get("sql.optimizer.preserve_user_order", True))
     ratio = float(config.get("sql.optimizer.fact_dimension_ratio", 0.7))
+    max_facts = int(config.get("sql.optimizer.max_fact_tables", 2))
+    preserve = bool(config.get("sql.optimizer.preserve_user_order", True))
+    selectivity = float(config.get("sql.optimizer.filter_selectivity", 1.0))
 
-    def go(node):
-        kids = [go(k) for k in node.inputs()]
+    def go(node, parent_is_chain: bool):
+        is_chain_head = (isinstance(node, p.Join) and node.join_type == "INNER"
+                         and node.filter is None and not parent_is_chain)
+        in_chain = (isinstance(node, p.Join) and node.join_type == "INNER"
+                    and node.filter is None)
+        kids = [go(k, in_chain) for k in node.inputs()]
         node = node.with_inputs(kids) if kids else node
-        if not isinstance(node, p.Join) or node.join_type != "INNER":
-            return node
-        if preserve:
-            # honour user order unless a dimension table is on the probe side:
-            # put the smaller input on the build (right) side of our
-            # sort+searchsorted kernel when stats clearly say so
-            lrows = _table_rows(node.left, catalog)
-            rrows = _table_rows(node.right, catalog)
-            if lrows is not None and rrows is not None and rrows > lrows / max(ratio, 1e-9):
-                # right side is big and left is small: swap so we probe from
-                # the big side and build on the small one
-                swapped = _swap_join(node)
-                if swapped is not None:
-                    return swapped
-            return node
+        if is_chain_head:
+            new = _reorder_chain(node, ratio, max_facts, preserve, selectivity,
+                                 catalog)
+            if new is not None:
+                return new
         return node
 
-    return go(plan)
+    return go(plan, False)
 
 
-def _swap_join(join: p.Join) -> Optional[p.Join]:
-    from ..expressions import shift_columns, ColumnRef, remap_columns
-
-    nleft = len(join.left.schema)
-    nright = len(join.right.schema)
-    if join.join_type != "INNER":
+def _reorder_chain(join, ratio, max_facts, preserve, selectivity, catalog):
+    if not _is_supported_rel(join):
         return None
-    # new combined index mapping: right block first
-    mapping = {}
-    for i in range(nleft):
-        mapping[i] = nright + i
-    for j in range(nright):
-        mapping[nleft + j] = j
-    on = [(remap_columns(r, mapping), remap_columns(l, mapping)) for l, r in join.on]
-    filt = remap_columns(join.filter, mapping) if join.filter is not None else None
-    fields = list(join.right.schema) + list(join.left.schema)
-    inner = p.Join(join.right, join.left, "INNER", on, filt, fields)
-    # restore the original output order with a projection
+    leaves: List[_Leaf] = []
+    conds: List[Tuple[int, int]] = []
+    if not _flatten(join, 0, leaves, conds, catalog):
+        return None
+    if len(leaves) < 3:
+        return None  # nothing to reorder; the executor picks the build side
+
+    largest = max(l.size for l in leaves)
+    facts = [i for i, l in enumerate(leaves) if l.size / max(largest, 1e-9) > ratio]
+    dims = [i for i, l in enumerate(leaves) if i not in facts]
+    if not facts or not dims or len(facts) > max_facts:
+        return None
+
+    # order the dimensions (join_reorder.rs:122-167)
+    unfiltered = [i for i in dims if not leaves[i].filtered]
+    if not preserve:
+        unfiltered.sort(key=lambda i: leaves[i].size)
+    filtered = sorted((i for i in dims if leaves[i].filtered),
+                      key=lambda i: leaves[i].size * selectivity)
+    ordered: List[int] = []
+    fi = ui = 0
+    while fi < len(filtered) or ui < len(unfiltered):
+        if fi < len(filtered) and (
+                ui >= len(unfiltered)
+                or leaves[filtered[fi]].size * selectivity
+                < leaves[unfiltered[ui]].size):
+            ordered.append(filtered[fi]); fi += 1
+        else:
+            ordered.append(unfiltered[ui]); ui += 1
+
+    # global position -> (leaf index, offset)
+    pos_to_leaf: Dict[int, Tuple[int, int]] = {}
+    for li, leaf in enumerate(leaves):
+        for off in range(leaf.width):
+            pos_to_leaf[leaf.start + off] = (li, off)
+    remaining = [(pos_to_leaf[a], pos_to_leaf[b]) for a, b in conds]
+
+    builder = _TreeBuilder(leaves, remaining)
+    unused = list(ordered)
+    trees = []
+    for f in facts:
+        builder.start(f)
+        # two passes so snowflake dims can attach through other dims
+        for _ in range(2):
+            still = []
+            for d in unused:
+                if not builder.try_join(d):
+                    still.append(d)
+            unused = still
+            if not unused:
+                break
+        trees.append(builder.finish())
+    if unused:
+        return None
+    tree = trees[0]
+    for t in trees[1:]:
+        tree = builder.join_trees(tree, t)
+        if tree is None:
+            return None
+    if builder.remaining:
+        return None  # a condition could not be placed; keep the user plan
+
+    # restore the original column order
+    new_pos: Dict[Tuple[int, int], int] = {}
+    off = 0
+    for li in tree.leaf_order:
+        for o in range(leaves[li].width):
+            new_pos[(li, o)] = off + o
+        off += leaves[li].width
     exprs = []
     out_fields = list(join.schema)
     for i, f in enumerate(out_fields):
-        exprs.append(ColumnRef(mapping[i], f.name, f.sql_type, f.nullable))
-    return p.Projection(inner, exprs, out_fields)
+        exprs.append(ColumnRef(new_pos[pos_to_leaf[i]], f.name, f.sql_type,
+                               f.nullable))
+    return p.Projection(tree.plan, exprs, out_fields)
+
+
+class _Tree:
+    def __init__(self, plan, leaf_order: List[int]):
+        self.plan = plan
+        self.leaf_order = leaf_order
+
+
+class _TreeBuilder:
+    def __init__(self, leaves: List[_Leaf], conds):
+        self.leaves = leaves
+        self.remaining = list(conds)  # [((leaf, off), (leaf, off))]
+        self._cur: Optional[_Tree] = None
+
+    # -- helpers ------------------------------------------------------------
+    def _offset_of(self, tree: _Tree, leaf_idx: int) -> int:
+        off = 0
+        for li in tree.leaf_order:
+            if li == leaf_idx:
+                return off
+            off += self.leaves[li].width
+        raise KeyError(leaf_idx)
+
+    def _conds_between(self, in_tree, leaf_set):
+        found, rest = [], []
+        for (la, oa), (lb, ob) in self.remaining:
+            if la in in_tree and lb in leaf_set:
+                found.append(((la, oa), (lb, ob)))
+            elif lb in in_tree and la in leaf_set:
+                found.append(((lb, ob), (la, oa)))
+            else:
+                rest.append(((la, oa), (lb, ob)))
+        return found, rest
+
+    def _make_join(self, tree: _Tree, other: _Tree, pairs) -> _Tree:
+        lwidth = sum(self.leaves[li].width for li in tree.leaf_order)
+        on = []
+        for (ll, lo), (rl, ro) in pairs:
+            lf = self.leaves[ll].plan.schema[lo]
+            rf = self.leaves[rl].plan.schema[ro]
+            lpos = self._offset_of(tree, ll) + lo
+            rpos = lwidth + self._offset_of(other, rl) + ro
+            on.append((ColumnRef(lpos, lf.name, lf.sql_type, lf.nullable),
+                       ColumnRef(rpos, rf.name, rf.sql_type, rf.nullable)))
+        fields = list(tree.plan.schema) + list(other.plan.schema)
+        plan = p.Join(tree.plan, other.plan, "INNER", on, None, fields)
+        return _Tree(plan, tree.leaf_order + other.leaf_order)
+
+    # -- build API ----------------------------------------------------------
+    def start(self, leaf_idx: int):
+        self._cur = _Tree(self.leaves[leaf_idx].plan, [leaf_idx])
+
+    def try_join(self, leaf_idx: int) -> bool:
+        tree = self._cur
+        pairs, rest = self._conds_between(set(tree.leaf_order), {leaf_idx})
+        if not pairs:
+            return False
+        self.remaining = rest
+        self._cur = self._make_join(tree, _Tree(self.leaves[leaf_idx].plan,
+                                                [leaf_idx]), pairs)
+        return True
+
+    def finish(self) -> _Tree:
+        t = self._cur
+        self._cur = None
+        return t
+
+    def join_trees(self, a: _Tree, b: _Tree) -> Optional[_Tree]:
+        pairs, rest = self._conds_between(set(a.leaf_order), set(b.leaf_order))
+        if not pairs:
+            return None
+        self.remaining = rest
+        return self._make_join(a, b, pairs)

@@ -689,28 +689,46 @@ class DecorrelateSubqueries(Rule):
                       + [Field(f"__scagg{j}", a.sql_type, True)
                          for j, a in enumerate(agg.agg_exprs)])
         agg2 = p.Aggregate(core, key_exprs, list(agg.agg_exprs), agg_fields)
-        # the subquery's projection referenced agg outputs at 0..; shift by ngroups
-        proj_expr = remap_columns(node.exprs[0],
-                                  {j: ngroups + j for j in range(len(agg.agg_exprs))})
-        sub_fields = ([Field("__scval", proj_expr.sql_type, True)]
+        # join the RAW aggregates (not the projected expression): the
+        # subquery's projection is re-evaluated post-join, where COUNT-like
+        # refs get COALESCE(.., 0) — their empty-input value — so unmatched
+        # outer rows see COUNT()=0 even inside larger expressions
+        # (DataFusion's ScalarSubqueryToJoin behaves the same way).
+        naggs = len(agg.agg_exprs)
+        sub_fields = ([Field(f"__scagg{j}", a.sql_type, True)
+                       for j, a in enumerate(agg.agg_exprs)]
                       + [Field(f"__sckey{i}", e.sql_type, True)
                          for i, e in enumerate(key_exprs)])
-        sub_exprs = [proj_expr] + [
-            ColumnRef(i, f"__sckey{i}", key_exprs[i].sql_type, True)
-            for i in range(ngroups)]
+        sub_exprs = ([ColumnRef(ngroups + j, f"__scagg{j}", a.sql_type, True)
+                      for j, a in enumerate(agg.agg_exprs)]
+                     + [ColumnRef(i, f"__sckey{i}", key_exprs[i].sql_type, True)
+                        for i in range(ngroups)])
         sub = p.Projection(agg2, sub_exprs, sub_fields)
         nleft = len(child.schema)
         on = [(_outer_to_local(outer),
-               ColumnRef(nleft + 1 + i, f"__sckey{i}", key_exprs[i].sql_type, True))
+               ColumnRef(nleft + naggs + i, f"__sckey{i}",
+                         key_exprs[i].sql_type, True))
               for i, (outer, _) in enumerate(pairs)]
         join_fields = list(child.schema) + sub_fields
         join = p.Join(child, sub, "LEFT", on, None, join_fields)
-        # replace the scalar subquery with a reference to the joined value
-        val_ref = ColumnRef(nleft, "__scval", sq.sql_type, True)
+        count_like = {"count", "count_star", "regr_count"}
+
+        def remap_agg_ref(x):
+            if isinstance(x, ColumnRef):
+                j = x.index
+                a = agg.agg_exprs[j]
+                ref: Expr = ColumnRef(nleft + j, f"__scagg{j}", a.sql_type, True)
+                if a.func in count_like:
+                    return ScalarFunc("coalesce",
+                                      (ref, Literal(0, a.sql_type)), a.sql_type)
+                return ref
+            return x
+
+        val_expr = transform(node.exprs[0], remap_agg_ref)
 
         def fn(x):
             if x is sq or x == sq:
-                return val_ref
+                return val_expr
             return x
 
         new_conjunct = transform(conjunct, fn)
@@ -881,3 +899,229 @@ def _all_exprs_below(plan) -> List[Expr]:
     for node in p.walk_plan(plan):
         out.extend(_node_exprs(node))
     return out
+
+
+# ---------------------------------------------------------------------------
+# UnwrapCastInComparison (parity: DataFusion rule in the reference pipeline,
+# optimizer.rs:56,88): CAST(col) <op> literal  ->  col <op> literal-in-col-type
+# when the literal round-trips losslessly.  Unwrapped comparisons become
+# pushdown-eligible (plain column refs reach the TableScan DNF filters).
+# ---------------------------------------------------------------------------
+_COMPARISONS = {"eq", "ne", "lt", "le", "gt", "ge"}
+_FLIP = {"lt": "gt", "le": "ge", "gt": "lt", "ge": "le", "eq": "eq", "ne": "ne"}
+
+#: integer widths for the injectivity check
+_INT_RANK = {SqlType.TINYINT: 8, SqlType.SMALLINT: 16, SqlType.INTEGER: 32,
+             SqlType.BIGINT: 64}
+_INT_RANGE = {SqlType.TINYINT: (-2**7, 2**7 - 1),
+              SqlType.SMALLINT: (-2**15, 2**15 - 1),
+              SqlType.INTEGER: (-2**31, 2**31 - 1),
+              SqlType.BIGINT: (-2**63, 2**63 - 1)}
+
+
+def _cast_is_injective_monotone(src: SqlType, dst: SqlType) -> bool:
+    """True only for value-preserving widenings, where
+    `CAST(col AS dst) <op> lit`  <=>  `col <op> downcast(lit)` for every col.
+    Truncating casts (TIMESTAMP->DATE, DOUBLE->INT, any ->VARCHAR) must NOT
+    be unwrapped: they map many column values onto one compared value."""
+    if src in _INT_RANK and dst in _INT_RANK:
+        return _INT_RANK[src] <= _INT_RANK[dst]
+    if src in _INT_RANK and dst == SqlType.DOUBLE:
+        return _INT_RANK[src] <= 32  # float64 mantissa covers int32
+    if src in _INT_RANK and dst == SqlType.FLOAT:
+        return _INT_RANK[src] <= 16  # float32 mantissa covers int16
+    if src == SqlType.FLOAT and dst == SqlType.DOUBLE:
+        return True
+    if src == SqlType.DATE and dst == SqlType.TIMESTAMP:
+        return True
+    return False
+
+
+def _try_unwrap_cast(op: str, cast: Cast, lit: Literal):
+    from ..binder import _cast_literal
+
+    if lit.value is None:
+        return None
+    src_type = cast.arg.sql_type
+    if not _cast_is_injective_monotone(src_type, cast.sql_type):
+        return None
+    try:
+        down = _cast_literal(Literal(lit.value, lit.sql_type), src_type)
+        back = _cast_literal(Literal(down.value, src_type), lit.sql_type)
+    except Exception:
+        return None
+    if back.value != lit.value:
+        return None  # lossy literal: e.g. 3.5 compared against an INT column
+    if src_type in _INT_RANGE:
+        lo, hi = _INT_RANGE[src_type]
+        try:
+            if not (lo <= int(down.value) <= hi):
+                return None  # literal overflows the column type
+        except (TypeError, ValueError):
+            return None
+    return ScalarFunc(op, (cast.arg, Literal(down.value, src_type)),
+                      SqlType.BOOLEAN)
+
+
+def _unwrap_cast_expr(e: Expr) -> Expr:
+    def fn(x: Expr) -> Expr:
+        if isinstance(x, ScalarFunc) and x.op in _COMPARISONS and len(x.args) == 2:
+            a, b = x.args
+            if isinstance(a, Cast) and isinstance(b, Literal):
+                out = _try_unwrap_cast(x.op, a, b)
+                if out is not None:
+                    return out
+            if isinstance(b, Cast) and isinstance(a, Literal):
+                out = _try_unwrap_cast(_FLIP[x.op], b, a)
+                if out is not None:
+                    # keep operand order: literal <op> col == col <flip op> lit
+                    return out
+        return x
+
+    return transform(e, fn)
+
+
+class UnwrapCastInComparison(Rule):
+    def apply(self, plan, config, catalog):
+        def go(node):
+            node = _rewrite_children(node, go)
+            return _map_node_exprs(node, _unwrap_cast_expr)
+
+        return go(plan)
+
+
+# ---------------------------------------------------------------------------
+# RewriteDisjunctivePredicate (parity: DataFusion rule, optimizer.rs:63):
+# (a AND b) OR (a AND c)  ->  a AND (b OR c) — exposes `a` to pushdown.
+# ---------------------------------------------------------------------------
+def _disjuncts(e: Expr) -> List[Expr]:
+    if isinstance(e, ScalarFunc) and e.op == "or":
+        out: List[Expr] = []
+        for a in e.args:
+            out.extend(_disjuncts(a))
+        return out
+    return [e]
+
+
+def _disjoin(parts: List[Expr]) -> Expr:
+    out = parts[0]
+    for x in parts[1:]:
+        out = ScalarFunc("or", (out, x), SqlType.BOOLEAN)
+    return out
+
+
+def _rewrite_disjunction(e: Expr) -> Expr:
+    def fn(x: Expr) -> Expr:
+        if not (isinstance(x, ScalarFunc) and x.op == "or"):
+            return x
+        branches = [_conjuncts(d) for d in _disjuncts(x)]
+        if len(branches) < 2:
+            return x
+        common = [c for c in branches[0]
+                  if all(any(c == c2 for c2 in b) for b in branches[1:])]
+        if not common:
+            return x
+        residuals = []
+        for b in branches:
+            rem = [c for c in b if not any(c == cm for cm in common)]
+            residuals.append(rem)
+        if any(not rem for rem in residuals):
+            # one branch is exactly the common part: OR collapses to it
+            return _conjoin(common)
+        parts = common + [_disjoin([_conjoin(rem) for rem in residuals])]
+        return _conjoin(parts)
+
+    return transform(e, fn)
+
+
+class RewriteDisjunctivePredicate(Rule):
+    def apply(self, plan, config, catalog):
+        def go(node):
+            node = _rewrite_children(node, go)
+            if isinstance(node, p.Filter):
+                return p.Filter(node.input, _rewrite_disjunction(node.predicate),
+                                node.schema)
+            return node
+
+        return go(plan)
+
+
+# ---------------------------------------------------------------------------
+# EliminateOuterJoin (parity: DataFusion rule, optimizer.rs:70): a filter
+# above an outer join that rejects NULLs of the padded side turns the join
+# INNER (feeding JoinReorder, which handles inner joins only).
+# ---------------------------------------------------------------------------
+_NULL_PROP_OPS = _COMPARISONS | {
+    "add", "sub", "mul", "div", "mod", "neg", "not", "like", "ilike",
+    "similar", "between",
+}
+
+
+def _strong(e: Expr) -> bool:
+    """NULL-propagating: any NULL input makes the result NULL."""
+    if isinstance(e, (ColumnRef, Literal)):
+        return True
+    if isinstance(e, Cast):
+        return _strong(e.arg)
+    if isinstance(e, ScalarFunc) and e.op in _NULL_PROP_OPS:
+        return all(_strong(a) for a in e.args)
+    return False
+
+
+def _refs_in_range(e: Expr, lo: int, hi: int) -> bool:
+    return any(isinstance(x, ColumnRef) and lo <= x.index < hi for x in walk(e))
+
+
+def _rejects_nulls(e: Expr, lo: int, hi: int) -> bool:
+    """True when `e` cannot evaluate to TRUE if all columns in [lo, hi)
+    are NULL (so the filter drops the outer join's padded rows)."""
+    if isinstance(e, ScalarFunc):
+        if e.op == "and":
+            return any(_rejects_nulls(a, lo, hi) for a in e.args)
+        if e.op == "or":
+            return all(_rejects_nulls(a, lo, hi) for a in e.args)
+        if e.op in ("is_not_null", "isnotnull"):
+            return _strong(e.args[0]) and _refs_in_range(e.args[0], lo, hi)
+        if e.op in _NULL_PROP_OPS:
+            return (all(_strong(a) for a in e.args)
+                    and _refs_in_range(e, lo, hi))
+    return False
+
+
+class EliminateOuterJoin(Rule):
+    def apply(self, plan, config, catalog):
+        def go(node):
+            node = _rewrite_children(node, go)
+            if not (isinstance(node, p.Filter) and isinstance(node.input, p.Join)):
+                return node
+            join = node.input
+            if join.join_type not in ("LEFT", "RIGHT", "FULL"):
+                return node
+            nleft = len(join.left.schema)
+            total = len(join.schema)
+            rej_left = rej_right = False
+            for c in _conjuncts(node.predicate):
+                rej_left = rej_left or _rejects_nulls(c, 0, nleft)
+                rej_right = rej_right or _rejects_nulls(c, nleft, total)
+            jt = join.join_type
+            new_jt = None
+            if jt == "LEFT" and rej_right:
+                new_jt = "INNER"
+            elif jt == "RIGHT" and rej_left:
+                new_jt = "INNER"
+            elif jt == "FULL":
+                # rej_left drops the rows whose LEFT side is padded — the
+                # unmatched-right rows — leaving a LEFT join (and vice versa)
+                if rej_left and rej_right:
+                    new_jt = "INNER"
+                elif rej_left:
+                    new_jt = "LEFT"
+                elif rej_right:
+                    new_jt = "RIGHT"
+            if new_jt is None:
+                return node
+            new_join = p.Join(join.left, join.right, new_jt, join.on,
+                              join.filter, join.schema)
+            return p.Filter(new_join, node.predicate, node.schema)
+
+        return go(plan)

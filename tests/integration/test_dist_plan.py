@@ -1,0 +1,212 @@
+"""Collectives-routed distributed execution: mechanism-pinning tests.
+
+Round-1 asserted result equality only; a silent full-gather would have
+passed.  These tests pin the mechanism itself:
+- the compiled kernels contain `all-to-all` collectives,
+- per-device output shards are ~1/ndev of the global shape,
+- sharded-table SQL actually routes through the kernels (STATS counters),
+- results match pandas for the full aggregate set, multi-key, and NULLs.
+"""
+import numpy as np
+import pandas as pd
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tests.utils import assert_eq
+
+needs_mesh = pytest.mark.skipif(len(jax.devices()) < 2, reason="needs mesh")
+
+
+@pytest.fixture
+def mesh():
+    from dask_sql_tpu.parallel.mesh import make_mesh
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs mesh")
+    return make_mesh(len(jax.devices()))
+
+
+# ---------------------------------------------------------------------------
+# mechanism: explicit collectives in the compiled HLO
+# ---------------------------------------------------------------------------
+@needs_mesh
+def test_agg_kernel_hlo_has_all_to_all(mesh):
+    from dask_sql_tpu.parallel import dist_plan as dp
+
+    ndev = mesh.devices.size
+    fn = dp.get_agg_kernel(mesh, nk=1, nv=1, capacity=1024, cpeer=2048)
+    n = 128 * ndev
+    args = (
+        jnp.zeros((1, n), jnp.int64), jnp.zeros((1, n), jnp.int64),
+        jnp.zeros((1, n), jnp.float64), jnp.ones((1, n), bool),
+        jnp.ones((n,), bool),
+    )
+    hlo = fn.lower(*args).compile().as_text()
+    assert "all-to-all" in hlo, "aggregate kernel must shuffle via all_to_all"
+    assert "all-gather" not in hlo, "no implicit full gather in the agg kernel"
+
+
+@needs_mesh
+def test_join_kernel_hlo_has_all_to_all(mesh):
+    from dask_sql_tpu.parallel import dist_plan as dp
+
+    ndev = mesh.devices.size
+    fn = dp.get_join_kernel(mesh, cpeer=2048, out_cap=2048)
+    n = 128 * ndev
+    a = jnp.zeros((n,), jnp.int64)
+    b = jnp.ones((n,), bool)
+    hlo = fn.lower(a, a, b, a, a, b).compile().as_text()
+    assert "all-to-all" in hlo
+    assert "all-gather" not in hlo
+
+
+@needs_mesh
+def test_agg_kernel_output_is_sharded(mesh):
+    """Per-device outputs are [1/ndev] shards: no device holds the world."""
+    from dask_sql_tpu.parallel import dist_plan as dp
+
+    ndev = mesh.devices.size
+    cap = 1024
+    fn = dp.get_agg_kernel(mesh, nk=1, nv=1, capacity=cap, cpeer=2048)
+    n = 128 * ndev
+    rng = np.random.RandomState(0)
+    keys = jnp.asarray(rng.randint(0, 64, n).astype(np.int64))[None]
+    vals = jnp.asarray(rng.rand(n))[None]
+    out = fn(keys, keys, vals, jnp.ones((1, n), bool), jnp.ones((n,), bool))
+    fk = out[0]
+    assert fk.shape == (ndev, 1, cap)
+    for shard in fk.addressable_shards:
+        assert shard.data.shape == (1, 1, cap)  # 1/ndev of the global rows
+
+
+# ---------------------------------------------------------------------------
+# mechanism: SQL routes through the kernels
+# ---------------------------------------------------------------------------
+@pytest.fixture
+def dist_ctx():
+    from dask_sql_tpu import Context
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs mesh")
+    rng = np.random.RandomState(3)
+    n = 4000
+    df = pd.DataFrame({
+        "g": rng.choice(["a", "b", "c", None], n),
+        "k": rng.randint(0, 150, n).astype(np.int64),
+        "h": rng.randint(0, 8, n).astype(np.int64),
+        "x": rng.randint(-50, 50, n).astype(np.int64),
+        "y": rng.rand(n) * 100,
+    })
+    df.loc[rng.rand(n) < 0.1, "x"] = None
+    dim = pd.DataFrame({
+        "k": np.arange(0, 180, dtype=np.int64),
+        "w": rng.rand(180),
+        "lbl": [f"l{i % 7}" for i in range(180)],
+    })
+    c = Context()
+    c.create_table("big", df, distributed=True)
+    c.create_table("dim", dim, distributed=True)
+    return c, df, dim
+
+
+@needs_mesh
+def test_sql_groupby_routes_through_agg_kernel(dist_ctx):
+    from dask_sql_tpu.parallel import dist_plan as dp
+
+    c, df, _ = dist_ctx
+    before = dp.STATS["agg_kernel"]
+    result = c.sql(
+        "SELECT g, h, COUNT(*) AS n, SUM(x) AS sx, AVG(y) AS ay, "
+        "MIN(y) AS mny, MAX(x) AS mxx, STDDEV(y) AS sy "
+        "FROM big GROUP BY g, h").compute()
+    assert dp.STATS["agg_kernel"] > before, "sharded groupby must use the kernel"
+    expected = (df.groupby(["g", "h"], dropna=False)
+                .agg(n=("x", "size"), sx=("x", "sum"), ay=("y", "mean"),
+                     mny=("y", "min"), mxx=("x", "max"), sy=("y", "std"))
+                .reset_index())
+    assert_eq(result, expected, check_dtype=False, sort_results=True)
+
+
+@needs_mesh
+def test_sql_join_routes_through_join_kernel(dist_ctx):
+    from dask_sql_tpu.parallel import dist_plan as dp
+
+    c, df, dim = dist_ctx
+    before = dp.STATS["join_kernel"]
+    result = c.sql(
+        "SELECT big.k, big.y, dim.w FROM big JOIN dim ON big.k = dim.k "
+        "WHERE big.y > 50").compute()
+    assert dp.STATS["join_kernel"] > before, "sharded join must use the kernel"
+    m = df[df.y > 50].merge(dim, on="k")
+    expected = m[["k", "y", "w"]]
+    assert_eq(result, expected, check_dtype=False, sort_results=True)
+
+
+@needs_mesh
+def test_sql_left_join_distributed(dist_ctx):
+    c, df, dim = dist_ctx
+    result = c.sql(
+        "SELECT dim.k, big.x FROM dim LEFT JOIN big ON dim.k = big.k").compute()
+    expected = dim.merge(df, on="k", how="left")[["k", "x"]]
+    assert_eq(result, expected, check_dtype=False, sort_results=True)
+
+
+@needs_mesh
+def test_sql_semi_anti_distributed(dist_ctx):
+    c, df, dim = dist_ctx
+    result = c.sql(
+        "SELECT k FROM dim WHERE EXISTS (SELECT 1 FROM big WHERE big.k = dim.k)"
+    ).compute()
+    expected = dim[dim.k.isin(df.k)][["k"]]
+    assert_eq(result, expected, check_dtype=False, sort_results=True)
+    result2 = c.sql(
+        "SELECT k FROM dim WHERE NOT EXISTS (SELECT 1 FROM big WHERE big.k = dim.k)"
+    ).compute()
+    expected2 = dim[~dim.k.isin(df.k)][["k"]]
+    assert_eq(result2, expected2, check_dtype=False, sort_results=True)
+
+
+@needs_mesh
+def test_broadcast_knob_skips_shuffle(dist_ctx):
+    """sql.join.broadcast=True keeps the replicated small side un-shuffled."""
+    from dask_sql_tpu.parallel import dist_plan as dp
+
+    c, df, dim = dist_ctx
+    before = dp.STATS["join_kernel"]
+    result = c.sql(
+        "SELECT big.k, dim.w FROM big JOIN dim ON big.k = dim.k",
+        config_options={"sql.join.broadcast": True}).compute()
+    assert dp.STATS["join_kernel"] == before, "broadcast join must not shuffle"
+    expected = df.merge(dim, on="k")[["k", "w"]]
+    assert_eq(result, expected, check_dtype=False, sort_results=True)
+
+
+@needs_mesh
+def test_distinct_count_falls_back_correctly(dist_ctx):
+    """Non-decomposable aggregates fall back but stay correct."""
+    c, df, _ = dist_ctx
+    result = c.sql(
+        "SELECT g, COUNT(DISTINCT h) AS dh FROM big GROUP BY g").compute()
+    expected = (df.groupby("g", dropna=False).h.nunique()
+                .reset_index().rename(columns={"h": "dh"}))
+    assert_eq(result, expected, check_dtype=False, sort_results=True)
+
+
+# ---------------------------------------------------------------------------
+# kernel-level: capacity ladder + negative/NULL keys
+# ---------------------------------------------------------------------------
+@needs_mesh
+def test_dist_pairs_capacity_retry(mesh):
+    """Skewed keys overflow the first capacity rung; the ladder retries."""
+    from dask_sql_tpu.parallel import dist_plan as dp
+
+    rng = np.random.RandomState(1)
+    n = 6000
+    lg = jnp.asarray(np.zeros(n, dtype=np.int64))  # all one key: max skew
+    rg = jnp.asarray(np.zeros(20, dtype=np.int64))
+    ones_l = jnp.ones(n, bool)
+    li, ri, lm = dp.dist_inner_pairs(mesh, lg, ones_l, rg, jnp.ones(20, bool))
+    assert int(li.shape[0]) == n * 20
+    assert lm.all()

@@ -152,9 +152,13 @@ def test_join_jit_probe_mode(c, user_table_1, user_table_2, monkeypatch):
                         lambda *a: calls.append(1) or orig(*a))
     q = ("SELECT lhs.user_id, lhs.b, rhs.c FROM user_table_1 AS lhs "
          "JOIN user_table_2 AS rhs ON lhs.user_id = rhs.user_id")
-    ref = c.sql(q, config_options={"sql.compile.join": "off"}).compute()
+    # pin the single-program path: in distributed-tests mode the collectives
+    # kernel (dist_plan) would otherwise take the join, bypassing this probe
+    ref = c.sql(q, config_options={"sql.compile.join": "off",
+                                   "sql.distributed.join": "off"}).compute()
     assert not calls
-    jit = c.sql(q, config_options={"sql.compile.join": "jit"}).compute()
+    jit = c.sql(q, config_options={"sql.compile.join": "jit",
+                                   "sql.distributed.join": "off"}).compute()
     assert calls  # the jitted phase really ran
     assert_eq(jit.sort_values(list(jit.columns)).reset_index(drop=True),
               ref.sort_values(list(ref.columns)).reset_index(drop=True),

@@ -1,0 +1,221 @@
+"""Optimizer rules added in round 2: UnwrapCastInComparison,
+RewriteDisjunctivePredicate, EliminateOuterJoin, and the full
+fact/dimension JoinReorder (parity: reference optimizer.rs:53-98 +
+join_reorder.rs)."""
+import numpy as np
+import pandas as pd
+import pytest
+
+from dask_sql_tpu import Context
+
+
+@pytest.fixture
+def c3():
+    """A fact table and two dimension tables with clear statistics."""
+    rng = np.random.RandomState(0)
+    n = 1000
+    fact = pd.DataFrame({
+        "fk1": rng.randint(0, 20, n).astype(np.int64),
+        "fk2": rng.randint(0, 10, n).astype(np.int64),
+        "v": rng.rand(n),
+    })
+    dim1 = pd.DataFrame({"k1": np.arange(20, dtype=np.int64),
+                         "a": rng.rand(20)})
+    dim2 = pd.DataFrame({"k2": np.arange(10, dtype=np.int64),
+                         "b": rng.rand(10)})
+    c = Context()
+    c.create_table("fact", fact)
+    c.create_table("dim1", dim1)
+    c.create_table("dim2", dim2)
+    return c, fact, dim1, dim2
+
+
+# ---------------------------------------------------------------------------
+# UnwrapCastInComparison
+# ---------------------------------------------------------------------------
+def test_unwrap_cast_in_comparison_plan(c3):
+    c, fact, _, _ = c3
+    plan = c.explain("SELECT v FROM fact WHERE CAST(fk1 AS BIGINT) > 5")
+    assert "cast" not in plan.lower(), plan
+    r = c.sql("SELECT v FROM fact WHERE CAST(fk1 AS BIGINT) > 5",
+              return_futures=False)
+    assert len(r) == int((fact.fk1 > 5).sum())
+
+
+def test_unwrap_cast_lossy_literal_stays_correct(c3):
+    c, fact, _, _ = c3
+    # 5.5 does not round-trip to an integer: the cast must NOT be unwrapped
+    r = c.sql("SELECT v FROM fact WHERE CAST(fk1 AS DOUBLE) > 5.5",
+              return_futures=False)
+    assert len(r) == int((fact.fk1 > 5.5).sum())
+
+
+def test_unwrap_cast_literal_on_left(c3):
+    c, fact, _, _ = c3
+    r = c.sql("SELECT v FROM fact WHERE 5 < CAST(fk1 AS BIGINT)",
+              return_futures=False)
+    assert len(r) == int((fact.fk1 > 5).sum())
+
+
+# ---------------------------------------------------------------------------
+# RewriteDisjunctivePredicate
+# ---------------------------------------------------------------------------
+def test_rewrite_disjunctive_predicate_unit():
+    from dask_sql_tpu.columnar.dtypes import SqlType
+    from dask_sql_tpu.planner.expressions import ColumnRef, Literal, ScalarFunc
+    from dask_sql_tpu.planner.optimizer.rules import _rewrite_disjunction
+
+    a = ScalarFunc("eq", (ColumnRef(0, "a", SqlType.BIGINT, False),
+                          Literal(1, SqlType.BIGINT)), SqlType.BOOLEAN)
+    b = ScalarFunc("eq", (ColumnRef(1, "b", SqlType.BIGINT, False),
+                          Literal(2, SqlType.BIGINT)), SqlType.BOOLEAN)
+    d = ScalarFunc("eq", (ColumnRef(2, "d", SqlType.BIGINT, False),
+                          Literal(3, SqlType.BIGINT)), SqlType.BOOLEAN)
+    left = ScalarFunc("and", (a, b), SqlType.BOOLEAN)
+    right = ScalarFunc("and", (a, d), SqlType.BOOLEAN)
+    e = ScalarFunc("or", (left, right), SqlType.BOOLEAN)
+    out = _rewrite_disjunction(e)
+    # expect: a AND (b OR d)
+    assert isinstance(out, ScalarFunc) and out.op == "and"
+    assert a in out.args
+    # collapse case: (a AND b) OR a  ->  a
+    e2 = ScalarFunc("or", (left, a), SqlType.BOOLEAN)
+    assert _rewrite_disjunction(e2) == a
+
+
+def test_rewrite_disjunctive_results(c3):
+    c, fact, _, _ = c3
+    q = ("SELECT v FROM fact WHERE (fk1 = 3 AND fk2 = 1) "
+         "OR (fk1 = 3 AND fk2 = 4)")
+    r = c.sql(q, return_futures=False)
+    exp = fact[(fact.fk1 == 3) & fact.fk2.isin([1, 4])]
+    assert len(r) == len(exp)
+
+
+# ---------------------------------------------------------------------------
+# EliminateOuterJoin
+# ---------------------------------------------------------------------------
+def test_eliminate_outer_join_plan(c3):
+    c, *_ = c3
+    plan = c.explain(
+        "SELECT fact.v, dim1.a FROM fact LEFT JOIN dim1 ON fact.fk1 = dim1.k1 "
+        "WHERE dim1.a > 0.5")
+    assert "Join(INNER)" in plan, plan
+    plan2 = c.explain(
+        "SELECT fact.v, dim1.a FROM fact LEFT JOIN dim1 ON fact.fk1 = dim1.k1 "
+        "WHERE dim1.a IS NULL")
+    assert "Join(LEFT)" in plan2, plan2  # IS NULL keeps padded rows
+
+
+def test_eliminate_outer_join_results(c3):
+    c, fact, dim1, _ = c3
+    r = c.sql(
+        "SELECT fact.v, dim1.a FROM fact LEFT JOIN dim1 ON fact.fk1 = dim1.k1 "
+        "WHERE dim1.a > 0.5", return_futures=False)
+    m = fact.merge(dim1, left_on="fk1", right_on="k1", how="left")
+    assert len(r) == int((m.a > 0.5).sum())
+
+
+def test_full_join_becomes_left(c3):
+    c, *_ = c3
+    plan = c.explain(
+        "SELECT fact.v, dim1.a FROM fact FULL JOIN dim1 ON fact.fk1 = dim1.k1 "
+        "WHERE fact.v >= 0")
+    assert "Join(LEFT)" in plan, plan
+
+
+# ---------------------------------------------------------------------------
+# JoinReorder
+# ---------------------------------------------------------------------------
+def _join_order(plan_str):
+    """Table names in scan order within the explain text."""
+    import re
+
+    return re.findall(r"TableScan: root\.(\w+)", plan_str)
+
+
+def test_join_reorder_dimension_first(c3):
+    c, *_ = c3
+    q = ("SELECT fact.v, dim1.a, dim2.b FROM fact "
+         "JOIN dim1 ON fact.fk1 = dim1.k1 "
+         "JOIN dim2 ON fact.fk2 = dim2.k2 "
+         "WHERE dim2.b > 0.2")
+    plan = c.explain(q)
+    order = _join_order(plan)
+    # the filtered dimension (dim2) joins the fact before dim1
+    assert order.index("dim2") < order.index("dim1"), plan
+    r = c.sql(q, return_futures=False)
+    c_off = c.sql(q, return_futures=False,
+                  config_options={"sql.optimizer.fact_dimension_ratio": 1e9})
+    assert len(r) == len(c_off)
+
+
+def test_join_reorder_preserve_user_order_knob(c3):
+    c, *_ = c3
+    # both dims unfiltered: preserve_user_order=True keeps dim1 first even
+    # though dim2 is smaller; False sorts by size (dim2 first)
+    q = ("SELECT fact.v, dim1.a, dim2.b FROM fact "
+         "JOIN dim1 ON fact.fk1 = dim1.k1 "
+         "JOIN dim2 ON fact.fk2 = dim2.k2")
+    plan_keep = c.explain(q)
+    keep = _join_order(plan_keep)
+    assert keep.index("dim1") < keep.index("dim2"), plan_keep
+    plan_sorted = c.explain(
+        q, config_options={"sql.optimizer.preserve_user_order": False})
+    srt = _join_order(plan_sorted)
+    assert srt.index("dim2") < srt.index("dim1"), plan_sorted
+
+
+def test_join_reorder_max_fact_tables_knob(c3):
+    c, fact, dim1, dim2 = c3
+    # register a second fact table so the chain has 2 facts + 2 dims
+    c.create_table("fact2", fact.rename(columns={"v": "w"}))
+    q = ("SELECT fact.v FROM fact "
+         "JOIN fact2 ON fact.fk1 = fact2.fk1 "
+         "JOIN dim1 ON fact.fk1 = dim1.k1 "
+         "JOIN dim2 ON fact.fk2 = dim2.k2 WHERE dim2.b > 0.2")
+    plan = c.explain(q)
+    order = _join_order(plan)
+    assert order.index("dim2") < order.index("dim1"), plan  # reorder fired
+    # max_fact_tables=1 disables it (2 facts present)
+    plan_off = c.explain(
+        q, config_options={"sql.optimizer.max_fact_tables": 1})
+    off = _join_order(plan_off)
+    assert off.index("dim1") < off.index("dim2"), plan_off
+    # results identical either way
+    a = c.sql(q, return_futures=False)
+    b = c.sql(q, return_futures=False,
+              config_options={"sql.optimizer.max_fact_tables": 1})
+    assert len(a) == len(b)
+
+
+def test_join_reorder_filter_selectivity_knob(c3):
+    c, *_ = c3
+    # dim1 (20 rows) filtered, dim2 (10 rows) unfiltered.  With selectivity
+    # 1.0 dim2 is smaller -> first; with 0.1 the filtered dim1 counts as 2
+    # rows -> first.
+    q = ("SELECT fact.v, dim1.a, dim2.b FROM fact "
+         "JOIN dim1 ON fact.fk1 = dim1.k1 "
+         "JOIN dim2 ON fact.fk2 = dim2.k2 WHERE dim1.a > 0.9")
+    plan1 = c.explain(q)
+    o1 = _join_order(plan1)
+    assert o1.index("dim2") < o1.index("dim1"), plan1
+    plan2 = c.explain(
+        q, config_options={"sql.optimizer.filter_selectivity": 0.1})
+    o2 = _join_order(plan2)
+    assert o2.index("dim1") < o2.index("dim2"), plan2
+
+
+def test_join_reorder_results_match_tpch_shape(c3):
+    """5-table star query: reordered plan returns the same rows."""
+    c, fact, dim1, dim2 = c3
+    q = ("SELECT SUM(fact.v * dim1.a * dim2.b) AS s FROM fact "
+         "JOIN dim1 ON fact.fk1 = dim1.k1 "
+         "JOIN dim2 ON fact.fk2 = dim2.k2 "
+         "WHERE dim1.a > 0.3 AND dim2.b > 0.3")
+    r = c.sql(q, return_futures=False)
+    m = fact.merge(dim1, left_on="fk1", right_on="k1").merge(
+        dim2, left_on="fk2", right_on="k2")
+    m = m[(m.a > 0.3) & (m.b > 0.3)]
+    np.testing.assert_allclose(float(r["s"].iloc[0]),
+                               float((m.v * m.a * m.b).sum()), rtol=1e-9)
