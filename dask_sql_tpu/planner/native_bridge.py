@@ -96,3 +96,380 @@ def native_tokenize(sql: str):
     end = len(raw)
     tokens.append(Token(TokenType.EOF, "", end))
     return tokens
+
+
+# ---------------------------------------------------------------------------
+# native parser (C++ parser.cpp) — flat node buffer -> sqlast objects
+# ---------------------------------------------------------------------------
+_parser_checked = False
+_parser_ok = False
+
+# kind constants (keep in sync with native/parser.cpp)
+_K_STMT_LIST = 0; _K_QUERY_STMT = 1; _K_EXPLAIN_STMT = 2
+_K_SELECT = 10; _K_PROJ_ITEM = 11; _K_FROM_CLAUSE = 12; _K_WHERE_CLAUSE = 13
+_K_GROUP_ITEM = 14; _K_HAVING_CLAUSE = 15; _K_ORDER_ITEM = 16
+_K_LIMIT_CLAUSE = 17; _K_OFFSET_CLAUSE = 18; _K_CTE = 19; _K_SETOP = 20
+_K_DISTRIBUTE_ITEM = 21; _K_VALUES_ROW = 22; _K_NAMED_WINDOW = 23
+_K_NAMED_TABLE = 30; _K_DERIVED_TABLE = 31; _K_TABLE_FUNC = 32; _K_JOIN = 33
+_K_PART = 34; _K_ALIAS_COL = 35; _K_USING_COL = 36
+_K_IDENT = 40; _K_WILDCARD = 41; _K_LIT_NULL = 42; _K_LIT_INT = 43
+_K_LIT_FLOAT = 44; _K_LIT_STR = 45; _K_LIT_BOOL = 46; _K_LIT_TYPED = 47
+_K_INTERVAL = 48; _K_UNARY = 49; _K_BINARY = 50; _K_CAST = 51; _K_CASE = 52
+_K_FUNCALL = 53; _K_WINSPEC = 54; _K_FRAME = 55; _K_BETWEEN = 56
+_K_INLIST = 57; _K_INSUBQ = 58; _K_EXISTS = 59; _K_SCALARSUBQ = 60
+_K_LIKE = 61; _K_ISNULL = 62; _K_ISBOOL = 63; _K_ISDIST = 64; _K_EXTRACT = 65
+_K_SUBSTRING = 66; _K_TRIM = 67; _K_POSITION = 68; _K_OVERLAY = 69
+_K_CEILFLOORTO = 70; _K_GROUPING_SETS = 71; _K_SET_NODE = 72; _K_ROLLUP = 73
+_K_CUBE = 74
+
+_FRAME_KINDS = ["UNBOUNDED_PRECEDING", "PRECEDING", "CURRENT_ROW",
+                "FOLLOWING", "UNBOUNDED_FOLLOWING"]
+
+
+def _get_parser_lib():
+    global _parser_checked, _parser_ok
+    lib = get_lib()
+    if lib is None:
+        return None
+    if not _parser_checked:
+        _parser_checked = True
+        try:
+            lib.dsql_parse.restype = ctypes.c_int32
+            lib.dsql_parse.argtypes = [
+                ctypes.c_char_p, ctypes.c_int64,
+                ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+                ctypes.POINTER(ctypes.c_int64),
+            ]
+            lib.dsql_buf_free.argtypes = [ctypes.POINTER(ctypes.c_uint8)]
+            lib.dsql_parser_abi_version.restype = ctypes.c_int32
+            _parser_ok = lib.dsql_parser_abi_version() == 1
+        except AttributeError:
+            _parser_ok = False
+    return lib if _parser_ok else None
+
+
+class _FlatAst:
+    __slots__ = ("nodes", "children", "strings", "root")
+
+    def __init__(self, buf: bytes):
+        import struct
+
+        magic, n_nodes, n_children, n_strings, str_bytes, root, _ = \
+            struct.unpack_from("<7i", buf, 0)
+        if magic != 0x44535131:
+            raise ValueError("bad native AST magic")
+        self.nodes = []
+        off = 28
+        for _ in range(n_nodes):
+            self.nodes.append(struct.unpack_from("<iiqdiiii", buf, off))
+            off += 40
+        self.children = struct.unpack_from(f"<{n_children}i", buf, off)
+        off += 4 * n_children
+        offs = struct.unpack_from(f"<{n_strings + 1}i", buf, off)
+        off += 4 * (n_strings + 1)
+        blob = buf[off : off + str_bytes]
+        self.strings = [blob[offs[i]:offs[i + 1]].decode("utf-8")
+                        for i in range(n_strings)]
+        self.root = root
+
+    def kids(self, nid):
+        k = self.nodes[nid]
+        return self.children[k[6] : k[6] + k[7]]
+
+    def s(self, idx):
+        return None if idx < 0 else self.strings[idx]
+
+
+def _decode_expr(f: "_FlatAst", nid: int):
+    from . import sqlast as a
+
+    kind, flags, ival, dval, s0, s1, _, _ = f.nodes[nid]
+    kids = f.kids(nid)
+    if kind == _K_IDENT:
+        parts, quoted = [], []
+        for p in kids:
+            pk = f.nodes[p]
+            parts.append(f.s(pk[4]))
+            quoted.append(bool(pk[1] & 1))
+        return a.Identifier(parts, quoted)
+    if kind == _K_WILDCARD:
+        if flags & 1:
+            return a.Wildcard([f.s(f.nodes[p][4]) for p in kids])
+        return a.Wildcard()
+    if kind == _K_LIT_NULL:
+        return a.Literal(None)
+    if kind == _K_LIT_INT:
+        return a.Literal(ival)
+    if kind == _K_LIT_FLOAT:
+        return a.Literal(dval)
+    if kind == _K_LIT_STR:
+        return a.Literal(f.s(s0))
+    if kind == _K_LIT_BOOL:
+        return a.Literal(bool(ival))
+    if kind == _K_LIT_TYPED:
+        return a.Literal(f.s(s0), type_name=f.s(s1))
+    if kind == _K_INTERVAL:
+        return a.IntervalLiteral(f.s(s0), f.s(s1))
+    if kind == _K_UNARY:
+        return a.UnaryOp(f.s(s0), _decode_expr(f, kids[0]))
+    if kind == _K_BINARY:
+        return a.BinaryOp(f.s(s0), _decode_expr(f, kids[0]),
+                          _decode_expr(f, kids[1]))
+    if kind == _K_CAST:
+        return a.Cast(_decode_expr(f, kids[0]), f.s(s0), safe=bool(flags & 1))
+    if kind == _K_CASE:
+        i = 0
+        operand = None
+        if flags & 1:
+            operand = _decode_expr(f, kids[0])
+            i = 1
+        rest = kids[i:]
+        n_when = (len(rest) - (1 if flags & 2 else 0)) // 2
+        whens = [( _decode_expr(f, rest[2 * j]), _decode_expr(f, rest[2 * j + 1]))
+                 for j in range(n_when)]
+        else_ = _decode_expr(f, rest[-1]) if flags & 2 else None
+        return a.Case(operand, whens, else_)
+    if kind == _K_FUNCALL:
+        args = [_decode_expr(f, k) for k in kids[:ival]]
+        i = ival
+        filt = None
+        if flags & 4:
+            filt = _decode_expr(f, kids[i])
+            i += 1
+        over = None
+        if flags & 8:
+            over = _decode_winspec(f, kids[i])
+            i += 1
+        elif flags & 16:
+            over = f.s(s1)
+        return a.FunctionCall(f.s(s0), args, bool(flags & 1), filt, over,
+                              bool(flags & 2))
+    if kind == _K_BETWEEN:
+        return a.Between(_decode_expr(f, kids[0]), _decode_expr(f, kids[1]),
+                         _decode_expr(f, kids[2]), bool(flags & 1),
+                         bool(flags & 2))
+    if kind == _K_INLIST:
+        return a.InList(_decode_expr(f, kids[0]),
+                        [_decode_expr(f, k) for k in kids[1:]],
+                        bool(flags & 1))
+    if kind == _K_INSUBQ:
+        return a.InSubquery(_decode_expr(f, kids[0]),
+                            _decode_select(f, kids[1]), bool(flags & 1))
+    if kind == _K_EXISTS:
+        return a.Exists(_decode_select(f, kids[0]), bool(flags & 1))
+    if kind == _K_SCALARSUBQ:
+        return a.ScalarSubquery(_decode_select(f, kids[0]))
+    if kind == _K_LIKE:
+        return a.Like(_decode_expr(f, kids[0]), _decode_expr(f, kids[1]),
+                      bool(flags & 1), bool(flags & 2), bool(flags & 4),
+                      f.s(s0) if flags & 8 else None)
+    if kind == _K_ISNULL:
+        return a.IsNull(_decode_expr(f, kids[0]), bool(flags & 1))
+    if kind == _K_ISBOOL:
+        return a.IsBool(_decode_expr(f, kids[0]), bool(flags & 2),
+                        bool(flags & 1))
+    if kind == _K_ISDIST:
+        return a.IsDistinctFrom(_decode_expr(f, kids[0]),
+                                _decode_expr(f, kids[1]), bool(flags & 1))
+    if kind == _K_EXTRACT:
+        return a.Extract(f.s(s0), _decode_expr(f, kids[0]))
+    if kind == _K_SUBSTRING:
+        start = _decode_expr(f, kids[1]) if flags & 1 else None
+        length = _decode_expr(f, kids[2]) if flags & 2 else None
+        return a.Substring(_decode_expr(f, kids[0]), start, length)
+    if kind == _K_TRIM:
+        chars = _decode_expr(f, kids[1]) if flags & 1 else None
+        return a.Trim(_decode_expr(f, kids[0]), f.s(s0), chars)
+    if kind == _K_POSITION:
+        return a.Position(_decode_expr(f, kids[0]), _decode_expr(f, kids[1]))
+    if kind == _K_OVERLAY:
+        length = _decode_expr(f, kids[3]) if flags & 1 else None
+        return a.Overlay(_decode_expr(f, kids[0]), _decode_expr(f, kids[1]),
+                         _decode_expr(f, kids[2]), length)
+    if kind == _K_CEILFLOORTO:
+        return a.CeilFloorTo(f.s(s0), _decode_expr(f, kids[0]), f.s(s1))
+    if kind == _K_GROUPING_SETS:
+        return a.GroupingSets([[_decode_expr(f, e) for e in f.kids(sn)]
+                               for sn in kids])
+    if kind == _K_ROLLUP:
+        return a.Rollup([_decode_expr(f, k) for k in kids])
+    if kind == _K_CUBE:
+        return a.Cube([_decode_expr(f, k) for k in kids])
+    raise ValueError(f"unexpected native expr kind {kind}")
+
+
+def _decode_order_item(f, nid):
+    from . import sqlast as a
+
+    _, flags, _, _, _, _, _, _ = f.nodes[nid]
+    nulls_first = bool(flags & 4) if flags & 2 else None
+    return a.OrderItem(_decode_expr(f, f.kids(nid)[0]), bool(flags & 1),
+                       nulls_first)
+
+
+def _decode_winspec(f, nid):
+    from . import sqlast as a
+
+    _, flags, npart, _, _, _, _, _ = f.nodes[nid]
+    kids = list(f.kids(nid))
+    has_frame = bool(flags & 1)
+    frame_id = kids.pop() if has_frame else None
+    spec = a.WindowSpec()
+    spec.partition_by = [_decode_expr(f, k) for k in kids[:npart]]
+    spec.order_by = [_decode_order_item(f, k) for k in kids[npart:]]
+    if frame_id is not None:
+        fk, fflags, fival, _, fs0, _, _, _ = f.nodes[frame_id]
+        fkids = list(f.kids(frame_id))
+        i = 0
+        start_off = None
+        if fflags & 1:
+            start_off = _decode_expr(f, fkids[i]); i += 1
+        end_off = None
+        if fflags & 2:
+            end_off = _decode_expr(f, fkids[i]); i += 1
+        start = (_FRAME_KINDS[fival & 0xFF], start_off)
+        end = (_FRAME_KINDS[(fival >> 8) & 0xFF], end_off)
+        spec.frame = a.WindowFrame(f.s(fs0), start, end)
+    return spec
+
+
+def _decode_table_ref(f, nid):
+    from . import sqlast as a
+
+    kind, flags, ival, dval, s0, s1, _, _ = f.nodes[nid]
+    kids = f.kids(nid)
+    if kind == _K_NAMED_TABLE:
+        parts = [f.s(f.nodes[k][4]) for k in kids
+                 if f.nodes[k][0] == _K_PART]
+        alias_cols = [f.s(f.nodes[k][4]) for k in kids
+                      if f.nodes[k][0] == _K_ALIAS_COL]
+        alias = f.s(s0)
+        if alias_cols:
+            alias = (alias, alias_cols)
+        sample = None
+        if flags & 1:
+            sample = (f.s(s1), dval, None if ival < 0 else ival)
+        return a.NamedTable(parts, alias, sample)
+    if kind == _K_DERIVED_TABLE:
+        alias_cols = [f.s(f.nodes[k][4]) for k in kids[1:]
+                      if f.nodes[k][0] == _K_ALIAS_COL]
+        alias = f.s(s0)
+        if alias_cols:
+            alias = (alias, alias_cols)
+        return a.DerivedTable(_decode_select(f, kids[0]), alias)
+    if kind == _K_TABLE_FUNC:
+        parts = [f.s(f.nodes[k][4]) for k in kids
+                 if f.nodes[k][0] == _K_PART]
+        sel = next(k for k in kids if f.nodes[k][0] == _K_SELECT)
+        return a.TableFunction(f.s(s0), parts, _decode_select(f, sel),
+                               f.s(s1))
+    if kind == _K_JOIN:
+        left = _decode_table_ref(f, kids[0])
+        right = _decode_table_ref(f, kids[1])
+        jt = f.s(s0)
+        condition = None
+        using = None
+        rest = kids[2:]
+        if flags & 1:
+            condition = _decode_expr(f, rest[0])
+        elif flags & 2:
+            using = [f.s(f.nodes[k][4]) for k in rest
+                     if f.nodes[k][0] == _K_USING_COL]
+        return a.Join(left, right, jt, condition, using)
+    raise ValueError(f"unexpected native table-ref kind {kind}")
+
+
+def _decode_select(f, nid):
+    from . import sqlast as a
+
+    kind, flags, _, _, _, _, _, _ = f.nodes[nid]
+    if kind != _K_SELECT:
+        raise ValueError(f"expected SELECT node, got {kind}")
+    sel = a.Select()
+    sel.distinct = bool(flags & 1)
+    values_rows = []
+    for k in f.kids(nid):
+        ck, cflags, cival, cdval, cs0, cs1, _, _ = f.nodes[k]
+        kk = f.kids(k)
+        if ck == _K_PROJ_ITEM:
+            sel.projections.append(
+                a.SelectItem(_decode_expr(f, kk[0]), f.s(cs0)))
+        elif ck == _K_FROM_CLAUSE:
+            sel.from_ = _decode_table_ref(f, kk[0])
+        elif ck == _K_WHERE_CLAUSE:
+            sel.where = _decode_expr(f, kk[0])
+        elif ck == _K_GROUP_ITEM:
+            sel.group_by.append(_decode_expr(f, kk[0]))
+        elif ck == _K_HAVING_CLAUSE:
+            sel.having = _decode_expr(f, kk[0])
+        elif ck == _K_ORDER_ITEM:
+            sel.order_by.append(_decode_order_item(f, k))
+        elif ck == _K_LIMIT_CLAUSE:
+            sel.limit = cival
+        elif ck == _K_OFFSET_CLAUSE:
+            sel.offset = cival
+        elif ck == _K_CTE:
+            sel.ctes.append((f.s(cs0), _decode_select(f, kk[0])))
+        elif ck == _K_SETOP:
+            sel.set_op = (f.s(cs0), bool(cflags & 1),
+                          _decode_select(f, kk[0]))
+        elif ck == _K_DISTRIBUTE_ITEM:
+            sel.distribute_by.append(_decode_expr(f, kk[0]))
+        elif ck == _K_VALUES_ROW:
+            values_rows.append([_decode_expr(f, e) for e in kk])
+        elif ck == _K_NAMED_WINDOW:
+            sel.named_windows[f.s(cs0)] = _decode_winspec(f, kk[0])
+        else:
+            raise ValueError(f"unexpected SELECT child kind {ck}")
+    if values_rows:
+        sel.values = values_rows
+    return sel
+
+
+def native_parse(sql: str):
+    """Parse via the C++ parser; returns a list of sqlast.Statement or None
+    when the native path is unavailable / the statement is unsupported.
+    Raises ParsingException for genuine syntax errors (same format as the
+    Python parser)."""
+    lib = _get_parser_lib()
+    if lib is None:
+        return None
+    raw = sql.encode("utf-8")
+    out = ctypes.POINTER(ctypes.c_uint8)()
+    out_len = ctypes.c_int64()
+    rc = lib.dsql_parse(raw, len(raw), ctypes.byref(out),
+                        ctypes.byref(out_len))
+    if rc == 1:
+        return None
+    try:
+        buf = ctypes.string_at(out, out_len.value) if out_len.value else b""
+    finally:
+        if out:
+            lib.dsql_buf_free(out)
+    if rc == 2:
+        import struct
+
+        from .parser import ParsingException
+
+        pos = struct.unpack_from("<q", buf, 0)[0]
+        msg = buf[8:].decode("utf-8", "replace")
+        ctx = sql[max(0, pos - 30) : pos + 30]
+        raise ParsingException(f"{msg} at position {pos} (near {ctx!r})")
+    try:
+        f = _FlatAst(buf)
+    except Exception:  # noqa: BLE001 - corrupt buffer -> Python fallback
+        logger.debug("native AST decode failed", exc_info=True)
+        return None
+    from . import sqlast as a
+
+    stmts = []
+    for sid in f.kids(f.root):
+        kind, flags, _, _, _, _, _, _ = f.nodes[sid]
+        if kind == _K_QUERY_STMT:
+            stmts.append(a.QueryStatement(_decode_select(f, f.kids(sid)[0])))
+        elif kind == _K_EXPLAIN_STMT:
+            stmts.append(a.ExplainStatement(_decode_select(f, f.kids(sid)[0]),
+                                            bool(flags & 1)))
+        else:
+            return None
+    return stmts

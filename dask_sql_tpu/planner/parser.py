@@ -10,10 +10,13 @@ expression parsing.
 """
 from __future__ import annotations
 
+import logging
 from typing import Any, Dict, List, Optional, Tuple
 
 from . import sqlast as a
 from .lexer import Token, TokenType, tokenize
+
+logger = logging.getLogger(__name__)
 
 
 class ParsingException(ValueError):
@@ -1094,5 +1097,25 @@ def _parse_number(text: str):
 
 
 def parse_sql(sql: str) -> List[a.Statement]:
-    """Parse one or more ;-separated statements (reference DaskParser::parse_sql)."""
+    """Parse one or more ;-separated statements (reference DaskParser::parse_sql).
+
+    Queries go through the native (C++) parser when the library is built
+    (native/parser.cpp emits a flat AST buffer that decodes to the same
+    sqlast objects); DDL/ML statements and any native miss fall back to the
+    Python parser.  DSQL_NATIVE_PARSER=0 disables the native path.
+    """
+    import os
+
+    if os.environ.get("DSQL_NATIVE_PARSER", "1") != "0":
+        try:
+            from .native_bridge import native_parse
+
+            stmts = native_parse(sql)
+            if stmts is not None:
+                return stmts
+        except ParsingException:
+            raise
+        except Exception:  # noqa: BLE001 - any native issue -> Python path
+            logger.debug("native parse failed; using Python parser",
+                         exc_info=True)
     return Parser(sql).parse_statements()

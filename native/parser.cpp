@@ -1,0 +1,1407 @@
+// SQL parser — native planner frontend (queries + expressions).
+//
+// Role parity: the reference's compiled parser (src/parser.rs, 1444 LoC of
+// Rust wrapping sqlparser-rs).  This implements the SELECT-core + full
+// expression grammar of dask_sql_tpu/planner/parser.py in C++, emitting a
+// flat node buffer that planner/native_bridge.py decodes into the same
+// sqlast dataclasses the Python parser produces — so the two parsers are
+// drop-in interchangeable and differentially testable (AST equality).
+//
+// DDL/ML statements (CREATE MODEL, SHOW, ANALYZE, ...) return `unsupported`
+// and stay on the Python path; queries — the hot path through Context.sql —
+// parse natively.
+//
+// Buffer ABI (version 1, little-endian):
+//   header: int32[7]  {magic, n_nodes, n_children, n_strings, str_bytes,
+//                      root_node, reserved}
+//   nodes:  n_nodes x 40B packed {i32 kind, i32 flags, i64 ival, f64 dval,
+//                                 i32 s0, i32 s1, i32 child_off, i32 nchild}
+//   children: n_children x i32 (node ids)
+//   str_offsets: (n_strings+1) x i32
+//   str_bytes: utf-8 blob
+//
+// Build: part of libdsql_native.so (see native/Makefile).
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <map>
+#include <string>
+#include <vector>
+
+extern "C" int64_t dsql_tokenize(const char* sql, int64_t n, int32_t* types,
+                                 int64_t* starts, int64_t* lens,
+                                 int64_t max_tokens);
+
+namespace {
+
+constexpr int32_t MAGIC = 0x44535131;  // "DSQ1"
+
+enum TokType : int32_t {
+  T_IDENT = 0, T_QUOTED = 1, T_NUMBER = 2, T_STRING = 3, T_OP = 4,
+  T_PUNCT = 5, T_PARAM = 6, T_EOF = 7,
+};
+
+enum Kind : int32_t {
+  K_STMT_LIST = 0, K_QUERY_STMT = 1, K_EXPLAIN_STMT = 2,
+  K_SELECT = 10, K_PROJ_ITEM = 11, K_FROM_CLAUSE = 12, K_WHERE_CLAUSE = 13,
+  K_GROUP_ITEM = 14, K_HAVING_CLAUSE = 15, K_ORDER_ITEM = 16,
+  K_LIMIT_CLAUSE = 17, K_OFFSET_CLAUSE = 18, K_CTE = 19, K_SETOP = 20,
+  K_DISTRIBUTE_ITEM = 21, K_VALUES_ROW = 22, K_NAMED_WINDOW = 23,
+  K_NAMED_TABLE = 30, K_DERIVED_TABLE = 31, K_TABLE_FUNC = 32, K_JOIN = 33,
+  K_PART = 34, K_ALIAS_COL = 35, K_USING_COL = 36,
+  K_IDENT = 40, K_WILDCARD = 41, K_LIT_NULL = 42, K_LIT_INT = 43,
+  K_LIT_FLOAT = 44, K_LIT_STR = 45, K_LIT_BOOL = 46, K_LIT_TYPED = 47,
+  K_INTERVAL = 48, K_UNARY = 49, K_BINARY = 50, K_CAST = 51, K_CASE = 52,
+  K_FUNCALL = 53, K_WINSPEC = 54, K_FRAME = 55, K_BETWEEN = 56,
+  K_INLIST = 57, K_INSUBQ = 58, K_EXISTS = 59, K_SCALARSUBQ = 60,
+  K_LIKE = 61, K_ISNULL = 62, K_ISBOOL = 63, K_ISDIST = 64, K_EXTRACT = 65,
+  K_SUBSTRING = 66, K_TRIM = 67, K_POSITION = 68, K_OVERLAY = 69,
+  K_CEILFLOORTO = 70, K_GROUPING_SETS = 71, K_SET_NODE = 72, K_ROLLUP = 73,
+  K_CUBE = 74,
+};
+
+// frame bound kinds
+enum { FB_UNB_PRE = 0, FB_PRE = 1, FB_CUR = 2, FB_FOL = 3, FB_UNB_FOL = 4 };
+
+struct Token {
+  int32_t type;
+  std::string value;  // content (quotes stripped, escapes folded)
+  std::string upper;
+  int64_t pos;
+};
+
+struct ParseErr {
+  int64_t pos;
+  std::string msg;
+};
+struct Unsupported {};
+
+const char* RESERVED_STOP[] = {
+    "FROM", "WHERE", "GROUP", "HAVING", "ORDER", "LIMIT", "OFFSET", "UNION",
+    "INTERSECT", "EXCEPT", "ON", "USING", "JOIN", "INNER", "LEFT", "RIGHT",
+    "FULL", "CROSS", "AS", "AND", "OR", "NOT", "WHEN", "THEN", "ELSE", "END",
+    "BY", "ASC", "DESC", "NULLS", "SELECT", "SEMI", "ANTI", "DISTRIBUTE",
+    "WITH", "TABLESAMPLE", "FETCH", "WINDOW", "OUTER", "NATURAL", "FILTER",
+    "OVER", "CASE", "BETWEEN", "IN", "LIKE", "ILIKE", "SIMILAR", "IS",
+    "ESCAPE", "VALUES", "TO", "FOR",
+};
+
+const char* DATETIME_UNITS[] = {
+    "YEAR", "QUARTER", "MONTH", "WEEK", "DAY", "DOW", "DOY", "HOUR", "MINUTE",
+    "SECOND", "MILLISECOND", "MICROSECOND", "NANOSECOND", "EPOCH", "CENTURY",
+    "DECADE", "MILLENNIUM", "ISODOW", "ISOYEAR",
+};
+
+bool in_list(const std::string& s, const char* const* arr, size_t n) {
+  for (size_t i = 0; i < n; ++i)
+    if (s == arr[i]) return true;
+  return false;
+}
+
+bool is_reserved_stop(const std::string& up) {
+  return in_list(up, RESERVED_STOP, sizeof(RESERVED_STOP) / sizeof(char*));
+}
+
+bool is_datetime_unit(const std::string& up) {
+  return in_list(up, DATETIME_UNITS, sizeof(DATETIME_UNITS) / sizeof(char*));
+}
+
+std::string upper_of(const std::string& s) {
+  std::string u = s;
+  for (auto& c : u)
+    if (c >= 'a' && c <= 'z') c -= 32;
+  return u;
+}
+
+std::string strip_trailing_s(const std::string& s) {
+  std::string r = s;
+  while (!r.empty() && r.back() == 'S') r.pop_back();
+  return r;
+}
+
+// ---------------------------------------------------------------------------
+// flat-buffer builder
+// ---------------------------------------------------------------------------
+struct Node {
+  int32_t kind;
+  int32_t flags;
+  int64_t ival;
+  double dval;
+  int32_t s0;
+  int32_t s1;
+  int32_t child_off;
+  int32_t nchild;
+};
+
+class Builder {
+ public:
+  std::vector<Node> nodes;
+  std::vector<int32_t> children;
+  std::vector<std::string> strings;
+  std::map<std::string, int32_t> intern_map;
+
+  int32_t intern(const std::string& s) {
+    auto it = intern_map.find(s);
+    if (it != intern_map.end()) return it->second;
+    int32_t id = static_cast<int32_t>(strings.size());
+    strings.push_back(s);
+    intern_map.emplace(s, id);
+    return id;
+  }
+
+  int32_t add(int32_t kind, const std::vector<int32_t>& kids,
+              int32_t flags = 0, int64_t ival = 0, double dval = 0.0,
+              int32_t s0 = -1, int32_t s1 = -1) {
+    Node n;
+    n.kind = kind;
+    n.flags = flags;
+    n.ival = ival;
+    n.dval = dval;
+    n.s0 = s0;
+    n.s1 = s1;
+    n.child_off = static_cast<int32_t>(children.size());
+    n.nchild = static_cast<int32_t>(kids.size());
+    children.insert(children.end(), kids.begin(), kids.end());
+    nodes.push_back(n);
+    return static_cast<int32_t>(nodes.size() - 1);
+  }
+
+  // serialize to a malloc'd buffer the caller frees with dsql_buf_free
+  uint8_t* serialize(int32_t root, int64_t* out_len) const {
+    size_t str_bytes = 0;
+    for (auto& s : strings) str_bytes += s.size();
+    size_t total = 7 * 4 + nodes.size() * 40 + children.size() * 4 +
+                   (strings.size() + 1) * 4 + str_bytes;
+    uint8_t* buf = static_cast<uint8_t*>(std::malloc(total));
+    if (!buf) return nullptr;
+    uint8_t* p = buf;
+    auto w32 = [&p](int32_t v) { std::memcpy(p, &v, 4); p += 4; };
+    auto w64 = [&p](int64_t v) { std::memcpy(p, &v, 8); p += 8; };
+    auto wf64 = [&p](double v) { std::memcpy(p, &v, 8); p += 8; };
+    w32(MAGIC);
+    w32(static_cast<int32_t>(nodes.size()));
+    w32(static_cast<int32_t>(children.size()));
+    w32(static_cast<int32_t>(strings.size()));
+    w32(static_cast<int32_t>(str_bytes));
+    w32(root);
+    w32(0);
+    for (auto& n : nodes) {
+      w32(n.kind); w32(n.flags); w64(n.ival); wf64(n.dval);
+      w32(n.s0); w32(n.s1); w32(n.child_off); w32(n.nchild);
+    }
+    for (auto c : children) w32(c);
+    int32_t off = 0;
+    for (auto& s : strings) { w32(off); off += static_cast<int32_t>(s.size()); }
+    w32(off);
+    for (auto& s : strings) { std::memcpy(p, s.data(), s.size()); p += s.size(); }
+    *out_len = static_cast<int64_t>(total);
+    return buf;
+  }
+};
+
+// ---------------------------------------------------------------------------
+// lexing wrapper (shared token contract with the Python lexer)
+// ---------------------------------------------------------------------------
+std::string fold_quotes(const char* s, int64_t len, char quote) {
+  std::string out;
+  out.reserve(len);
+  for (int64_t i = 0; i < len; ++i) {
+    out.push_back(s[i]);
+    if (s[i] == quote && i + 1 < len && s[i + 1] == quote) ++i;
+  }
+  return out;
+}
+
+bool lex(const char* sql, int64_t n, std::vector<Token>& out, int64_t* errpos) {
+  int64_t cap = n + 8;
+  std::vector<int32_t> types(cap);
+  std::vector<int64_t> starts(cap), lens(cap);
+  int64_t count = dsql_tokenize(sql, n, types.data(), starts.data(),
+                                lens.data(), cap);
+  if (count < 0) {
+    *errpos = -count - 1;
+    return false;
+  }
+  out.reserve(count + 1);
+  for (int64_t i = 0; i < count; ++i) {
+    Token t;
+    t.type = types[i];
+    t.pos = starts[i];
+    const char* s = sql + starts[i];
+    if (types[i] == T_STRING)
+      t.value = fold_quotes(s, lens[i], '\'');
+    else if (types[i] == T_QUOTED)
+      t.value = fold_quotes(s, lens[i], s[-1] == '`' ? '`' : '"');
+    else
+      t.value.assign(s, static_cast<size_t>(lens[i]));
+    t.upper = upper_of(t.value);
+    out.push_back(std::move(t));
+  }
+  Token eof;
+  eof.type = T_EOF;
+  eof.pos = n;
+  out.push_back(eof);
+  return true;
+}
+
+// ---------------------------------------------------------------------------
+// the parser — method-for-method mirror of planner/parser.py
+// ---------------------------------------------------------------------------
+class Parser {
+ public:
+  Parser(const char* sql, int64_t n, std::vector<Token> toks, Builder& b)
+      : sql_(sql, static_cast<size_t>(n)), toks_(std::move(toks)), b_(b) {}
+
+  int32_t parse_statements() {
+    std::vector<int32_t> stmts;
+    while (peek().type != T_EOF) {
+      stmts.push_back(parse_statement());
+      while (accept(";")) {}
+    }
+    return b_.add(K_STMT_LIST, stmts);
+  }
+
+ private:
+  std::string sql_;
+  std::vector<Token> toks_;
+  Builder& b_;
+  size_t pos_ = 0;
+
+  const Token& peek(size_t off = 0) const {
+    size_t i = pos_ + off;
+    if (i >= toks_.size()) i = toks_.size() - 1;
+    return toks_[i];
+  }
+  const Token& next() {
+    const Token& t = toks_[pos_];
+    if (t.type != T_EOF) ++pos_;
+    return t;
+  }
+  [[noreturn]] void error(const std::string& msg) const {
+    throw ParseErr{peek().pos, msg};
+  }
+  bool at_keyword(const char* kw) const {
+    const Token& t = peek();
+    return t.type == T_IDENT && t.upper == kw;
+  }
+  bool at_keyword2(const char* a, const char* b) const {
+    return at_keyword(a) || at_keyword(b);
+  }
+  bool accept_keyword(const char* kw) {
+    if (at_keyword(kw)) { next(); return true; }
+    return false;
+  }
+  void expect_keyword(const char* kw) {
+    if (!accept_keyword(kw)) error(std::string("Expected ") + kw);
+  }
+  bool accept(const char* v) {
+    const Token& t = peek();
+    if ((t.type == T_OP || t.type == T_PUNCT) && t.value == v) {
+      next();
+      return true;
+    }
+    return false;
+  }
+  void expect(const char* v) {
+    if (!accept(v)) error(std::string("Expected '") + v + "'");
+  }
+  bool peek_is(size_t off, const char* v) const {
+    const Token& t = peek(off);
+    return (t.type == T_OP || t.type == T_PUNCT) && t.value == v;
+  }
+
+  std::string parse_identifier(bool* quoted = nullptr) {
+    const Token& t = peek();
+    if (t.type == T_QUOTED) {
+      if (quoted) *quoted = true;
+      return next().value;
+    }
+    if (t.type == T_IDENT) {
+      if (quoted) *quoted = false;
+      return next().value;
+    }
+    error("Expected identifier");
+  }
+
+  std::vector<int32_t> parse_qualified_parts() {
+    std::vector<int32_t> parts;
+    bool q = false;
+    std::string name = parse_identifier(&q);
+    parts.push_back(b_.add(K_PART, {}, q ? 1 : 0, 0, 0.0, b_.intern(name)));
+    while (accept(".")) {
+      name = parse_identifier(&q);
+      parts.push_back(b_.add(K_PART, {}, q ? 1 : 0, 0, 0.0, b_.intern(name)));
+    }
+    return parts;
+  }
+
+  // numbers: int when the text parses as a pure integer, else double;
+  // out-of-int64 integers fall back to the Python parser
+  int32_t number_literal(const std::string& text) {
+    bool is_float = false;
+    for (char c : text)
+      if (c == '.' || c == 'e' || c == 'E') { is_float = true; break; }
+    if (!is_float) {
+      errno = 0;
+      char* end = nullptr;
+      long long v = std::strtoll(text.c_str(), &end, 10);
+      if (errno != 0 || (end && *end != '\0')) throw Unsupported{};
+      return b_.add(K_LIT_INT, {}, 0, static_cast<int64_t>(v));
+    }
+    char* end = nullptr;
+    double d = std::strtod(text.c_str(), &end);
+    if (end && *end != '\0') error("Bad number");
+    return b_.add(K_LIT_FLOAT, {}, 0, 0, d);
+  }
+
+  int64_t parse_int_token() {
+    const Token& t = next();
+    if (t.type != T_NUMBER) throw ParseErr{t.pos, "Expected number"};
+    errno = 0;
+    char* end = nullptr;
+    double d = std::strtod(t.value.c_str(), &end);
+    if (end && *end != '\0') throw ParseErr{t.pos, "Bad number"};
+    return static_cast<int64_t>(d);
+  }
+
+  // -- statements ---------------------------------------------------------
+  int32_t parse_statement() {
+    if (at_keyword("SELECT") || at_keyword("WITH") || at_keyword("VALUES") ||
+        peek_is(0, "(")) {
+      return b_.add(K_QUERY_STMT, {parse_query()});
+    }
+    if (at_keyword("EXPLAIN")) {
+      next();
+      bool analyze = accept_keyword("ANALYZE");
+      accept_keyword("VERBOSE");
+      return b_.add(K_EXPLAIN_STMT, {parse_query()}, analyze ? 1 : 0);
+    }
+    throw Unsupported{};  // DDL/ML statements stay on the Python parser
+  }
+
+  // -- queries ------------------------------------------------------------
+  int32_t parse_query() {
+    std::vector<int32_t> ctes;
+    if (accept_keyword("WITH")) {
+      while (true) {
+        std::string name = parse_identifier();
+        expect_keyword("AS");
+        expect("(");
+        int32_t sub = parse_query();
+        expect(")");
+        ctes.push_back(b_.add(K_CTE, {sub}, 0, 0, 0.0, b_.intern(name)));
+        if (!accept(",")) break;
+      }
+    }
+    int32_t query = parse_set_expr();
+    // attach CTEs + trailing clauses by appending extra children
+    std::vector<int32_t> extra = ctes;
+    if (accept_keyword("ORDER")) {
+      expect_keyword("BY");
+      parse_order_items(extra);
+    }
+    if (accept_keyword("LIMIT")) {
+      const Token& t = next();
+      if (t.upper != "ALL") {
+        errno = 0;
+        char* end = nullptr;
+        double d = std::strtod(t.value.c_str(), &end);
+        if (t.type != T_NUMBER || (end && *end != '\0'))
+          throw ParseErr{t.pos, "Expected number"};
+        extra.push_back(b_.add(K_LIMIT_CLAUSE, {}, 0,
+                               static_cast<int64_t>(d)));
+      }
+    }
+    if (accept_keyword("OFFSET")) {
+      extra.push_back(b_.add(K_OFFSET_CLAUSE, {}, 0, parse_int_token()));
+      if (!accept_keyword("ROW")) accept_keyword("ROWS");
+    }
+    if (accept_keyword("FETCH")) {
+      if (!accept_keyword("FIRST")) accept_keyword("NEXT");
+      extra.push_back(b_.add(K_LIMIT_CLAUSE, {}, 0, parse_int_token()));
+      if (!accept_keyword("ROW")) accept_keyword("ROWS");
+      expect_keyword("ONLY");
+    }
+    if (extra.empty()) return query;
+    return append_children(query, extra);
+  }
+
+  // append clause nodes to an existing SELECT node (creates fresh child span)
+  int32_t append_children(int32_t sel, const std::vector<int32_t>& extra) {
+    Node n = b_.nodes[sel];
+    std::vector<int32_t> kids;
+    kids.reserve(n.nchild + extra.size());
+    for (int32_t i = 0; i < n.nchild; ++i)
+      kids.push_back(b_.children[n.child_off + i]);
+    kids.insert(kids.end(), extra.begin(), extra.end());
+    b_.nodes[sel].child_off = static_cast<int32_t>(b_.children.size());
+    b_.nodes[sel].nchild = static_cast<int32_t>(kids.size());
+    b_.children.insert(b_.children.end(), kids.begin(), kids.end());
+    return sel;
+  }
+
+  int32_t parse_set_expr() {
+    int32_t left = parse_select_core();
+    while (at_keyword("UNION") || at_keyword("INTERSECT") ||
+           at_keyword("EXCEPT")) {
+      std::string op = next().upper;
+      bool all = accept_keyword("ALL");
+      if (!all) accept_keyword("DISTINCT");
+      int32_t right = parse_select_core();
+      if (has_setop(left)) {
+        // chain: wrap the existing (A op B) as a derived table
+        int32_t wild = b_.add(K_WILDCARD, {}, 0);
+        int32_t item = b_.add(K_PROJ_ITEM, {wild});
+        int32_t dt = b_.add(K_DERIVED_TABLE, {left});
+        int32_t from = b_.add(K_FROM_CLAUSE, {dt});
+        left = b_.add(K_SELECT, {item, from});
+      }
+      int32_t setop = b_.add(K_SETOP, {right}, all ? 1 : 0, 0, 0.0,
+                             b_.intern(op));
+      left = append_children(left, {setop});
+    }
+    return left;
+  }
+
+  bool has_setop(int32_t sel) const {
+    const Node& n = b_.nodes[sel];
+    for (int32_t i = 0; i < n.nchild; ++i)
+      if (b_.nodes[b_.children[n.child_off + i]].kind == K_SETOP) return true;
+    return false;
+  }
+
+  int32_t parse_select_core() {
+    if (accept("(")) {
+      int32_t q = parse_query();
+      expect(")");
+      return q;
+    }
+    std::vector<int32_t> kids;
+    int32_t flags = 0;
+    if (accept_keyword("VALUES")) {
+      while (true) {
+        expect("(");
+        std::vector<int32_t> row;
+        row.push_back(parse_expr());
+        while (accept(",")) row.push_back(parse_expr());
+        expect(")");
+        kids.push_back(b_.add(K_VALUES_ROW, row));
+        if (!accept(",")) break;
+      }
+      return b_.add(K_SELECT, kids);
+    }
+    expect_keyword("SELECT");
+    if (accept_keyword("DISTINCT"))
+      flags |= 1;
+    else
+      accept_keyword("ALL");
+    // projections
+    kids.push_back(parse_select_item());
+    while (accept(",")) kids.push_back(parse_select_item());
+    if (accept_keyword("FROM"))
+      kids.push_back(b_.add(K_FROM_CLAUSE, {parse_table_ref()}));
+    if (accept_keyword("WHERE"))
+      kids.push_back(b_.add(K_WHERE_CLAUSE, {parse_expr()}));
+    if (at_keyword("GROUP")) {
+      next();
+      expect_keyword("BY");
+      kids.push_back(b_.add(K_GROUP_ITEM, {parse_group_item()}));
+      while (accept(","))
+        kids.push_back(b_.add(K_GROUP_ITEM, {parse_group_item()}));
+    }
+    if (accept_keyword("HAVING"))
+      kids.push_back(b_.add(K_HAVING_CLAUSE, {parse_expr()}));
+    if (at_keyword("WINDOW") &&
+        (peek(1).type == T_IDENT || peek(1).type == T_QUOTED) &&
+        peek(2).upper == "AS") {
+      next();
+      while (true) {
+        std::string wname = parse_identifier();
+        expect_keyword("AS");
+        int32_t spec = parse_window_spec();
+        kids.push_back(b_.add(K_NAMED_WINDOW, {spec}, 0, 0, 0.0,
+                              b_.intern(wname)));
+        if (!accept(",")) break;
+      }
+    }
+    if (at_keyword("DISTRIBUTE")) {
+      next();
+      expect_keyword("BY");
+      kids.push_back(b_.add(K_DISTRIBUTE_ITEM, {parse_expr()}));
+      while (accept(","))
+        kids.push_back(b_.add(K_DISTRIBUTE_ITEM, {parse_expr()}));
+    }
+    return b_.add(K_SELECT, kids, flags);
+  }
+
+  int32_t parse_group_item() {
+    if (at_keyword("GROUPING") && peek(1).upper == "SETS") {
+      next();
+      next();
+      expect("(");
+      std::vector<int32_t> sets;
+      while (true) {
+        if (accept("(")) {
+          std::vector<int32_t> items;
+          if (!accept(")")) {
+            items.push_back(parse_expr());
+            while (accept(",")) items.push_back(parse_expr());
+            expect(")");
+          }
+          sets.push_back(b_.add(K_SET_NODE, items));
+        } else {
+          sets.push_back(b_.add(K_SET_NODE, {parse_expr()}));
+        }
+        if (!accept(",")) break;
+      }
+      expect(")");
+      return b_.add(K_GROUPING_SETS, sets);
+    }
+    if (at_keyword("ROLLUP") && peek_is(1, "(")) {
+      next();
+      expect("(");
+      std::vector<int32_t> exprs{parse_expr()};
+      while (accept(",")) exprs.push_back(parse_expr());
+      expect(")");
+      return b_.add(K_ROLLUP, exprs);
+    }
+    if (at_keyword("CUBE") && peek_is(1, "(")) {
+      next();
+      expect("(");
+      std::vector<int32_t> exprs{parse_expr()};
+      while (accept(",")) exprs.push_back(parse_expr());
+      expect(")");
+      return b_.add(K_CUBE, exprs);
+    }
+    return parse_expr();
+  }
+
+  int32_t parse_select_item() {
+    int32_t expr = parse_expr();
+    int32_t alias = -1;
+    if (accept_keyword("AS")) {
+      alias = b_.intern(parse_identifier());
+    } else if ((peek().type == T_IDENT || peek().type == T_QUOTED) &&
+               !is_reserved_stop(peek().upper)) {
+      alias = b_.intern(parse_identifier());
+    }
+    return b_.add(K_PROJ_ITEM, {expr}, 0, 0, 0.0, alias);
+  }
+
+  void parse_order_items(std::vector<int32_t>& out) {
+    out.push_back(parse_order_item());
+    while (accept(",")) out.push_back(parse_order_item());
+  }
+
+  int32_t parse_order_item() {
+    int32_t expr = parse_expr();
+    int32_t flags = 1;  // asc
+    if (accept_keyword("ASC")) {
+    } else if (accept_keyword("DESC")) {
+      flags &= ~1;
+    }
+    if (accept_keyword("NULLS")) {
+      flags |= 2;
+      if (accept_keyword("FIRST"))
+        flags |= 4;
+      else
+        expect_keyword("LAST");
+    }
+    return b_.add(K_ORDER_ITEM, {expr}, flags);
+  }
+
+  // -- FROM ---------------------------------------------------------------
+  int32_t parse_table_ref() {
+    int32_t left = parse_table_factor();
+    while (true) {
+      bool natural = accept_keyword("NATURAL");
+      if (accept_keyword("CROSS")) {
+        expect_keyword("JOIN");
+        int32_t right = parse_table_factor();
+        left = b_.add(K_JOIN, {left, right}, 0, 0, 0.0, b_.intern("CROSS"));
+        continue;
+      }
+      std::string join_type;
+      if (accept_keyword("INNER")) {
+        join_type = "INNER";
+      } else if (at_keyword("LEFT") || at_keyword("RIGHT") ||
+                 at_keyword("FULL")) {
+        std::string jt = next().upper;
+        if (jt == "LEFT" && accept_keyword("SEMI")) {
+          join_type = "LEFTSEMI";
+        } else if (jt == "LEFT" && accept_keyword("ANTI")) {
+          join_type = "LEFTANTI";
+        } else {
+          accept_keyword("OUTER");
+          join_type = jt;
+        }
+      } else if (at_keyword("JOIN")) {
+        join_type = "INNER";
+      }
+      if (join_type.empty()) {
+        if (accept(",")) {
+          int32_t right = parse_table_factor();
+          left = b_.add(K_JOIN, {left, right}, 0, 0, 0.0, b_.intern("CROSS"));
+          continue;
+        }
+        break;
+      }
+      expect_keyword("JOIN");
+      int32_t right = parse_table_factor();
+      int32_t flags = 0;
+      std::vector<int32_t> kids{left, right};
+      if (accept_keyword("ON")) {
+        flags |= 1;
+        kids.push_back(parse_expr());
+      } else if (accept_keyword("USING")) {
+        flags |= 2;
+        expect("(");
+        kids.push_back(b_.add(K_USING_COL, {}, 0, 0, 0.0,
+                              b_.intern(parse_identifier())));
+        while (accept(","))
+          kids.push_back(b_.add(K_USING_COL, {}, 0, 0, 0.0,
+                                b_.intern(parse_identifier())));
+        expect(")");
+      } else if (natural) {
+        flags |= 2;  // natural join: empty USING list, resolved in binder
+      }
+      left = b_.add(K_JOIN, kids, flags, 0, 0.0, b_.intern(join_type));
+    }
+    return left;
+  }
+
+  int32_t parse_table_factor() {
+    if (accept("(")) {
+      bool is_query = at_keyword("SELECT") || at_keyword("WITH") ||
+                      at_keyword("VALUES") || peek_is(0, "(");
+      if (!is_query) {
+        int32_t ref = parse_table_ref();
+        expect(")");
+        return ref;
+      }
+      int32_t inner = parse_query();
+      expect(")");
+      std::vector<int32_t> kids{inner};
+      int32_t alias = parse_table_alias(kids);
+      return b_.add(K_DERIVED_TABLE, kids, 0, 0, 0.0, alias);
+    }
+    if (at_keyword("PREDICT") && peek_is(1, "(")) {
+      next();
+      expect("(");
+      expect_keyword("MODEL");
+      std::vector<int32_t> kids = parse_qualified_parts();
+      expect(",");
+      kids.push_back(parse_query());
+      expect(")");
+      int32_t alias = parse_table_alias(kids);
+      return b_.add(K_TABLE_FUNC, kids, 0, 0, 0.0, b_.intern("PREDICT"),
+                    alias);
+    }
+    std::vector<int32_t> kids = parse_qualified_parts();
+    int32_t flags = 0;
+    double frac = 0.0;
+    int64_t seed = -1;
+    int32_t method = -1;
+    if (accept_keyword("TABLESAMPLE")) {
+      flags |= 1;
+      std::string m = "BERNOULLI";
+      if (accept_keyword("SYSTEM"))
+        m = "SYSTEM";
+      else if (accept_keyword("BERNOULLI"))
+        m = "BERNOULLI";
+      expect("(");
+      const Token& t = next();
+      char* end = nullptr;
+      frac = std::strtod(t.value.c_str(), &end);
+      expect(")");
+      if (accept_keyword("REPEATABLE")) {
+        expect("(");
+        seed = parse_int_token();
+        expect(")");
+      }
+      method = b_.intern(m);
+    }
+    int32_t alias = parse_table_alias(kids);
+    return b_.add(K_NAMED_TABLE, kids, flags, seed, frac, alias, method);
+  }
+
+  // returns interned alias or -1; appends ALIAS_COL children for t(a, b)
+  int32_t parse_table_alias(std::vector<int32_t>& kids) {
+    std::string alias;
+    if (accept_keyword("AS")) {
+      alias = parse_identifier();
+    } else if ((peek().type == T_IDENT || peek().type == T_QUOTED) &&
+               !is_reserved_stop(peek().upper)) {
+      alias = parse_identifier();
+    } else {
+      return -1;
+    }
+    if (accept("(")) {
+      kids.push_back(b_.add(K_ALIAS_COL, {}, 0, 0, 0.0,
+                            b_.intern(parse_identifier())));
+      while (accept(","))
+        kids.push_back(b_.add(K_ALIAS_COL, {}, 0, 0, 0.0,
+                              b_.intern(parse_identifier())));
+      expect(")");
+    }
+    return b_.intern(alias);
+  }
+
+  // -- expressions (Pratt, mirroring parser.py precedence) ----------------
+  int32_t parse_expr() { return parse_or(); }
+
+  int32_t parse_or() {
+    int32_t left = parse_and();
+    while (accept_keyword("OR"))
+      left = b_.add(K_BINARY, {left, parse_and()}, 0, 0, 0.0, b_.intern("OR"));
+    return left;
+  }
+
+  int32_t parse_and() {
+    int32_t left = parse_not();
+    while (accept_keyword("AND"))
+      left = b_.add(K_BINARY, {left, parse_not()}, 0, 0, 0.0,
+                    b_.intern("AND"));
+    return left;
+  }
+
+  int32_t parse_not() {
+    if (accept_keyword("NOT"))
+      return b_.add(K_UNARY, {parse_not()}, 0, 0, 0.0, b_.intern("NOT"));
+    return parse_predicate();
+  }
+
+  int32_t parse_predicate() {
+    int32_t left = parse_comparison();
+    while (true) {
+      bool negated = false;
+      size_t save = pos_;
+      if (accept_keyword("NOT")) negated = true;
+      if (accept_keyword("BETWEEN")) {
+        bool symmetric = accept_keyword("SYMMETRIC");
+        int32_t low = parse_comparison();
+        expect_keyword("AND");
+        int32_t high = parse_comparison();
+        left = b_.add(K_BETWEEN, {left, low, high},
+                      (negated ? 1 : 0) | (symmetric ? 2 : 0));
+        continue;
+      }
+      if (accept_keyword("IN")) {
+        expect("(");
+        if (at_keyword("SELECT") || at_keyword("WITH")) {
+          int32_t sub = parse_query();
+          expect(")");
+          left = b_.add(K_INSUBQ, {left, sub}, negated ? 1 : 0);
+        } else {
+          std::vector<int32_t> kids{left, parse_expr()};
+          while (accept(",")) kids.push_back(parse_expr());
+          expect(")");
+          left = b_.add(K_INLIST, kids, negated ? 1 : 0);
+        }
+        continue;
+      }
+      if (at_keyword("LIKE") || at_keyword("ILIKE")) {
+        bool ci = next().upper == "ILIKE";
+        int32_t pattern = parse_comparison();
+        int32_t esc = -1;
+        if (accept_keyword("ESCAPE")) esc = b_.intern(next().value);
+        left = b_.add(K_LIKE, {left, pattern},
+                      (negated ? 1 : 0) | (ci ? 2 : 0) |
+                          (esc >= 0 ? 8 : 0), 0, 0.0, esc);
+        continue;
+      }
+      if (accept_keyword("SIMILAR")) {
+        expect_keyword("TO");
+        int32_t pattern = parse_comparison();
+        int32_t esc = -1;
+        if (accept_keyword("ESCAPE")) esc = b_.intern(next().value);
+        left = b_.add(K_LIKE, {left, pattern},
+                      (negated ? 1 : 0) | 4 | (esc >= 0 ? 8 : 0), 0, 0.0,
+                      esc);
+        continue;
+      }
+      if (negated) {
+        pos_ = save;
+        break;
+      }
+      if (accept_keyword("IS")) {
+        bool neg = accept_keyword("NOT");
+        if (accept_keyword("NULL")) {
+          left = b_.add(K_ISNULL, {left}, neg ? 1 : 0);
+        } else if (accept_keyword("TRUE")) {
+          left = b_.add(K_ISBOOL, {left}, (neg ? 1 : 0) | 2);
+        } else if (accept_keyword("FALSE")) {
+          left = b_.add(K_ISBOOL, {left}, neg ? 1 : 0);
+        } else if (accept_keyword("UNKNOWN")) {
+          left = b_.add(K_ISNULL, {left}, neg ? 1 : 0);
+        } else if (accept_keyword("DISTINCT")) {
+          expect_keyword("FROM");
+          int32_t right = parse_comparison();
+          left = b_.add(K_ISDIST, {left, right}, neg ? 1 : 0);
+        } else {
+          error("Expected NULL/TRUE/FALSE/DISTINCT FROM after IS");
+        }
+        continue;
+      }
+      break;
+    }
+    return left;
+  }
+
+  int32_t parse_comparison() {
+    int32_t left = parse_additive();
+    const Token& t = peek();
+    if (t.type == T_OP &&
+        (t.value == "=" || t.value == "<>" || t.value == "!=" ||
+         t.value == "<" || t.value == "<=" || t.value == ">" ||
+         t.value == ">=")) {
+      std::string op = next().value;
+      if (op == "!=") op = "<>";
+      if (at_keyword("ANY") || at_keyword("SOME") || at_keyword("ALL")) {
+        std::string quant = next().upper;
+        expect("(");
+        int32_t sub = parse_query();
+        expect(")");
+        if (op == "=" && (quant == "ANY" || quant == "SOME"))
+          return b_.add(K_INSUBQ, {left, sub}, 0);
+        if (op == "<>" && quant == "ALL")
+          return b_.add(K_INSUBQ, {left, sub}, 1);
+        error("Unsupported quantified comparison " + op + " " + quant);
+      }
+      int32_t right = parse_additive();
+      return b_.add(K_BINARY, {left, right}, 0, 0, 0.0, b_.intern(op));
+    }
+    return left;
+  }
+
+  int32_t parse_additive() {
+    int32_t left = parse_multiplicative();
+    while (true) {
+      const Token& t = peek();
+      if (t.type == T_OP &&
+          (t.value == "+" || t.value == "-" || t.value == "||")) {
+        std::string op = next().value;
+        left = b_.add(K_BINARY, {left, parse_multiplicative()}, 0, 0, 0.0,
+                      b_.intern(op));
+      } else {
+        break;
+      }
+    }
+    return left;
+  }
+
+  int32_t parse_multiplicative() {
+    int32_t left = parse_unary();
+    while (true) {
+      const Token& t = peek();
+      if (t.type == T_OP &&
+          (t.value == "*" || t.value == "/" || t.value == "%")) {
+        std::string op = next().value;
+        left = b_.add(K_BINARY, {left, parse_unary()}, 0, 0, 0.0,
+                      b_.intern(op));
+      } else {
+        break;
+      }
+    }
+    return left;
+  }
+
+  int32_t parse_unary() {
+    const Token& t = peek();
+    if (t.type == T_OP && (t.value == "-" || t.value == "+")) {
+      bool minus = t.value == "-";
+      next();
+      int32_t operand = parse_unary();
+      if (minus) {
+        Node& n = b_.nodes[operand];
+        if (n.kind == K_LIT_INT) {
+          n.ival = -n.ival;
+          return operand;
+        }
+        if (n.kind == K_LIT_FLOAT) {
+          n.dval = -n.dval;
+          return operand;
+        }
+        return b_.add(K_UNARY, {operand}, 0, 0, 0.0, b_.intern("-"));
+      }
+      return operand;
+    }
+    return parse_postfix();
+  }
+
+  int32_t parse_postfix() {
+    int32_t expr = parse_primary();
+    while (true) {
+      if (accept("::")) {
+        std::string type_name = parse_type_name();
+        expr = b_.add(K_CAST, {expr}, 0, 0, 0.0, b_.intern(type_name));
+        continue;
+      }
+      break;
+    }
+    return expr;
+  }
+
+  std::string parse_type_name() {
+    std::string name = upper_of(parse_identifier());
+    while (peek().type == T_IDENT) {
+      const std::string& up = peek().upper;
+      if (up == "PRECISION" || up == "VARYING" || up == "WITHOUT" ||
+          up == "WITH" || up == "TIME" || up == "ZONE" || up == "LOCAL") {
+        name += " " + next().upper;
+      } else {
+        break;
+      }
+    }
+    if (accept("(")) {
+      name += "(";
+      name += next().value;
+      while (accept(",")) {
+        name += ",";
+        name += next().value;
+      }
+      expect(")");
+      name += ")";
+    }
+    return name;
+  }
+
+  // -- primary ------------------------------------------------------------
+  int32_t parse_primary() {
+    const Token& t = peek();
+    if (t.type == T_NUMBER) {
+      std::string text = next().value;
+      return number_literal(text);
+    }
+    if (t.type == T_STRING) {
+      return b_.add(K_LIT_STR, {}, 0, 0, 0.0, b_.intern(next().value));
+    }
+    if (t.type == T_PARAM) {
+      next();
+      return b_.add(K_LIT_NULL, {});
+    }
+    if (peek_is(0, "(")) {
+      next();
+      if (at_keyword("SELECT") || at_keyword("WITH")) {
+        int32_t sub = parse_query();
+        expect(")");
+        return b_.add(K_SCALARSUBQ, {sub});
+      }
+      int32_t expr = parse_expr();
+      if (accept(",")) {  // row constructor -> function ROW
+        std::vector<int32_t> items{expr, parse_expr()};
+        while (accept(",")) items.push_back(parse_expr());
+        expect(")");
+        return b_.add(K_FUNCALL, items, 0,
+                      static_cast<int64_t>(items.size()), 0.0,
+                      b_.intern("ROW"));
+      }
+      expect(")");
+      return expr;
+    }
+    if (peek_is(0, "*")) {
+      next();
+      return b_.add(K_WILDCARD, {}, 0);
+    }
+    if (t.type == T_QUOTED) return parse_identifier_chain();
+    if (t.type != T_IDENT) error("Expected expression");
+    const std::string up = t.upper;
+    if (up == "NULL") { next(); return b_.add(K_LIT_NULL, {}); }
+    if (up == "TRUE") { next(); return b_.add(K_LIT_BOOL, {}, 0, 1); }
+    if (up == "FALSE") { next(); return b_.add(K_LIT_BOOL, {}, 0, 0); }
+    if ((up == "DATE" || up == "TIMESTAMP" || up == "TIME") &&
+        peek(1).type == T_STRING) {
+      next();
+      std::string val = next().value;
+      return b_.add(K_LIT_TYPED, {}, 0, 0, 0.0, b_.intern(val),
+                    b_.intern(up));
+    }
+    if (up == "INTERVAL") {
+      next();
+      bool neg = accept("-");
+      const Token& vt = next();
+      std::string value = vt.value;
+      std::string unit = "SECOND";
+      if (peek().type == T_IDENT &&
+          is_datetime_unit(strip_trailing_s(peek().upper))) {
+        unit = strip_trailing_s(next().upper);
+        if (accept_keyword("TO")) unit += " TO " + strip_trailing_s(next().upper);
+      }
+      return b_.add(K_INTERVAL, {}, 0, 0, 0.0,
+                    b_.intern((neg ? "-" : "") + value), b_.intern(unit));
+    }
+    if (up == "CASE") return parse_case();
+    if (up == "CAST" || up == "TRY_CAST") {
+      next();
+      expect("(");
+      int32_t operand = parse_expr();
+      expect_keyword("AS");
+      std::string type_name = parse_type_name();
+      expect(")");
+      return b_.add(K_CAST, {operand}, up == "TRY_CAST" ? 1 : 0, 0, 0.0,
+                    b_.intern(type_name));
+    }
+    if (up == "EXTRACT") {
+      next();
+      expect("(");
+      std::string unit =
+          peek().type == T_IDENT ? next().upper : upper_of(next().value);
+      expect_keyword("FROM");
+      int32_t operand = parse_expr();
+      expect(")");
+      return b_.add(K_EXTRACT, {operand}, 0, 0, 0.0, b_.intern(unit));
+    }
+    if (up == "SUBSTRING" && peek_is(1, "(")) {
+      next();
+      expect("(");
+      int32_t operand = parse_expr();
+      int32_t flags = 0;
+      std::vector<int32_t> kids{operand};
+      if (accept_keyword("FROM")) {
+        flags |= 1;
+        kids.push_back(parse_expr());
+        if (accept_keyword("FOR")) {
+          flags |= 2;
+          kids.push_back(parse_expr());
+        }
+      } else if (accept(",")) {
+        flags |= 1;
+        kids.push_back(parse_expr());
+        if (accept(",")) {
+          flags |= 2;
+          kids.push_back(parse_expr());
+        }
+      }
+      expect(")");
+      return b_.add(K_SUBSTRING, kids, flags);
+    }
+    if (up == "TRIM" && peek_is(1, "(")) {
+      next();
+      expect("(");
+      std::string where = "BOTH";
+      if (at_keyword("LEADING") || at_keyword("TRAILING") ||
+          at_keyword("BOTH"))
+        where = next().upper;
+      int32_t operand = -1, chars = -1;
+      if (peek().type == T_STRING) {
+        chars = b_.add(K_LIT_STR, {}, 0, 0, 0.0, b_.intern(next().value));
+        if (accept_keyword("FROM")) {
+          operand = parse_expr();
+        } else {
+          operand = chars;
+          chars = -1;
+        }
+      } else if (accept_keyword("FROM")) {
+        operand = parse_expr();
+      } else {
+        operand = parse_expr();
+        if (accept_keyword("FROM")) {
+          chars = operand;
+          operand = parse_expr();
+        }
+      }
+      expect(")");
+      std::vector<int32_t> kids{operand};
+      int32_t flags = 0;
+      if (chars >= 0) {
+        flags |= 1;
+        kids.push_back(chars);
+      }
+      return b_.add(K_TRIM, kids, flags, 0, 0.0, b_.intern(where));
+    }
+    if (up == "POSITION" && peek_is(1, "(")) {
+      next();
+      expect("(");
+      int32_t needle = parse_additive();  // stop before IN: it's the separator
+      expect_keyword("IN");
+      int32_t hay = parse_expr();
+      expect(")");
+      return b_.add(K_POSITION, {needle, hay});
+    }
+    if (up == "OVERLAY" && peek_is(1, "(")) {
+      next();
+      expect("(");
+      int32_t operand = parse_expr();
+      expect_keyword("PLACING");
+      int32_t repl = parse_expr();
+      expect_keyword("FROM");
+      int32_t start = parse_expr();
+      int32_t flags = 0;
+      std::vector<int32_t> kids{operand, repl, start};
+      if (accept_keyword("FOR")) {
+        flags |= 1;
+        kids.push_back(parse_expr());
+      }
+      expect(")");
+      return b_.add(K_OVERLAY, kids, flags);
+    }
+    if ((up == "CEIL" || up == "CEILING" || up == "FLOOR") &&
+        peek_is(1, "(")) {
+      next();
+      expect("(");
+      int32_t operand = parse_expr();
+      std::string func = (up == "FLOOR") ? "FLOOR" : "CEIL";
+      if (accept_keyword("TO")) {
+        std::string unit = next().upper;
+        expect(")");
+        return b_.add(K_CEILFLOORTO, {operand}, 0, 0, 0.0, b_.intern(func),
+                      b_.intern(unit));
+      }
+      expect(")");
+      return b_.add(K_FUNCALL, {operand}, 0, 1, 0.0, b_.intern(func));
+    }
+    if ((up == "TIMESTAMPADD" || up == "TIMESTAMPDIFF" || up == "DATEDIFF") &&
+        peek_is(1, "(")) {
+      next();
+      expect("(");
+      const Token& ut = next();
+      std::string unit = ut.type == T_STRING ? ut.value : ut.upper;
+      expect(",");
+      std::vector<int32_t> kids;
+      kids.push_back(b_.add(K_LIT_STR, {}, 0, 0, 0.0, b_.intern(unit)));
+      kids.push_back(parse_expr());
+      expect(",");
+      kids.push_back(parse_expr());
+      expect(")");
+      return b_.add(K_FUNCALL, kids, 0, 3, 0.0, b_.intern(up));
+    }
+    if (up == "EXISTS" && peek_is(1, "(")) {
+      next();
+      expect("(");
+      int32_t sub = parse_query();
+      expect(")");
+      return b_.add(K_EXISTS, {sub}, 0);
+    }
+    if (peek_is(1, "(")) return parse_function_call();
+    return parse_identifier_chain();
+  }
+
+  int32_t parse_identifier_chain() {
+    bool q = false;
+    std::string name = parse_identifier(&q);
+    std::vector<int32_t> parts;
+    parts.push_back(b_.add(K_PART, {}, q ? 1 : 0, 0, 0.0, b_.intern(name)));
+    while (accept(".")) {
+      if (peek_is(0, "*")) {
+        next();
+        return b_.add(K_WILDCARD, parts, 1);
+      }
+      name = parse_identifier(&q);
+      parts.push_back(b_.add(K_PART, {}, q ? 1 : 0, 0, 0.0, b_.intern(name)));
+    }
+    return b_.add(K_IDENT, parts);
+  }
+
+  int32_t parse_case() {
+    expect_keyword("CASE");
+    int32_t flags = 0;
+    std::vector<int32_t> kids;
+    if (!at_keyword("WHEN")) {
+      flags |= 1;
+      kids.push_back(parse_expr());
+    }
+    while (accept_keyword("WHEN")) {
+      kids.push_back(parse_expr());
+      expect_keyword("THEN");
+      kids.push_back(parse_expr());
+    }
+    if (accept_keyword("ELSE")) {
+      flags |= 2;
+      kids.push_back(parse_expr());
+    }
+    expect_keyword("END");
+    return b_.add(K_CASE, kids, flags);
+  }
+
+  int32_t parse_function_call() {
+    std::string name = parse_identifier();
+    expect("(");
+    int32_t flags = 0;
+    std::vector<int32_t> args;
+    if (!accept(")")) {
+      if (accept_keyword("DISTINCT"))
+        flags |= 1;
+      else
+        accept_keyword("ALL");
+      if (peek_is(0, "*")) {
+        next();
+        args.push_back(b_.add(K_WILDCARD, {}, 0));
+      } else {
+        args.push_back(parse_expr());
+      }
+      while (accept(",")) args.push_back(parse_expr());
+      expect(")");
+    }
+    if (accept_keyword("IGNORE")) {
+      expect_keyword("NULLS");
+      flags |= 2;
+    } else if (accept_keyword("RESPECT")) {
+      expect_keyword("NULLS");
+    }
+    if (at_keyword("WITHIN")) {
+      // PERCENTILE_CONT(q) WITHIN GROUP (ORDER BY x) -> (x, q)
+      next();
+      expect_keyword("GROUP");
+      expect("(");
+      expect_keyword("ORDER");
+      expect_keyword("BY");
+      int32_t order_expr = parse_expr();
+      bool desc = false;
+      if (accept_keyword("DESC"))
+        desc = true;
+      else
+        accept_keyword("ASC");
+      expect(")");
+      double qv;
+      bool have_q = false;
+      if (!args.empty()) {
+        const Node& a0 = b_.nodes[args[0]];
+        if (a0.kind == K_LIT_INT) { qv = static_cast<double>(a0.ival); have_q = true; }
+        if (a0.kind == K_LIT_FLOAT) { qv = a0.dval; have_q = true; }
+      }
+      if (!have_q)
+        throw ParseErr{peek().pos,
+                       "WITHIN GROUP requires a numeric literal fraction, "
+                       "e.g. PERCENTILE_CONT(0.5) WITHIN GROUP (ORDER BY x)"};
+      if (desc) qv = 1.0 - qv;
+      args.clear();
+      args.push_back(order_expr);
+      args.push_back(b_.add(K_LIT_FLOAT, {}, 0, 0, qv));
+    }
+    int64_t n_args = static_cast<int64_t>(args.size());
+    if (at_keyword("FILTER") && peek_is(1, "(")) {
+      next();
+      expect("(");
+      expect_keyword("WHERE");
+      flags |= 4;
+      args.push_back(parse_expr());
+      expect(")");
+    }
+    int32_t over_name = -1;
+    if (accept_keyword("OVER")) {
+      if (peek_is(0, "(")) {
+        flags |= 8;
+        args.push_back(parse_window_spec());
+      } else {
+        flags |= 16;
+        over_name = b_.intern(parse_identifier());
+      }
+    }
+    return b_.add(K_FUNCALL, args, flags, n_args, 0.0,
+                  b_.intern(upper_of(name)), over_name);
+  }
+
+  int32_t parse_window_spec() {
+    expect("(");
+    std::vector<int32_t> kids;
+    int64_t npart = 0;
+    int32_t flags = 0;
+    if (accept_keyword("PARTITION")) {
+      expect_keyword("BY");
+      kids.push_back(parse_expr());
+      ++npart;
+      while (accept(",")) {
+        kids.push_back(parse_expr());
+        ++npart;
+      }
+    }
+    if (accept_keyword("ORDER")) {
+      expect_keyword("BY");
+      parse_order_items(kids);
+    }
+    if (at_keyword("ROWS") || at_keyword("RANGE")) {
+      std::string units = next().upper;
+      int32_t skind, ekind;
+      std::vector<int32_t> fkids;
+      int32_t fflags = 0;
+      if (accept_keyword("BETWEEN")) {
+        skind = parse_frame_bound(fkids, fflags, 1);
+        expect_keyword("AND");
+        ekind = parse_frame_bound(fkids, fflags, 2);
+      } else {
+        skind = parse_frame_bound(fkids, fflags, 1);
+        ekind = FB_CUR;
+      }
+      flags |= 1;
+      kids.push_back(b_.add(K_FRAME, fkids, fflags,
+                            static_cast<int64_t>(skind) |
+                                (static_cast<int64_t>(ekind) << 8),
+                            0.0, b_.intern(units)));
+    }
+    expect(")");
+    return b_.add(K_WINSPEC, kids, flags, npart);
+  }
+
+  int32_t parse_frame_bound(std::vector<int32_t>& fkids, int32_t& fflags,
+                            int32_t which) {
+    if (accept_keyword("UNBOUNDED")) {
+      if (accept_keyword("PRECEDING")) return FB_UNB_PRE;
+      expect_keyword("FOLLOWING");
+      return FB_UNB_FOL;
+    }
+    if (accept_keyword("CURRENT")) {
+      expect_keyword("ROW");
+      return FB_CUR;
+    }
+    int32_t offset = parse_expr();
+    fkids.push_back(offset);
+    fflags |= which;
+    if (accept_keyword("PRECEDING")) return FB_PRE;
+    expect_keyword("FOLLOWING");
+    return FB_FOL;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// rc: 0 = ok (buffer = flat AST); 1 = unsupported statement (fall back to
+// the Python parser; *out null); 2 = parse error (*out = int64 pos + msg).
+int32_t dsql_parse(const char* sql, int64_t n, uint8_t** out,
+                   int64_t* out_len) {
+  *out = nullptr;
+  *out_len = 0;
+  std::vector<Token> toks;
+  int64_t errpos = 0;
+  if (!lex(sql, n, toks, &errpos)) {
+    std::string msg = "Lex error";
+    size_t total = 8 + msg.size();
+    uint8_t* buf = static_cast<uint8_t*>(std::malloc(total));
+    if (!buf) return 1;
+    std::memcpy(buf, &errpos, 8);
+    std::memcpy(buf + 8, msg.data(), msg.size());
+    *out = buf;
+    *out_len = static_cast<int64_t>(total);
+    return 2;
+  }
+  try {
+    Builder b;
+    Parser p(sql, n, std::move(toks), b);
+    int32_t root = p.parse_statements();
+    uint8_t* buf = b.serialize(root, out_len);
+    if (!buf) return 1;
+    *out = buf;
+    return 0;
+  } catch (const Unsupported&) {
+    return 1;
+  } catch (const ParseErr& e) {
+    size_t total = 8 + e.msg.size();
+    uint8_t* buf = static_cast<uint8_t*>(std::malloc(total));
+    if (!buf) return 1;
+    std::memcpy(buf, &e.pos, 8);
+    std::memcpy(buf + 8, e.msg.data(), e.msg.size());
+    *out = buf;
+    *out_len = static_cast<int64_t>(total);
+    return 2;
+  } catch (...) {
+    return 1;
+  }
+}
+
+void dsql_buf_free(uint8_t* p) { std::free(p); }
+
+int32_t dsql_parser_abi_version() { return 1; }
+
+}  // extern "C"
