@@ -1,0 +1,97 @@
+"""Phase profiler for the TPC-H Q1 bench: where does end-to-end time go?
+
+Phases: parse+plan / execute-dispatch / device-sync / to_pandas, plus the raw
+compiled-kernel time (direct call on resident device buffers) as the floor.
+Prints one JSON line per phase.  Run on the real chip:  python benchmarks/profile_q1.py
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+from bench import N_ROWS, QUERY, gen_lineitem, _ensure_backend  # noqa: E402
+
+
+def main():
+    _ensure_backend()
+    import jax
+
+    from dask_sql_tpu import Context
+    from dask_sql_tpu.planner.parser import parse_sql
+
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else N_ROWS
+    df = gen_lineitem(n)
+
+    c = Context()
+    t0 = time.perf_counter()
+    c.create_table("lineitem", df)
+    t_create = time.perf_counter() - t0
+
+    # warm-up: compile + caches
+    c.sql(QUERY).compute()
+
+    phases = {"create_table_s": round(t_create, 3), "rows": n,
+              "backend": jax.default_backend()}
+
+    # 1. parse + plan
+    reps = 5
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        stmt = parse_sql(QUERY)[0]
+        plan = c._get_ral(stmt)
+    phases["plan_ms"] = round((time.perf_counter() - t0) / reps * 1000, 2)
+
+    # 2. full execute to device table (dispatch incl. any host work)
+    from dask_sql_tpu.physical.executor import Executor
+
+    times = {"exec": [], "sync": [], "pandas": []}
+    for _ in range(3):
+        ex = Executor(c)
+        t0 = time.perf_counter()
+        table = ex.execute(plan)
+        t1 = time.perf_counter()
+        for col in table.columns.values():
+            jax.block_until_ready(col.data)
+        t2 = time.perf_counter()
+        table.to_pandas()
+        t3 = time.perf_counter()
+        times["exec"].append(t1 - t0)
+        times["sync"].append(t2 - t1)
+        times["pandas"].append(t3 - t2)
+    for k, v in times.items():
+        phases[f"{k}_ms"] = round(min(v) * 1000, 2)
+
+    # 3. compiled-kernel floor: direct call on the cached CompiledAggregate
+    from dask_sql_tpu.physical import compiled as C
+
+    if C._cache:
+        ca = next(iter(C._cache.values()))
+        datas = [ca.table.columns[nm].data for nm in ca.table.column_names]
+        valids = [ca.table.columns[nm].validity for nm in ca.table.column_names]
+        flat = ca._fn(tuple(datas), tuple(valids))
+        jax.block_until_ready(flat)
+        t0 = time.perf_counter()
+        for _ in range(5):
+            flat = ca._fn(tuple(datas), tuple(valids))
+            jax.block_until_ready(flat)
+        phases["kernel_ms"] = round((time.perf_counter() - t0) / 5 * 1000, 2)
+        t0 = time.perf_counter()
+        for _ in range(3):
+            ca.run()
+        phases["kernel_plus_decode_ms"] = round(
+            (time.perf_counter() - t0) / 3 * 1000, 2)
+
+    # 4. end-to-end (the bench number)
+    t0 = time.perf_counter()
+    c.sql(QUERY).compute()
+    phases["end_to_end_ms"] = round((time.perf_counter() - t0) * 1000, 2)
+    phases["rows_per_sec"] = round(n / (phases["end_to_end_ms"] / 1000), 0)
+
+    print(json.dumps(phases))
+
+
+if __name__ == "__main__":
+    main()

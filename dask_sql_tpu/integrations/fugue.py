@@ -1,11 +1,17 @@
-"""FugueSQL execution-engine adapter (parity: reference integrations/fugue.py:22-70
-— registers a dask-sql based SQL engine with fugue).  Gated on the optional
-`fugue` dependency, exactly like the reference."""
+"""FugueSQL execution-engine adapter.
+
+Parity: reference integrations/fugue.py — a SqlEngine that routes FugueSQL
+SELECT statements through this engine (DaskSQLEngine, fugue.py:41-70 there),
+a full ExecutionEngine subclass with that SQL engine pre-configured
+(DaskSQLExecutionEngine, fugue.py:73-92), and entrypoint registration that
+overwrites fugue's default engine (fugue.py:21-38).  Gated on the optional
+`fugue` dependency exactly like the reference.
+"""
 from __future__ import annotations
 
 try:  # pragma: no cover - optional dependency
     import fugue
-    from fugue import ExecutionEngine, SqlEngine
+    from fugue import SqlEngine
 
     _HAS_FUGUE = True
 except ImportError:  # pragma: no cover
@@ -15,22 +21,52 @@ except ImportError:  # pragma: no cover
 if _HAS_FUGUE:  # pragma: no cover - optional dependency
 
     class TpuSQLEngine(SqlEngine):
-        """Fugue SqlEngine backed by a dask_sql_tpu Context."""
+        """Fugue SqlEngine backed by a dask_sql_tpu Context
+        (parity: DaskSQLEngine, reference fugue.py:41)."""
 
-        def __init__(self, execution_engine=None):
-            super().__init__(execution_engine)
-            from ..context import Context
-
-            self._context = Context()
+        @property
+        def is_distributed(self) -> bool:
+            return True
 
         def select(self, dfs, statement):
-            import pandas as pd
+            from ..context import Context
 
+            context = Context()
             for name, df in dfs.items():
-                self._context.create_table(name, df.as_pandas())
-            result = self._context.sql(
+                context.create_table(name, df.as_pandas())
+            result = context.sql(
                 statement if isinstance(statement, str) else statement.construct())
             return fugue.dataframe.PandasDataFrame(result.compute())
+
+    try:
+        from fugue import NativeExecutionEngine
+
+        class TpuSQLExecutionEngine(NativeExecutionEngine):
+            """ExecutionEngine with the TPU SQL engine pre-configured
+            (parity: DaskSQLExecutionEngine, reference fugue.py:73)."""
+
+            def create_default_sql_engine(self) -> SqlEngine:
+                return TpuSQLEngine(self)
+
+    except ImportError:
+        TpuSQLExecutionEngine = None  # type: ignore[assignment]
+
+    def register_engines() -> None:
+        """Register (overwrite) fugue's engine to route SQL through this
+        engine (parity: _register_engines entrypoint, reference fugue.py:21)."""
+        from fugue import register_execution_engine
+
+        if TpuSQLExecutionEngine is not None:
+            register_execution_engine(
+                "tpu",
+                lambda conf, **kwargs: TpuSQLExecutionEngine(conf=conf),
+                on_dup="overwrite",
+            )
+
+    try:  # auto-register like the reference's @run_at_def
+        register_engines()
+    except Exception:  # pragma: no cover - registration best-effort
+        pass
 
 else:
 
@@ -38,3 +74,12 @@ else:
         def __init__(self, *args, **kwargs):
             raise ImportError(
                 "fugue is not installed; `pip install fugue` to use the adapter")
+
+    class TpuSQLExecutionEngine:  # type: ignore[no-redef]
+        def __init__(self, *args, **kwargs):
+            raise ImportError(
+                "fugue is not installed; `pip install fugue` to use the adapter")
+
+    def register_engines() -> None:
+        raise ImportError(
+            "fugue is not installed; `pip install fugue` to use the adapter")

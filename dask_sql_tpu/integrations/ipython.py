@@ -24,3 +24,16 @@ def ipython_integration(context, auto_include: bool = False,
         return result.compute() if result is not None else None
 
     register_line_cell_magic(sql)
+
+    if not disable_highlighting:
+        # best-effort SQL syntax highlighting of %%sql cells in classic
+        # notebooks (parity: the reference's codemirror magic_spec injection)
+        try:
+            from IPython.display import Javascript, display
+
+            display(Javascript(
+                "if (window.IPython && IPython.CodeCell) {"
+                "IPython.CodeCell.options_default.highlight_modes"
+                "['magic_text/x-sql'] = {'reg': [/^%%sql/]};}"))
+        except Exception:
+            pass

@@ -72,6 +72,11 @@ def _make_handler(context, registry: _QueryRegistry, jdbc_meta: bool):
                 return
             length = int(self.headers.get("Content-Length", 0))
             sql = self.rfile.read(length).decode()
+            if jdbc_meta:
+                # JDBC drivers query the unsupported `system` catalog
+                from .presto_jdbc import adjust_for_presto_sql
+
+                sql = adjust_for_presto_sql(sql)
             if not sql.strip():
                 self._send(self._empty_results())
                 return

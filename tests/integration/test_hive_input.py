@@ -1,0 +1,108 @@
+"""Hive input plugin against a fake cursor (the in-image analogue of the
+reference's dockerized Hive integration test, test_hive.py:39-70 there:
+DESCRIBE FORMATTED metadata -> storage location -> registered files)."""
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from tests.utils import assert_eq
+
+
+class FakeHiveCursor:
+    """Scripted pyhive-like cursor: execute() + fetchall()."""
+
+    def __init__(self, responses):
+        self.responses = responses
+        self._rows = []
+        self.executed = []
+
+    def execute(self, sql):
+        self.executed.append(sql)
+        for prefix, rows in self.responses.items():
+            if sql.startswith(prefix):
+                self._rows = rows
+                return
+        raise RuntimeError(f"unexpected hive query: {sql}")
+
+    def fetchall(self):
+        return self._rows
+
+
+@pytest.fixture
+def hive_parquet(tmp_path):
+    df = pd.DataFrame({
+        "i": np.arange(10, dtype=np.int64),
+        "v": np.arange(10, dtype=np.float64) * 1.5,
+    })
+    loc = tmp_path / "warehouse" / "tbl"
+    loc.mkdir(parents=True)
+    df.to_parquet(loc / "part-000.parquet")
+    return df, str(loc)
+
+
+def test_hive_unpartitioned(hive_parquet):
+    from dask_sql_tpu import Context
+
+    df, loc = hive_parquet
+    cursor = FakeHiveCursor({
+        "DESCRIBE FORMATTED": [
+            ("# col_name", "data_type", "comment"),
+            ("i", "bigint", ""),
+            ("v", "double", ""),
+            ("Location:", f"file:{loc}", ""),
+            ("InputFormat:", "org.apache.hadoop.hive.ql.io.parquet"
+             ".MapredParquetInputFormat", ""),
+        ],
+        "SHOW PARTITIONS": [],
+    })
+    c = Context()
+    c.create_table("t", cursor)
+    result = c.sql("SELECT i, v FROM t", return_futures=False)
+    assert_eq(result, df, check_dtype=False, sort_results=True)
+    assert any(s.startswith("DESCRIBE FORMATTED") for s in cursor.executed)
+
+
+def test_hive_partitioned(tmp_path):
+    from dask_sql_tpu import Context
+
+    loc = tmp_path / "warehouse" / "ptbl"
+    frames = []
+    for part in ("p=a", "p=b"):
+        d = loc / part
+        d.mkdir(parents=True)
+        df = pd.DataFrame({"x": np.arange(3, dtype=np.int64)})
+        df.to_parquet(d / "part-000.parquet")
+        frames.append(df.assign(p=part.split("=")[1]))
+    expected = pd.concat(frames, ignore_index=True)
+
+    cursor = FakeHiveCursor({
+        "DESCRIBE FORMATTED": [
+            ("x", "bigint", ""),
+            ("Location:", f"file:{loc}", ""),
+            ("InputFormat:", "parquet", ""),
+        ],
+        "SHOW PARTITIONS": [("p=a",), ("p=b",)],
+    })
+    c = Context()
+    c.create_table("pt", cursor)
+    result = c.sql("SELECT x, p FROM pt", return_futures=False)
+    assert_eq(result, expected, check_dtype=False, sort_results=True)
+
+
+def test_hive_unsupported_format(hive_parquet):
+    from dask_sql_tpu import Context
+
+    _, loc = hive_parquet
+    cursor = FakeHiveCursor({
+        "DESCRIBE FORMATTED": [
+            ("Location:", f"file:{loc}", ""),
+            ("InputFormat:", "org.apache.hadoop.hive.ql.io.orc"
+             ".OrcInputFormat", ""),
+        ],
+        "SHOW PARTITIONS": [],
+    })
+    c = Context()
+    with pytest.raises(NotImplementedError):
+        c.create_table("t", cursor)

@@ -74,8 +74,10 @@ def test_server_jdbc_metadata(c):
         assert SYSTEM_SCHEMA in c.schema
         port = srv.port
         payload = _follow(port, _post(
-            port, f"SELECT * FROM {SYSTEM_SCHEMA}.tables"))
-        names = [row[1] for row in payload["data"]]
+            port, "SELECT * FROM system.jdbc.tables"))  # driver-style path
+        cols = [col["name"] for col in payload["columns"]]
+        name_idx = cols.index("TABLE_NAME")
+        names = [row[name_idx] for row in payload["data"]]
         assert "df_simple" in names
     finally:
         srv.shutdown()

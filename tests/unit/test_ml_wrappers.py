@@ -1,0 +1,100 @@
+"""ML wrapper semantics (parity: reference tests/unit/test_ml_utils.py over
+wrappers.py — ParallelPostFit metas/scoring honored, Incremental block
+streaming with shuffle/random_state, sklearn params protocol)."""
+import numpy as np
+import pytest
+
+from dask_sql_tpu.ml.wrappers import Incremental, ParallelPostFit
+
+sklearn = pytest.importorskip("sklearn")
+from sklearn.linear_model import LogisticRegression, SGDClassifier  # noqa: E402
+
+
+@pytest.fixture
+def data():
+    rng = np.random.RandomState(0)
+    X = rng.rand(500, 4)
+    y = (X[:, 0] + X[:, 1] > 1.0).astype(int)
+    return X, y
+
+
+def test_parallel_post_fit_blockwise(data):
+    X, y = data
+    clf = ParallelPostFit(LogisticRegression(), block_rows=64)
+    clf.fit(X, y)
+    pred = clf.predict(X)
+    assert pred.shape == (500,)
+    direct = clf.estimator.predict(X)
+    np.testing.assert_array_equal(pred, direct)
+    proba = clf.predict_proba(X)
+    assert proba.shape == (500, 2)
+
+
+def test_predict_meta_sets_dtype(data):
+    X, y = data
+    clf = ParallelPostFit(LogisticRegression(),
+                          predict_meta=np.array([], dtype=np.float32),
+                          predict_proba_meta=np.array([[]], dtype=np.float32))
+    clf.fit(X, y)
+    assert clf.predict(X).dtype == np.float32
+    assert clf.predict_proba(X).dtype == np.float32
+
+
+def test_scoring_honored(data):
+    X, y = data
+    clf = ParallelPostFit(LogisticRegression(), scoring="neg_log_loss")
+    clf.fit(X, y)
+    from sklearn.metrics import log_loss
+
+    expected = -log_loss(y, clf.estimator.predict_proba(X))
+    assert clf.score(X, y) == pytest.approx(expected)
+    # default scoring = estimator.score
+    clf2 = ParallelPostFit(LogisticRegression()).fit(X, y)
+    assert clf2.score(X, y) == pytest.approx(clf2.estimator.score(X, y))
+
+
+def test_params_protocol(data):
+    clf = ParallelPostFit(LogisticRegression(C=2.0), scoring="accuracy")
+    params = clf.get_params()
+    assert params["scoring"] == "accuracy"
+    assert params["estimator__C"] == 2.0
+    clf.set_params(estimator__C=0.5, scoring=None)
+    assert clf.estimator.C == 0.5
+    assert clf.scoring is None
+    with pytest.raises(ValueError):
+        clf.set_params(bogus=1)
+
+
+def test_incremental_streams_partial_fit(data):
+    X, y = data
+    calls = []
+
+    class Probe(SGDClassifier):
+        def partial_fit(self, Xb, yb=None, classes=None, **kw):
+            calls.append(len(Xb))
+            return super().partial_fit(Xb, yb, classes=classes)
+
+    inc = Incremental(Probe(random_state=0), block_rows=100,
+                      shuffle_blocks=False)
+    inc.fit(X, y)
+    assert calls == [100] * 5  # streamed in order
+    assert inc.predict(X).shape == (500,)
+
+
+def test_incremental_shuffle_uses_random_state(data):
+    X, y = data
+    order1, order2 = [], []
+
+    def probe(sink):
+        class P(SGDClassifier):
+            def partial_fit(self, Xb, yb=None, classes=None, **kw):
+                sink.append(int(Xb[0, 0] * 1e6))
+                return super().partial_fit(Xb, yb, classes=classes)
+        return P(random_state=0)
+
+    Incremental(probe(order1), block_rows=100, random_state=42).fit(X, y)
+    Incremental(probe(order2), block_rows=100, random_state=42).fit(X, y)
+    assert order1 == order2  # deterministic shuffle
+    order3 = []
+    Incremental(probe(order3), block_rows=100, shuffle_blocks=False).fit(X, y)
+    assert order3 != order1  # shuffling actually changes the order
