@@ -1,0 +1,60 @@
+"""Vectorized set-membership kernels shared by the eager and compiled rex
+evaluators.
+
+One sorted-lookup regardless of the value-set size — the reference's InList
+lowers to a Literal comparison chain (call.py there), which is O(values) in
+trace/compile time and melts down on DPP-generated lists of thousands of keys.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+# below this, a fused compare-chain traces fine and avoids the host sort
+IN_LIST_VECTORIZE_THRESHOLD = 16
+
+
+def sorted_membership(data: jnp.ndarray, values: np.ndarray) -> jnp.ndarray:
+    """`data IN values` as a device bool array (no NULL handling here).
+
+    Integer columns are compared exactly: float value lists are reduced to
+    their integral members (SQL `int_col IN (1.5)` can never match) instead
+    of promoting the column to float64, which would collapse ids >2^53.
+    """
+    values = np.asarray(values)
+    if not len(values):
+        return jnp.zeros(data.shape, dtype=bool)
+    col_dtype = np.dtype(data.dtype)
+    if col_dtype.kind in "iu" and values.dtype.kind == "f":
+        integral = values == np.floor(values)
+        values = values[integral & (np.abs(values) < 2.0 ** 63)].astype(np.int64)
+        if not len(values):
+            return jnp.zeros(data.shape, dtype=bool)
+    cmp_dtype = np.result_type(col_dtype, values.dtype)
+    sv = np.sort(np.unique(values.astype(cmp_dtype, copy=False)))
+    svj = jnp.asarray(sv)
+    d = data.astype(cmp_dtype)
+    idx = jnp.clip(jnp.searchsorted(svj, d), 0, len(sv) - 1)
+    return svj[idx] == d
+
+
+def dictionary_membership(codes: jnp.ndarray, dictionary, values) -> jnp.ndarray:
+    """Membership for dictionary-encoded strings: host LUT over the uniques,
+    one device gather over the codes."""
+    d = dictionary if dictionary is not None else np.array([""], dtype=object)
+    lut = np.isin(d.astype(str), np.asarray(values).astype(str))
+    if not len(lut):
+        lut = np.zeros(1, dtype=bool)
+    return jnp.asarray(lut)[jnp.clip(codes, 0, len(lut) - 1)]
+
+
+def vectorizable_literal_items(items) -> bool:
+    """True when an InList's items are bulk numeric literals worth routing
+    through sorted_membership instead of a comparison chain."""
+    from ..planner.expressions import Literal
+
+    if len(items) <= IN_LIST_VECTORIZE_THRESHOLD:
+        return False
+    return all(
+        isinstance(it, Literal) and isinstance(it.value, (int, float))
+        and not isinstance(it.value, bool) for it in items)

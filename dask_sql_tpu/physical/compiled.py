@@ -37,6 +37,7 @@ from ..columnar.dtypes import (
 from ..columnar.table import Table
 from ..ops import datetime as dt_ops
 from ..ops import strings as str_ops
+from ..ops.membership import dictionary_membership, sorted_membership
 from ..planner import plan as p
 from ..planner.expressions import (
     AggExpr,
@@ -44,6 +45,7 @@ from ..planner.expressions import (
     Cast,
     ColumnRef,
     Expr,
+    InArrayExpr,
     InListExpr,
     Literal,
     ScalarFunc,
@@ -52,6 +54,7 @@ from ..planner.expressions import (
 )
 
 logger = logging.getLogger(__name__)
+
 
 _SUPPORTED_AGGS = {"sum", "count", "avg", "min", "max", "count_star",
                    "var_samp", "var_pop", "stddev_samp", "stddev_pop"}
@@ -134,6 +137,8 @@ class _TraceEval:
             return (out_d, out_v)
         if isinstance(expr, InListExpr):
             return self._in_list(expr, slots)
+        if isinstance(expr, InArrayExpr):
+            return self._in_array(expr, slots)
         if isinstance(expr, ScalarFunc):
             return self._call(expr, slots)
         raise _Unsupported(f"expr {type(expr).__name__}")
@@ -149,29 +154,39 @@ class _TraceEval:
     def _in_list(self, expr: InListExpr, slots):
         src = self._string_source(expr.arg)
         if src is not None:
-            # membership via a host-built boolean LUT over the dictionary
-            values = {it.value for it in expr.items
-                      if isinstance(it, Literal) and it.value is not None}
             if not all(isinstance(it, Literal) for it in expr.items):
                 raise _Unsupported("non-literal IN list")
-            d = src.dictionary if src.dictionary is not None else np.array([""], dtype=object)
-            lut = jnp.asarray(np.isin(d.astype(str), list(values)))
+            values = [it.value for it in expr.items if it.value is not None]
             codes, valid = slots[expr.arg.index]
-            hit = lut[jnp.clip(codes, 0, len(d) - 1)]
+            hit = dictionary_membership(codes, src.dictionary, values)
             if expr.negated:
                 hit = ~hit
             return (hit, valid)
         ad, av = self.eval(expr.arg, slots)
-        hit = jnp.zeros_like(ad, dtype=bool)
-        for it in expr.items:
-            if not isinstance(it, Literal):
-                raise _Unsupported("non-literal IN list")
-            if it.value is None:
-                continue
-            hit = hit | (ad == jnp.asarray(it.value, dtype=ad.dtype))
+        if not all(isinstance(it, Literal) for it in expr.items):
+            raise _Unsupported("non-literal IN list")
+        vals = [it.value for it in expr.items if it.value is not None]
+        if all(isinstance(v, (int, float)) and not isinstance(v, bool)
+               for v in vals):
+            # exact for int columns vs float literals (no dtype truncation)
+            hit = sorted_membership(ad, np.asarray(vals))
+        else:
+            hit = jnp.zeros_like(ad, dtype=bool)
+            for v in vals:
+                hit = hit | (ad == jnp.asarray(v))
         if expr.negated:
             hit = ~hit
         return (hit, av)
+
+    def _in_array(self, expr: InArrayExpr, slots):
+        src = self._string_source(expr.arg)
+        if src is not None:
+            codes, valid = slots[expr.arg.index]
+            hit = dictionary_membership(codes, src.dictionary, expr.values)
+            return (~hit if expr.negated else hit, valid)
+        ad, av = self.eval(expr.arg, slots)
+        hit = sorted_membership(ad, expr.values)
+        return (~hit if expr.negated else hit, av)
 
     def _call(self, expr: ScalarFunc, slots):
         op = expr.op
@@ -554,6 +569,10 @@ class CompiledAggregate:
         flat = self._fn(tuple(datas), tuple(valids))
         hit = flat[0]
         present = jnp.nonzero(hit)[0]
+        if not self.gcols and int(present.shape[0]) == 0:
+            # SQL: a global aggregate over zero input rows still yields one
+            # row (COUNT=0, other aggs NULL via their cnt>0 validity)
+            present = jnp.zeros(1, dtype=present.dtype)
         from ..physical.rel.base import unique_names
 
         names = unique_names([f.name for f in self.agg.schema])

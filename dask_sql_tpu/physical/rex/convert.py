@@ -21,6 +21,7 @@ from ...planner.expressions import (
     ColumnRef,
     ExistsExpr,
     Expr,
+    InArrayExpr,
     InListExpr,
     InSubqueryExpr,
     Literal,
@@ -28,7 +29,20 @@ from ...planner.expressions import (
     ScalarSubqueryExpr,
     UdfExpr,
 )
+from ...ops.membership import (
+    dictionary_membership,
+    sorted_membership,
+    vectorizable_literal_items,
+)
 from .operations import OPERATION_MAPPING, _and_validity, _merged_for_compare
+
+
+def _bulk_membership(arg: Column, values) -> jnp.ndarray:
+    """Vectorized `arg IN values` (bool device array; NULL handling is the
+    caller's)."""
+    if arg.sql_type in STRING_TYPES:
+        return dictionary_membership(arg.data, arg.dictionary, values)
+    return sorted_membership(arg.data, values)
 
 
 class RexConverter:
@@ -44,6 +58,7 @@ class RexConverter:
             Cast: self._cast,
             CaseExpr: self._case,
             InListExpr: self._in_list,
+            InArrayExpr: self._in_array,
             ScalarSubqueryExpr: self._scalar_subquery,
             InSubqueryExpr: self._in_subquery,
             ExistsExpr: self._exists,
@@ -114,8 +129,21 @@ class RexConverter:
             out = Column(data, target, None if bool(validity.all()) else validity)
         return out
 
+    def _in_array(self, expr: InArrayExpr, table: Table) -> Column:
+        arg = self.convert(expr.arg, table)
+        hits = _bulk_membership(arg, expr.values)
+        value = hits if not expr.negated else ~hits
+        return Column(value, SqlType.BOOLEAN, arg.validity)
+
     def _in_list(self, expr: InListExpr, table: Table) -> Column:
         arg = self.convert(expr.arg, table)
+        # bulk literal lists: one vectorized membership op instead of a
+        # per-item comparison chain (which traces O(items) jnp ops)
+        if vectorizable_literal_items(expr.items):
+            vals = np.asarray([it.value for it in expr.items])
+            hits = _bulk_membership(arg, vals)
+            value = hits if not expr.negated else ~hits
+            return Column(value, SqlType.BOOLEAN, arg.validity)
         hits = None
         any_null_item = False
         for item in expr.items:

@@ -18,6 +18,7 @@ from ...columnar.dtypes import DATETIME_TYPES, SqlType
 from ...planner.expressions import (
     ColumnRef,
     Expr,
+    InArrayExpr,
     InListExpr,
     Literal,
     ScalarFunc,
@@ -50,6 +51,15 @@ def conjunct_to_filter(expr: Expr, field_names: List[str]) -> Optional[Tuple[str
                 isinstance(i, Literal) and i.value is not None for i in expr.items):
             op = "not in" if expr.negated else "in"
             return (field_names[arg.index], op, [_literal_value(i) for i in expr.items])
+        return None
+    if isinstance(expr, InArrayExpr):
+        arg = _strip_cast(expr.arg)
+        if isinstance(arg, ColumnRef):
+            vals = np.asarray(expr.values)
+            if arg.sql_type in DATETIME_TYPES:
+                vals = vals.astype(np.int64).view("datetime64[ns]")
+            op = "not in" if expr.negated else "in"
+            return (field_names[arg.index], op, list(vals))
         return None
     if isinstance(expr, ScalarFunc) and expr.op in ("is_null", "is_not_null"):
         arg = _strip_cast(expr.args[0])

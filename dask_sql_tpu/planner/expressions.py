@@ -11,6 +11,8 @@ from __future__ import annotations
 from dataclasses import dataclass, field, replace
 from typing import Any, List, Optional, Tuple
 
+import numpy as np
+
 from ..columnar.dtypes import SqlType
 
 
@@ -145,6 +147,44 @@ class InListExpr(Expr):
 
     def with_children(self, children):
         return replace(self, arg=children[0], items=tuple(children[1:]))
+
+
+@dataclass(frozen=True, eq=False)
+class InArrayExpr(Expr):
+    """Membership test against a bulk host array (plan-time generated filters).
+
+    Role parity: the reference's DynamicPartitionPruning injects `InList`
+    filters with thousands of values (dynamic_partition_pruning.rs:1-8);
+    carrying them as one numpy array keeps plan walks O(1) in the value
+    count and lets the kernels evaluate membership with a single vectorized
+    sorted-lookup instead of one comparison per value.
+
+    `values` is already normalized to the comparison domain: numerics keep
+    their numpy dtype, datetimes are int64 nanoseconds, strings are an
+    object array.  Identity equality (eq=False) — the array payload makes
+    structural equality both expensive and unnecessary.
+    """
+
+    arg: Expr
+    values: Any  # np.ndarray, sorted unique, no nulls
+    negated: bool = False
+    sql_type: SqlType = SqlType.BOOLEAN
+
+    def children(self):
+        return [self.arg]
+
+    def with_children(self, children):
+        return replace(self, arg=children[0])
+
+    def __repr__(self):
+        # content digest: str(expr) keys compiled-plan caches, so two arrays
+        # with equal length but different values must stringify differently
+        import hashlib
+
+        v = np.ascontiguousarray(self.values)
+        digest = hashlib.sha1(v.tobytes() + str(v.dtype).encode()).hexdigest()[:12]
+        return (f"InArray(arg={self.arg!r}, n={len(self.values)}, "
+                f"digest={digest}, negated={self.negated})")
 
 
 @dataclass(frozen=True)

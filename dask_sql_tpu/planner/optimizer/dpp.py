@@ -8,7 +8,7 @@ non-matching row groups (dynamic_partition_pruning.rs:1-8; gated by
 
 Here the dim side is evaluated with a scoped executor at plan time (the
 reference reads parquet directly at plan time, the same plan/execute blur),
-and the distinct key values become an InListExpr on the fact TableScan —
+and the distinct key values become a bulk InArrayExpr on the fact TableScan —
 which the lazy-parquet scan path then converts into a pyarrow row-group
 filter (physical/utils/filter.py), completing the IO pruning.
 """
@@ -20,7 +20,7 @@ from typing import List, Optional
 import numpy as np
 
 from .. import plan as p
-from ..expressions import ColumnRef, InListExpr, Literal, walk
+from ..expressions import ColumnRef, InArrayExpr
 
 logger = logging.getLogger(__name__)
 
@@ -84,7 +84,7 @@ def _try_prune(join: p.Join, catalog, context, ratio):
                               join.filter, join.schema)
         if lrows / rrows <= (1 - ratio) and _has_filters(join.left) \
                 and isinstance(rkey, ColumnRef) and rscan is not None:
-            new_right = _inject(join.right, rscan, rkey, join.left, lkey, 0,
+            new_right = _inject(join.right, rscan, rkey, join.left, lkey, nleft,
                                 context, side="left")
             if new_right is not None:
                 return p.Join(join.left, new_right, join.join_type, join.on,
@@ -93,15 +93,21 @@ def _try_prune(join: p.Join, catalog, context, ratio):
 
 
 def _inject(fact_side, fact_scan: p.TableScan, fact_key: ColumnRef,
-            dim_side, dim_key, dim_base: int, context, side: str):
-    """Evaluate the dim side now, collect distinct key values, filter fact scan."""
+            dim_side, dim_key, nleft: int, context, side: str):
+    """Evaluate the dim side now, collect distinct key values, filter fact scan.
+
+    `nleft` is the left input's schema width: with the dim on the left
+    (side="left") the fact key lives in the join's combined output space and
+    must be rebased by -nleft before resolving into the fact scan; with the
+    dim on the right it is the dim key that needs the rebase.
+    """
     try:
         from ...physical.executor import Executor
 
         executor = Executor(context)
         dim_table = executor.execute(dim_side)
         if side == "right":
-            key_expr = _rebase(dim_key, len(fact_side.schema))
+            key_expr = _rebase(dim_key, nleft)
         else:
             key_expr = dim_key
         col = executor.eval_expr(key_expr, dim_table)
@@ -110,19 +116,17 @@ def _inject(fact_side, fact_scan: p.TableScan, fact_key: ColumnRef,
         uniq = np.unique(vals)
         if len(uniq) == 0 or len(uniq) > _MAX_INLIST:
             return None
-        from ...columnar.dtypes import np_to_sql
-
-        sql_t = col.sql_type
-        items = tuple(Literal(_pyval(v, sql_t), sql_t) for v in uniq)
+        if uniq.dtype.kind == "M":
+            uniq = uniq.astype("datetime64[ns]").view("int64")
         # the fact key must resolve inside the scan (column ref path only)
         scan_idx = fact_key.index
         if side == "left":
-            scan_idx = fact_key.index - dim_base if fact_key.index >= dim_base else fact_key.index
+            scan_idx = fact_key.index - nleft
         # map through any projections between scan and join input
         ref = _resolve_to_scan(fact_side, scan_idx)
         if ref is None:
             return None
-        in_filter = InListExpr(ref, items, False)
+        in_filter = InArrayExpr(ref, uniq, False)
         new_scan = p.TableScan(fact_scan.schema_name, fact_scan.table_name,
                                fact_scan.schema, fact_scan.projection,
                                list(fact_scan.filters) + [in_filter])
@@ -175,12 +179,3 @@ def _isnull(vals: np.ndarray) -> np.ndarray:
         return np.isnat(vals)
     return np.zeros(len(vals), dtype=bool)
 
-
-def _pyval(v, sql_t):
-    from ...columnar.dtypes import DATETIME_TYPES
-
-    if sql_t in DATETIME_TYPES:
-        return int(np.datetime64(v, "ns").astype(np.int64))
-    if isinstance(v, np.generic):
-        return v.item()
-    return v

@@ -33,8 +33,7 @@ def test_dpp_injects_inlist(dpp_setup):
     q = ("SELECT SUM(f_val) AS s FROM fact JOIN dim ON f_key = d_key "
          "WHERE d_cat = 'keep'")
     plan_text = c.explain(q)
-    assert "InListExpr" in plan_text or "in_list" in plan_text.lower() or \
-        "filters=" in plan_text  # the fact scan carries a pushed filter
+    assert "InArray" in plan_text, plan_text  # DPP filter landed on the fact scan
     result = c.sql(q).compute()
     keep = dim[dim.d_cat == "keep"].d_key
     expected = fact[fact.f_key.isin(keep)].f_val.sum()
@@ -68,3 +67,19 @@ def test_dpp_disabled_by_config(dpp_setup):
     res_on = c.sql(q).compute()
     res_off = c.sql(q, config_options={"sql.dynamic_partition_pruning": False}).compute()
     np.testing.assert_allclose(res_on["s"][0], res_off["s"][0])
+
+
+def test_dpp_dim_on_left(dpp_setup):
+    """Small filtered dim on the LEFT: the fact key (combined-plan space)
+    must be rebased into the fact scan's schema.  Regression: the rebase
+    offsets were swapped between the two injection sites, resolving the
+    wrong fact column (or silently disabling DPP for left-dim joins)."""
+    c, fact, dim = dpp_setup
+    q = ("SELECT SUM(f_val) AS s FROM dim JOIN fact ON d_key = f_key "
+         "WHERE d_cat = 'keep'")
+    plan_text = c.explain(q)
+    assert "InArray" in plan_text, plan_text  # DPP fired on the left-dim shape
+    result = c.sql(q).compute()
+    keep = dim[dim.d_cat == "keep"].d_key
+    expected = fact[fact.f_key.isin(keep)].f_val.sum()
+    np.testing.assert_allclose(result["s"][0], expected, rtol=1e-9)

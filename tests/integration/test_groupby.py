@@ -149,3 +149,22 @@ def test_coalesce_in_compiled_aggregate(c):
     ).compute().sort_values("g").reset_index(drop=True)
     assert list(result["n"]) == [2, 1]
     np.testing.assert_allclose(result["m"], [2.0, 0.0])
+
+
+def test_global_aggregate_over_empty_input(c):
+    """SQL: a global aggregate with zero qualifying rows yields ONE row —
+    COUNT(*)=0 and NULL for value aggregates (regression: the compiled
+    pipeline's group compaction dropped the row entirely)."""
+    import pandas as pd
+
+    for opts in ({"sql.compile": True}, {"sql.compile": False}):
+        df = c.sql(
+            "SELECT COUNT(*) AS n, SUM(a) AS s, MIN(a) AS mn FROM df_simple "
+            "WHERE a > 1e9", config_options=opts).compute()
+        assert len(df) == 1
+        assert int(df["n"][0]) == 0
+        assert pd.isna(df["s"][0]) and pd.isna(df["mn"][0])
+        # grouped aggregates over empty input correctly yield zero rows
+        g = c.sql("SELECT a, COUNT(*) AS n FROM df_simple WHERE a > 1e9 "
+                  "GROUP BY a", config_options=opts).compute()
+        assert len(g) == 0

@@ -1,0 +1,608 @@
+"""TPC-DS schema + small synthetic data generator for the q1-q99 suite.
+
+Parity: the reference's q1-q99 yardstick reads pre-generated parquet from
+--data_dir (reference tests/unit/test_queries.py); here the tables are
+generated in-process (like tests/tpch.py) with domains matched to the
+qualification-query predicates so queries exercise real paths and return
+non-degenerate results at tiny scale.
+"""
+from __future__ import annotations
+
+import numpy as np
+import pandas as pd
+
+_STATES = ["TN", "GA", "CA", "WA", "TX", "OH", "OR", "NM", "KY", "VA", "MS",
+           "IN", "ND", "OK", "IL", "NJ", "WI", "CT", "LA", "IA", "AR", "CO",
+           "MN", "MO"]
+_COUNTIES = ["Williamson County", "Rush County", "Toole County",
+             "Jefferson County", "Dona Ana County", "La Porte County",
+             "Franklin Parish", "Bronx County", "Orange County",
+             "Ziebach County", "Walker County"]
+_CITIES = ["Fairview", "Midway", "Edgewood", "Oak Grove", "Five Points",
+           "Centerville", "Liberty", "Union", "Salem", "Glenwood"]
+_CATEGORIES = ["Books", "Children", "Electronics", "Women", "Music", "Men",
+               "Sports", "Home", "Jewelry", "Shoes"]
+_CLASSES = ["personal", "portable", "reference", "self-help", "accessories",
+            "classical", "fragrances", "pants", "computers", "stereo",
+            "football", "shirts", "birdal", "dresses", "maternity"]
+_BRANDS = ["scholaramalgamalg #14", "scholaramalgamalg #7",
+           "exportiunivamalg #9", "scholaramalgamalg #9", "amalgimporto #1",
+           "edu packscholar #1", "exportiimporto #1", "importoamalg #1",
+           "corpnameless #3", "univbrand #6"]
+_COLORS = ["pale", "powder", "khaki", "brown", "honeydew", "floral", "deep",
+           "light", "cornflower", "midnight", "snow", "cyan", "papaya",
+           "orange", "frosted", "forest", "ghost", "slate", "blanched",
+           "burnished", "purple", "burlywood", "indian", "spring", "medium"]
+_UNITS = ["Ounce", "Oz", "Bunch", "Ton", "N/A", "Dozen", "Box", "Pound",
+          "Pallet", "Gross", "Cup", "Dram", "Each", "Tbl", "Lb", "Bundle"]
+_SIZES = ["medium", "extra large", "N/A", "small", "petite", "large"]
+_DAY_NAMES = ["Sunday", "Monday", "Tuesday", "Wednesday", "Thursday",
+              "Friday", "Saturday"]
+_EDUCATION = ["Unknown", "College", "Advanced Degree", "2 yr Degree",
+              "4 yr Degree", "Primary", "Secondary"]
+_MARITAL = ["M", "S", "D", "W", "U"]
+_BUY_POTENTIAL = [">10000", "Unknown", "1001-5000", "0-500", "501-1000",
+                  "5001-10000"]
+_MEALS = ["breakfast", "dinner", "lunch", ""]
+_COUNTRIES = ["United States"]
+
+
+def _dates() -> pd.DataFrame:
+    days = pd.date_range("1998-01-01", "2002-12-31", freq="D")
+    n = len(days)
+    sk = np.arange(1, n + 1, dtype=np.int64)
+    year = days.year.to_numpy()
+    moy = days.month.to_numpy()
+    return pd.DataFrame({
+        "d_date_sk": sk,
+        "d_date_id": [f"AAAAAAAA{int(s):08d}" for s in sk],
+        "d_date": days.to_numpy().astype("datetime64[ns]"),
+        "d_month_seq": ((year - 1900) * 12 + (moy - 1)).astype(np.int64),
+        "d_week_seq": ((days - pd.Timestamp("1900-01-01")).days.to_numpy() // 7
+                       ).astype(np.int64),
+        "d_quarter_seq": ((year - 1900) * 4 + (moy - 1) // 3).astype(np.int64),
+        "d_year": year.astype(np.int64),
+        "d_dow": days.dayofweek.to_numpy().astype(np.int64),  # Mon=0
+        "d_moy": moy.astype(np.int64),
+        "d_dom": days.day.to_numpy().astype(np.int64),
+        "d_qoy": days.quarter.to_numpy().astype(np.int64),
+        "d_fy_year": year.astype(np.int64),
+        "d_day_name": [_DAY_NAMES[(d + 1) % 7] for d in days.dayofweek],
+        "d_quarter_name": [f"{y}Q{q}" for y, q in
+                           zip(year, days.quarter.to_numpy())],
+        "d_holiday": np.where(days.day.to_numpy() % 13 == 0, "Y", "N"),
+        "d_weekend": np.where(days.dayofweek.to_numpy() >= 5, "Y", "N"),
+        "d_following_holiday": np.where(days.day.to_numpy() % 13 == 1, "Y", "N"),
+        "d_first_dom": sk - days.day.to_numpy() + 1,
+        "d_last_dom": sk - days.day.to_numpy() + days.days_in_month.to_numpy(),
+        "d_current_day": "N",
+        "d_current_week": "N",
+        "d_current_month": "N",
+        "d_current_quarter": "N",
+        "d_current_year": "N",
+    })
+
+
+def _times(rng) -> pd.DataFrame:
+    # one row per 30s of the day keeps it small but covers hour/minute filters
+    secs = np.arange(0, 86400, 30, dtype=np.int64)
+    return pd.DataFrame({
+        "t_time_sk": secs,
+        "t_time_id": [f"T{int(s):08d}" for s in secs],
+        "t_time": secs,
+        "t_hour": secs // 3600,
+        "t_minute": (secs % 3600) // 60,
+        "t_second": secs % 60,
+        "t_am_pm": np.where(secs < 43200, "AM", "PM"),
+        "t_shift": np.where(secs < 28800, "first",
+                            np.where(secs < 57600, "second", "third")),
+        "t_sub_shift": "morning",
+        "t_meal_time": [_MEALS[int(h) // 7 % 4] for h in secs // 3600],
+    })
+
+
+def _pick(rng, values, n):
+    return np.array(values, dtype=object)[rng.randint(0, len(values), n)]
+
+
+def _null_some(rng, arr: np.ndarray, frac: float) -> np.ndarray:
+    out = arr.astype(float)
+    out[rng.rand(len(out)) < frac] = np.nan
+    return out
+
+
+def generate(scale_rows: int = 2000, seed: int = 42):
+    """All 24 TPC-DS tables; `scale_rows` sizes store_sales, others scale off it."""
+    rng = np.random.RandomState(seed)
+    date_dim = _dates()
+    nd = len(date_dim)
+    time_dim = _times(rng)
+
+    n_item = max(scale_rows // 20, 50)
+    n_cust = max(scale_rows // 10, 100)
+    n_addr = max(n_cust // 2, 50)
+    n_cd = 200
+    n_hd = 72
+    n_store = 12
+    n_wh = 5
+    n_promo = 30
+    n_cc = 6
+    n_cp = 20
+    n_web = 6
+    n_wp = 20
+    n_ib = 20
+
+    item = pd.DataFrame({
+        "i_item_sk": np.arange(1, n_item + 1, dtype=np.int64),
+        "i_item_id": [f"AAAAAAAA{k % (n_item // 2 + 1):08d}"
+                      for k in range(1, n_item + 1)],
+        "i_rec_start_date": pd.Timestamp("1997-10-27"),
+        "i_rec_end_date": pd.NaT,
+        "i_item_desc": [f"item description {k} longer text for substr"
+                        for k in range(1, n_item + 1)],
+        "i_current_price": np.round(rng.uniform(0.5, 100, n_item), 2),
+        "i_wholesale_cost": np.round(rng.uniform(0.3, 80, n_item), 2),
+        "i_brand_id": rng.randint(1, 10, n_item).astype(np.int64) * 1000 + 1,
+        "i_brand": _pick(rng, _BRANDS, n_item),
+        "i_class_id": rng.randint(1, 16, n_item).astype(np.int64),
+        "i_class": _pick(rng, _CLASSES, n_item),
+        "i_category_id": rng.randint(1, 11, n_item).astype(np.int64),
+        "i_category": _pick(rng, _CATEGORIES, n_item),
+        "i_manufact_id": rng.randint(1, 1000, n_item).astype(np.int64),
+        "i_manufact": [f"manufact{k % 100}" for k in range(n_item)],
+        "i_size": _pick(rng, _SIZES, n_item),
+        "i_formulation": [f"form{k % 17}" for k in range(n_item)],
+        "i_color": _pick(rng, _COLORS, n_item),
+        "i_units": _pick(rng, _UNITS, n_item),
+        "i_container": "Unknown",
+        "i_manager_id": rng.randint(1, 100, n_item).astype(np.int64),
+        "i_product_name": [f"product {k}" for k in range(1, n_item + 1)],
+    })
+    customer_address = pd.DataFrame({
+        "ca_address_sk": np.arange(1, n_addr + 1, dtype=np.int64),
+        "ca_address_id": [f"AAAAAAAA{k:08d}" for k in range(1, n_addr + 1)],
+        "ca_street_number": [str(100 + k) for k in range(n_addr)],
+        "ca_street_name": [f"Main St {k % 40}" for k in range(n_addr)],
+        "ca_street_type": "Street",
+        "ca_suite_number": [f"Suite {k % 20}" for k in range(n_addr)],
+        "ca_city": _pick(rng, _CITIES, n_addr),
+        "ca_county": _pick(rng, _COUNTIES, n_addr),
+        "ca_state": _pick(rng, _STATES, n_addr),
+        "ca_zip": [f"{z:05d}" for z in
+                   rng.choice([24128, 76232, 65084, 85669, 86197, 88274, 83405,
+                               86475, 85392, 85460, 80348, 81792, 30903, 48583],
+                              n_addr)],
+        "ca_country": _pick(rng, _COUNTRIES, n_addr),
+        "ca_gmt_offset": rng.choice([-5.0, -6.0, -7.0, -8.0], n_addr),
+        "ca_location_type": "single family",
+    })
+    customer_demographics = pd.DataFrame({
+        "cd_demo_sk": np.arange(1, n_cd + 1, dtype=np.int64),
+        "cd_gender": _pick(rng, ["M", "F"], n_cd),
+        "cd_marital_status": _pick(rng, _MARITAL, n_cd),
+        "cd_education_status": _pick(rng, _EDUCATION, n_cd),
+        "cd_purchase_estimate": rng.randint(1, 10, n_cd).astype(np.int64) * 500,
+        "cd_credit_rating": _pick(rng, ["Good", "Low Risk", "High Risk",
+                                        "Unknown"], n_cd),
+        "cd_dep_count": rng.randint(0, 7, n_cd).astype(np.int64),
+        "cd_dep_employed_count": rng.randint(0, 7, n_cd).astype(np.int64),
+        "cd_dep_college_count": rng.randint(0, 7, n_cd).astype(np.int64),
+    })
+    household_demographics = pd.DataFrame({
+        "hd_demo_sk": np.arange(1, n_hd + 1, dtype=np.int64),
+        "hd_income_band_sk": rng.randint(1, n_ib + 1, n_hd).astype(np.int64),
+        "hd_buy_potential": _pick(rng, _BUY_POTENTIAL, n_hd),
+        "hd_dep_count": rng.randint(0, 10, n_hd).astype(np.int64),
+        "hd_vehicle_count": rng.randint(0, 7, n_hd).astype(np.int64),
+    })
+    income_band = pd.DataFrame({
+        "ib_income_band_sk": np.arange(1, n_ib + 1, dtype=np.int64),
+        "ib_lower_bound": np.arange(0, n_ib, dtype=np.int64) * 10000,
+        "ib_upper_bound": (np.arange(0, n_ib, dtype=np.int64) + 1) * 10000,
+    })
+    customer = pd.DataFrame({
+        "c_customer_sk": np.arange(1, n_cust + 1, dtype=np.int64),
+        "c_customer_id": [f"AAAAAAAA{k:08d}" for k in range(1, n_cust + 1)],
+        "c_current_cdemo_sk": rng.randint(1, n_cd + 1, n_cust).astype(np.int64),
+        "c_current_hdemo_sk": rng.randint(1, n_hd + 1, n_cust).astype(np.int64),
+        "c_current_addr_sk": rng.randint(1, n_addr + 1, n_cust).astype(np.int64),
+        "c_first_shipto_date_sk": rng.randint(1, nd + 1, n_cust).astype(np.int64),
+        "c_first_sales_date_sk": rng.randint(1, nd + 1, n_cust).astype(np.int64),
+        "c_salutation": _pick(rng, ["Mr.", "Ms.", "Dr.", "Mrs.", "Sir"], n_cust),
+        "c_first_name": _pick(rng, ["James", "Mary", "John", "Linda", "Ann",
+                                    "Luis", "Wei", "Aisha"], n_cust),
+        "c_last_name": _pick(rng, ["Smith", "Jones", "Garcia", "Chen", "Khan",
+                                   "Brown", "Lee", "Patel"], n_cust),
+        "c_preferred_cust_flag": _pick(rng, ["Y", "N"], n_cust),
+        "c_birth_day": rng.randint(1, 29, n_cust).astype(np.int64),
+        "c_birth_month": rng.randint(1, 13, n_cust).astype(np.int64),
+        "c_birth_year": rng.randint(1930, 1995, n_cust).astype(np.int64),
+        "c_birth_country": _pick(rng, ["UNITED STATES", "CANADA", "MEXICO",
+                                       "FRANCE"], n_cust),
+        "c_login": "",
+        "c_email_address": [f"user{k}@example.com" for k in range(n_cust)],
+        "c_last_review_date_sk": rng.randint(1, nd + 1, n_cust).astype(np.int64),
+    })
+    store = pd.DataFrame({
+        "s_store_sk": np.arange(1, n_store + 1, dtype=np.int64),
+        "s_store_id": [f"AAAAAAAA{k % (n_store // 2):08d}"
+                       for k in range(n_store)],
+        "s_rec_start_date": pd.Timestamp("1997-03-13"),
+        "s_rec_end_date": pd.NaT,
+        "s_closed_date_sk": _null_some(
+            rng, rng.randint(1, nd + 1, n_store), 0.7),
+        "s_store_name": _pick(rng, ["ese", "ought", "able", "pri", "bar"],
+                              n_store),
+        "s_number_employees": rng.randint(200, 300, n_store).astype(np.int64),
+        "s_floor_space": rng.randint(5000000, 9999999, n_store).astype(np.int64),
+        "s_hours": "8AM-8PM",
+        "s_manager": "William Ward",
+        "s_market_id": rng.randint(1, 11, n_store).astype(np.int64),
+        "s_geography_class": "Unknown",
+        "s_market_desc": "market description text",
+        "s_market_manager": "Scott Smith",
+        "s_division_id": 1,
+        "s_division_name": "Unknown",
+        "s_company_id": 1,
+        "s_company_name": "Unknown",
+        "s_street_number": [str(100 + k) for k in range(n_store)],
+        "s_street_name": "Main",
+        "s_street_type": "Street",
+        "s_suite_number": "Suite 100",
+        "s_city": _pick(rng, _CITIES[:4], n_store),
+        "s_county": _pick(rng, _COUNTIES[:2], n_store),
+        "s_state": _pick(rng, ["TN", "GA"], n_store),
+        "s_zip": [f"{z:05d}" for z in
+                  rng.choice([24128, 76232, 85669, 30903], n_store)],
+        "s_country": "United States",
+        "s_gmt_offset": -5.0,
+        "s_tax_precentage": 0.03,
+    })
+    warehouse = pd.DataFrame({
+        "w_warehouse_sk": np.arange(1, n_wh + 1, dtype=np.int64),
+        "w_warehouse_id": [f"AAAAAAAA{k:08d}" for k in range(1, n_wh + 1)],
+        "w_warehouse_name": [f"Warehouse number {k} with a long name"
+                             for k in range(1, n_wh + 1)],
+        "w_warehouse_sq_ft": rng.randint(50000, 999999, n_wh).astype(np.int64),
+        "w_street_number": "100",
+        "w_street_name": "Main",
+        "w_street_type": "Street",
+        "w_suite_number": "Suite 1",
+        "w_city": _pick(rng, _CITIES, n_wh),
+        "w_county": _pick(rng, _COUNTIES, n_wh),
+        "w_state": _pick(rng, _STATES, n_wh),
+        "w_zip": "30903",
+        "w_country": "United States",
+        "w_gmt_offset": -5.0,
+    })
+    promotion = pd.DataFrame({
+        "p_promo_sk": np.arange(1, n_promo + 1, dtype=np.int64),
+        "p_promo_id": [f"AAAAAAAA{k:08d}" for k in range(1, n_promo + 1)],
+        "p_start_date_sk": rng.randint(1, nd + 1, n_promo).astype(np.int64),
+        "p_end_date_sk": rng.randint(1, nd + 1, n_promo).astype(np.int64),
+        "p_item_sk": rng.randint(1, n_item + 1, n_promo).astype(np.int64),
+        "p_cost": 1000.0,
+        "p_response_target": 1,
+        "p_promo_name": _pick(rng, ["ought", "able", "pri"], n_promo),
+        "p_channel_dmail": _pick(rng, ["Y", "N"], n_promo),
+        "p_channel_email": _pick(rng, ["Y", "N"], n_promo),
+        "p_channel_catalog": _pick(rng, ["Y", "N"], n_promo),
+        "p_channel_tv": _pick(rng, ["Y", "N"], n_promo),
+        "p_channel_radio": _pick(rng, ["Y", "N"], n_promo),
+        "p_channel_press": _pick(rng, ["Y", "N"], n_promo),
+        "p_channel_event": _pick(rng, ["Y", "N"], n_promo),
+        "p_channel_demo": _pick(rng, ["Y", "N"], n_promo),
+        "p_channel_details": "details",
+        "p_purpose": "Unknown",
+        "p_discount_active": "N",
+    })
+    call_center = pd.DataFrame({
+        "cc_call_center_sk": np.arange(1, n_cc + 1, dtype=np.int64),
+        "cc_call_center_id": [f"AAAAAAAA{k:08d}" for k in range(1, n_cc + 1)],
+        "cc_name": [f"call center {k}" for k in range(1, n_cc + 1)],
+        "cc_class": "medium",
+        "cc_employees": rng.randint(100, 700, n_cc).astype(np.int64),
+        "cc_manager": "Bob Belcher",
+        "cc_county": _pick(rng, _COUNTIES[:1], n_cc),
+        "cc_state": _pick(rng, ["TN", "GA"], n_cc),
+    })
+    catalog_page = pd.DataFrame({
+        "cp_catalog_page_sk": np.arange(1, n_cp + 1, dtype=np.int64),
+        "cp_catalog_page_id": [f"AAAAAAAA{k:08d}" for k in range(1, n_cp + 1)],
+        "cp_catalog_number": rng.randint(1, 10, n_cp).astype(np.int64),
+        "cp_catalog_page_number": np.arange(1, n_cp + 1, dtype=np.int64),
+        "cp_department": "DEPARTMENT",
+        "cp_description": "catalog page description",
+        "cp_type": "monthly",
+    })
+    web_site = pd.DataFrame({
+        "web_site_sk": np.arange(1, n_web + 1, dtype=np.int64),
+        "web_site_id": [f"AAAAAAAA{k:08d}" for k in range(1, n_web + 1)],
+        "web_name": [f"site_{k}" for k in range(n_web)],
+        "web_manager": "Adam Stonge",
+        "web_company_id": rng.randint(1, 7, n_web).astype(np.int64),
+        "web_company_name": _pick(rng, ["pri", "able", "ought", "ese"], n_web),
+    })
+    web_page = pd.DataFrame({
+        "wp_web_page_sk": np.arange(1, n_wp + 1, dtype=np.int64),
+        "wp_web_page_id": [f"AAAAAAAA{k:08d}" for k in range(1, n_wp + 1)],
+        "wp_creation_date_sk": rng.randint(1, nd + 1, n_wp).astype(np.int64),
+        "wp_access_date_sk": rng.randint(1, nd + 1, n_wp).astype(np.int64),
+        "wp_autogen_flag": _pick(rng, ["Y", "N"], n_wp),
+        "wp_url": "http://www.foo.com",
+        "wp_type": _pick(rng, ["general", "welcome", "protected"], n_wp),
+        "wp_char_count": rng.randint(4000, 6000, n_wp).astype(np.int64),
+        "wp_link_count": rng.randint(2, 25, n_wp).astype(np.int64),
+        "wp_image_count": rng.randint(1, 7, n_wp).astype(np.int64),
+    })
+    reason = pd.DataFrame({
+        "r_reason_sk": np.arange(1, 36, dtype=np.int64),
+        "r_reason_id": [f"AAAAAAAA{k:08d}" for k in range(1, 36)],
+        "r_reason_desc": [f"reason {k}" for k in range(1, 36)],
+    })
+    ship_mode = pd.DataFrame({
+        "sm_ship_mode_sk": np.arange(1, 21, dtype=np.int64),
+        "sm_ship_mode_id": [f"AAAAAAAA{k:08d}" for k in range(1, 21)],
+        "sm_type": np.array(["EXPRESS", "NEXT DAY", "OVERNIGHT", "REGULAR",
+                             "LIBRARY"] * 4, dtype=object),
+        "sm_code": np.array(["AIR", "SURFACE", "SEA", "AIR", "SURFACE"] * 4,
+                            dtype=object),
+        "sm_carrier": np.array(["DHL", "BARIAN", "UPS", "FEDEX", "USPS"] * 4,
+                               dtype=object),
+        "sm_contract": "contract",
+    })
+
+    def sales_common(n):
+        return {
+            "sold_date_sk": rng.randint(1, nd + 1, n).astype(np.int64),
+            "sold_time_sk": time_dim["t_time_sk"].to_numpy()[
+                rng.randint(0, len(time_dim), n)],
+            "item_sk": rng.randint(1, n_item + 1, n).astype(np.int64),
+            "quantity": rng.randint(1, 101, n).astype(np.int64),
+            "wholesale_cost": np.round(rng.uniform(1, 100, n), 2),
+            "list_price": np.round(rng.uniform(1, 200, n), 2),
+            "sales_price": np.round(rng.uniform(1, 200, n), 2),
+            "ext_discount_amt": np.round(rng.uniform(0, 100, n), 2),
+            "ext_sales_price": np.round(rng.uniform(1, 2000, n), 2),
+            "ext_wholesale_cost": np.round(rng.uniform(1, 2000, n), 2),
+            "ext_list_price": np.round(rng.uniform(1, 4000, n), 2),
+            "ext_tax": np.round(rng.uniform(0, 200, n), 2),
+            "coupon_amt": np.round(rng.uniform(0, 500, n), 2),
+            "net_paid": np.round(rng.uniform(1, 2000, n), 2),
+            "net_paid_inc_tax": np.round(rng.uniform(1, 2200, n), 2),
+            "net_profit": np.round(rng.uniform(-500, 2000, n), 2),
+        }
+
+    n_ss = scale_rows
+    sc = sales_common(n_ss)
+    store_sales = pd.DataFrame({
+        "ss_sold_date_sk": sc["sold_date_sk"],
+        "ss_sold_time_sk": sc["sold_time_sk"],
+        "ss_item_sk": sc["item_sk"],
+        "ss_customer_sk": rng.randint(1, n_cust + 1, n_ss).astype(np.int64),
+        "ss_cdemo_sk": rng.randint(1, n_cd + 1, n_ss).astype(np.int64),
+        "ss_hdemo_sk": rng.randint(1, n_hd + 1, n_ss).astype(np.int64),
+        "ss_addr_sk": _null_some(rng, rng.randint(1, n_addr + 1, n_ss), 0.02),
+        "ss_store_sk": _null_some(rng, rng.randint(1, n_store + 1, n_ss), 0.02),
+        "ss_promo_sk": rng.randint(1, n_promo + 1, n_ss).astype(np.int64),
+        "ss_ticket_number": (np.arange(n_ss, dtype=np.int64) // 3) + 1,
+        "ss_quantity": sc["quantity"],
+        "ss_wholesale_cost": sc["wholesale_cost"],
+        "ss_list_price": sc["list_price"],
+        "ss_sales_price": sc["sales_price"],
+        "ss_ext_discount_amt": sc["ext_discount_amt"],
+        "ss_ext_sales_price": sc["ext_sales_price"],
+        "ss_ext_wholesale_cost": sc["ext_wholesale_cost"],
+        "ss_ext_list_price": sc["ext_list_price"],
+        "ss_ext_tax": sc["ext_tax"],
+        "ss_coupon_amt": sc["coupon_amt"],
+        "ss_net_paid": sc["net_paid"],
+        "ss_net_paid_inc_tax": sc["net_paid_inc_tax"],
+        "ss_net_profit": sc["net_profit"],
+    })
+    # returns reference real sales rows for key consistency
+    n_sr = max(n_ss // 10, 20)
+    ridx = rng.choice(n_ss, n_sr, replace=False)
+    store_returns = pd.DataFrame({
+        "sr_returned_date_sk": np.minimum(
+            store_sales["ss_sold_date_sk"].to_numpy()[ridx]
+            + rng.randint(1, 120, n_sr), nd).astype(np.int64),
+        "sr_return_time_sk": store_sales["ss_sold_time_sk"].to_numpy()[ridx],
+        "sr_item_sk": store_sales["ss_item_sk"].to_numpy()[ridx],
+        "sr_customer_sk": store_sales["ss_customer_sk"].to_numpy()[ridx],
+        "sr_cdemo_sk": rng.randint(1, n_cd + 1, n_sr).astype(np.int64),
+        "sr_hdemo_sk": rng.randint(1, n_hd + 1, n_sr).astype(np.int64),
+        "sr_addr_sk": rng.randint(1, n_addr + 1, n_sr).astype(np.int64),
+        "sr_store_sk": np.nan_to_num(
+            store_sales["ss_store_sk"].to_numpy()[ridx], nan=1.0
+        ).astype(np.int64),
+        "sr_reason_sk": rng.randint(1, 36, n_sr).astype(np.int64),
+        "sr_ticket_number": store_sales["ss_ticket_number"].to_numpy()[ridx],
+        "sr_return_quantity": rng.randint(1, 50, n_sr).astype(np.int64),
+        "sr_return_amt": np.round(rng.uniform(1, 20000, n_sr), 2),
+        "sr_return_tax": np.round(rng.uniform(0, 100, n_sr), 2),
+        "sr_return_amt_inc_tax": np.round(rng.uniform(1, 1100, n_sr), 2),
+        "sr_fee": np.round(rng.uniform(1, 100, n_sr), 2),
+        "sr_return_ship_cost": np.round(rng.uniform(0, 500, n_sr), 2),
+        "sr_refunded_cash": np.round(rng.uniform(0, 1000, n_sr), 2),
+        "sr_reversed_charge": np.round(rng.uniform(0, 1000, n_sr), 2),
+        "sr_store_credit": np.round(rng.uniform(0, 1000, n_sr), 2),
+        "sr_net_loss": np.round(rng.uniform(1, 1000, n_sr), 2),
+    })
+
+    n_cs = max(scale_rows // 2, 100)
+    cc2 = sales_common(n_cs)
+    catalog_sales = pd.DataFrame({
+        "cs_sold_date_sk": cc2["sold_date_sk"],
+        "cs_sold_time_sk": cc2["sold_time_sk"],
+        "cs_ship_date_sk": np.minimum(cc2["sold_date_sk"]
+                                      + rng.randint(1, 130, n_cs), nd
+                                      ).astype(np.int64),
+        "cs_bill_customer_sk": rng.randint(1, n_cust + 1, n_cs).astype(np.int64),
+        "cs_bill_cdemo_sk": rng.randint(1, n_cd + 1, n_cs).astype(np.int64),
+        "cs_bill_hdemo_sk": rng.randint(1, n_hd + 1, n_cs).astype(np.int64),
+        "cs_bill_addr_sk": rng.randint(1, n_addr + 1, n_cs).astype(np.int64),
+        "cs_ship_customer_sk": rng.randint(1, n_cust + 1, n_cs).astype(np.int64),
+        "cs_ship_cdemo_sk": rng.randint(1, n_cd + 1, n_cs).astype(np.int64),
+        "cs_ship_hdemo_sk": rng.randint(1, n_hd + 1, n_cs).astype(np.int64),
+        "cs_ship_addr_sk": _null_some(rng, rng.randint(1, n_addr + 1, n_cs), 0.02),
+        "cs_call_center_sk": rng.randint(1, n_cc + 1, n_cs).astype(np.int64),
+        "cs_catalog_page_sk": rng.randint(1, n_cp + 1, n_cs).astype(np.int64),
+        "cs_ship_mode_sk": rng.randint(1, 21, n_cs).astype(np.int64),
+        "cs_warehouse_sk": rng.randint(1, n_wh + 1, n_cs).astype(np.int64),
+        "cs_item_sk": cc2["item_sk"],
+        "cs_promo_sk": rng.randint(1, n_promo + 1, n_cs).astype(np.int64),
+        "cs_order_number": (np.arange(n_cs, dtype=np.int64) // 2) + 1,
+        "cs_quantity": cc2["quantity"],
+        "cs_wholesale_cost": cc2["wholesale_cost"],
+        "cs_list_price": cc2["list_price"],
+        "cs_sales_price": cc2["sales_price"],
+        "cs_ext_discount_amt": cc2["ext_discount_amt"],
+        "cs_ext_sales_price": cc2["ext_sales_price"],
+        "cs_ext_wholesale_cost": cc2["ext_wholesale_cost"],
+        "cs_ext_list_price": cc2["ext_list_price"],
+        "cs_ext_tax": cc2["ext_tax"],
+        "cs_coupon_amt": cc2["coupon_amt"],
+        "cs_ext_ship_cost": np.round(rng.uniform(0, 500, n_cs), 2),
+        "cs_net_paid": cc2["net_paid"],
+        "cs_net_paid_inc_tax": cc2["net_paid_inc_tax"],
+        "cs_net_profit": cc2["net_profit"],
+    })
+    n_cr = max(n_cs // 10, 10)
+    ridx = rng.choice(n_cs, n_cr, replace=False)
+    catalog_returns = pd.DataFrame({
+        "cr_returned_date_sk": np.minimum(
+            catalog_sales["cs_sold_date_sk"].to_numpy()[ridx]
+            + rng.randint(1, 120, n_cr), nd).astype(np.int64),
+        "cr_returned_time_sk": catalog_sales["cs_sold_time_sk"].to_numpy()[ridx],
+        "cr_item_sk": catalog_sales["cs_item_sk"].to_numpy()[ridx],
+        "cr_refunded_customer_sk": rng.randint(1, n_cust + 1, n_cr).astype(np.int64),
+        "cr_refunded_cdemo_sk": rng.randint(1, n_cd + 1, n_cr).astype(np.int64),
+        "cr_refunded_hdemo_sk": rng.randint(1, n_hd + 1, n_cr).astype(np.int64),
+        "cr_refunded_addr_sk": rng.randint(1, n_addr + 1, n_cr).astype(np.int64),
+        "cr_returning_customer_sk": rng.randint(1, n_cust + 1, n_cr).astype(np.int64),
+        "cr_returning_cdemo_sk": rng.randint(1, n_cd + 1, n_cr).astype(np.int64),
+        "cr_returning_hdemo_sk": rng.randint(1, n_hd + 1, n_cr).astype(np.int64),
+        "cr_returning_addr_sk": rng.randint(1, n_addr + 1, n_cr).astype(np.int64),
+        "cr_call_center_sk": rng.randint(1, n_cc + 1, n_cr).astype(np.int64),
+        "cr_catalog_page_sk": rng.randint(1, n_cp + 1, n_cr).astype(np.int64),
+        "cr_ship_mode_sk": rng.randint(1, 21, n_cr).astype(np.int64),
+        "cr_warehouse_sk": rng.randint(1, n_wh + 1, n_cr).astype(np.int64),
+        "cr_reason_sk": rng.randint(1, 36, n_cr).astype(np.int64),
+        "cr_order_number": catalog_sales["cs_order_number"].to_numpy()[ridx],
+        "cr_return_quantity": rng.randint(1, 50, n_cr).astype(np.int64),
+        "cr_return_amount": np.round(rng.uniform(1, 20000, n_cr), 2),
+        "cr_return_tax": np.round(rng.uniform(0, 100, n_cr), 2),
+        "cr_return_amt_inc_tax": np.round(rng.uniform(1, 1100, n_cr), 2),
+        "cr_fee": np.round(rng.uniform(1, 100, n_cr), 2),
+        "cr_return_ship_cost": np.round(rng.uniform(0, 500, n_cr), 2),
+        "cr_refunded_cash": np.round(rng.uniform(0, 1000, n_cr), 2),
+        "cr_reversed_charge": np.round(rng.uniform(0, 1000, n_cr), 2),
+        "cr_store_credit": np.round(rng.uniform(0, 1000, n_cr), 2),
+        "cr_net_loss": np.round(rng.uniform(1, 1000, n_cr), 2),
+    })
+
+    n_ws = max(scale_rows // 2, 100)
+    wc = sales_common(n_ws)
+    web_sales = pd.DataFrame({
+        "ws_sold_date_sk": wc["sold_date_sk"],
+        "ws_sold_time_sk": wc["sold_time_sk"],
+        "ws_ship_date_sk": np.minimum(wc["sold_date_sk"]
+                                      + rng.randint(1, 130, n_ws), nd
+                                      ).astype(np.int64),
+        "ws_item_sk": wc["item_sk"],
+        "ws_bill_customer_sk": rng.randint(1, n_cust + 1, n_ws).astype(np.int64),
+        "ws_bill_cdemo_sk": rng.randint(1, n_cd + 1, n_ws).astype(np.int64),
+        "ws_bill_hdemo_sk": rng.randint(1, n_hd + 1, n_ws).astype(np.int64),
+        "ws_bill_addr_sk": rng.randint(1, n_addr + 1, n_ws).astype(np.int64),
+        "ws_ship_customer_sk": _null_some(
+            rng, rng.randint(1, n_cust + 1, n_ws), 0.02),
+        "ws_ship_cdemo_sk": rng.randint(1, n_cd + 1, n_ws).astype(np.int64),
+        "ws_ship_hdemo_sk": rng.randint(1, n_hd + 1, n_ws).astype(np.int64),
+        "ws_ship_addr_sk": rng.randint(1, n_addr + 1, n_ws).astype(np.int64),
+        "ws_web_page_sk": rng.randint(1, n_wp + 1, n_ws).astype(np.int64),
+        "ws_web_site_sk": rng.randint(1, n_web + 1, n_ws).astype(np.int64),
+        "ws_ship_mode_sk": rng.randint(1, 21, n_ws).astype(np.int64),
+        "ws_warehouse_sk": rng.randint(1, n_wh + 1, n_ws).astype(np.int64),
+        "ws_promo_sk": rng.randint(1, n_promo + 1, n_ws).astype(np.int64),
+        "ws_order_number": (np.arange(n_ws, dtype=np.int64) // 2) + 1,
+        "ws_quantity": wc["quantity"],
+        "ws_wholesale_cost": wc["wholesale_cost"],
+        "ws_list_price": wc["list_price"],
+        "ws_sales_price": wc["sales_price"],
+        "ws_ext_discount_amt": wc["ext_discount_amt"],
+        "ws_ext_sales_price": wc["ext_sales_price"],
+        "ws_ext_wholesale_cost": wc["ext_wholesale_cost"],
+        "ws_ext_list_price": wc["ext_list_price"],
+        "ws_ext_tax": wc["ext_tax"],
+        "ws_coupon_amt": wc["coupon_amt"],
+        "ws_ext_ship_cost": np.round(rng.uniform(0, 500, n_ws), 2),
+        "ws_net_paid": wc["net_paid"],
+        "ws_net_paid_inc_tax": wc["net_paid_inc_tax"],
+        "ws_net_profit": wc["net_profit"],
+    })
+    n_wr = max(n_ws // 10, 10)
+    ridx = rng.choice(n_ws, n_wr, replace=False)
+    web_returns = pd.DataFrame({
+        "wr_returned_date_sk": np.minimum(
+            web_sales["ws_sold_date_sk"].to_numpy()[ridx]
+            + rng.randint(1, 120, n_wr), nd).astype(np.int64),
+        "wr_returned_time_sk": web_sales["ws_sold_time_sk"].to_numpy()[ridx],
+        "wr_item_sk": web_sales["ws_item_sk"].to_numpy()[ridx],
+        "wr_refunded_customer_sk": rng.randint(1, n_cust + 1, n_wr).astype(np.int64),
+        "wr_refunded_cdemo_sk": rng.randint(1, n_cd + 1, n_wr).astype(np.int64),
+        "wr_refunded_hdemo_sk": rng.randint(1, n_hd + 1, n_wr).astype(np.int64),
+        "wr_refunded_addr_sk": rng.randint(1, n_addr + 1, n_wr).astype(np.int64),
+        "wr_returning_customer_sk": rng.randint(1, n_cust + 1, n_wr).astype(np.int64),
+        "wr_returning_cdemo_sk": rng.randint(1, n_cd + 1, n_wr).astype(np.int64),
+        "wr_returning_hdemo_sk": rng.randint(1, n_hd + 1, n_wr).astype(np.int64),
+        "wr_returning_addr_sk": rng.randint(1, n_addr + 1, n_wr).astype(np.int64),
+        "wr_web_page_sk": rng.randint(1, n_wp + 1, n_wr).astype(np.int64),
+        "wr_reason_sk": rng.randint(1, 36, n_wr).astype(np.int64),
+        "wr_order_number": web_sales["ws_order_number"].to_numpy()[ridx],
+        "wr_return_quantity": rng.randint(1, 50, n_wr).astype(np.int64),
+        "wr_return_amt": np.round(rng.uniform(1, 20000, n_wr), 2),
+        "wr_return_tax": np.round(rng.uniform(0, 100, n_wr), 2),
+        "wr_return_amt_inc_tax": np.round(rng.uniform(1, 1100, n_wr), 2),
+        "wr_fee": np.round(rng.uniform(1, 100, n_wr), 2),
+        "wr_return_ship_cost": np.round(rng.uniform(0, 500, n_wr), 2),
+        "wr_refunded_cash": np.round(rng.uniform(0, 1000, n_wr), 2),
+        "wr_reversed_charge": np.round(rng.uniform(0, 1000, n_wr), 2),
+        "wr_account_credit": np.round(rng.uniform(0, 1000, n_wr), 2),
+        "wr_net_loss": np.round(rng.uniform(1, 1000, n_wr), 2),
+    })
+
+    n_inv = max(scale_rows // 2, 200)
+    inventory = pd.DataFrame({
+        "inv_date_sk": rng.randint(1, nd + 1, n_inv).astype(np.int64),
+        "inv_item_sk": rng.randint(1, n_item + 1, n_inv).astype(np.int64),
+        "inv_warehouse_sk": rng.randint(1, n_wh + 1, n_inv).astype(np.int64),
+        "inv_quantity_on_hand": rng.randint(0, 1000, n_inv).astype(np.int64),
+    })
+
+    return {
+        "store_sales": store_sales,
+        "store_returns": store_returns,
+        "catalog_sales": catalog_sales,
+        "catalog_returns": catalog_returns,
+        "web_sales": web_sales,
+        "web_returns": web_returns,
+        "inventory": inventory,
+        "date_dim": date_dim,
+        "time_dim": time_dim,
+        "item": item,
+        "customer": customer,
+        "customer_address": customer_address,
+        "customer_demographics": customer_demographics,
+        "household_demographics": household_demographics,
+        "income_band": income_band,
+        "store": store,
+        "warehouse": warehouse,
+        "promotion": promotion,
+        "call_center": call_center,
+        "catalog_page": catalog_page,
+        "web_site": web_site,
+        "web_page": web_page,
+        "reason": reason,
+        "ship_mode": ship_mode,
+    }

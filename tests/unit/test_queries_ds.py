@@ -1,0 +1,64 @@
+"""TPC-DS q1-q99 runner with an explicit xfail list.
+
+Parity: the reference's coverage yardstick (reference
+tests/unit/test_queries.py:5-44 — 99 TPC-DS-style queries with a 38-query
+XFAIL list; 61 expected passes on CPU).  Here 99 standard TPC-DS queries run
+against generated in-memory tables; the xfail list below is the honest
+record of what the engine cannot do yet, grouped by root cause.
+"""
+import pytest
+
+from tests.tpcds import generate
+from tests.tpcds_queries import QUERIES
+
+# Root causes (round 2 state):
+#   grouping   — GROUPING() function not implemented
+#   cte-reuse  — IndexError when a CTE/view is self-joined 3+ times
+#   having     — HAVING/qualify references a select alias of an aggregate
+#   decorrelate— correlated subquery shape not decorrelated
+#   misc       — see message in the probe log
+XFAIL_QUERIES = {
+    4: "cte-reuse", 8: "misc: empty intermediate", 10: "decorrelate",
+    11: "cte-reuse", 17: "cte-reuse", 25: "cte-reuse",
+    27: "grouping", 29: "cte-reuse", 31: "cte-reuse",
+    33: "having", 35: "decorrelate", 36: "grouping", 41: "decorrelate",
+    47: "cte-reuse", 56: "having", 57: "cte-reuse",
+    58: "misc: ambiguous column via CTE triple join", 60: "having",
+    70: "grouping", 71: "having",
+    72: "cte-reuse", 74: "cte-reuse", 77: "misc: empty channel gather",
+    83: "cte-reuse", 84: "misc: non-integer gather index", 85: "misc",
+    86: "grouping",
+}
+# too slow at any scale without the compiled join pipeline — skipped, not xfail
+SLOW_QUERIES = {23: "4 CTE scans x self-joins", 24: "ssales CTE x2",
+                64: "18-table join at test scale"}
+
+
+@pytest.fixture(scope="module")
+def tpcds_context():
+    from dask_sql_tpu import Context
+
+    c = Context()
+    for name, df in generate(scale_rows=1000).items():
+        c.create_table(name, df)
+    return c
+
+
+def _params():
+    for qnum in sorted(QUERIES):
+        marks = []
+        if qnum in SLOW_QUERIES:
+            marks.append(pytest.mark.skip(reason=f"q{qnum}: {SLOW_QUERIES[qnum]}"))
+        elif qnum in XFAIL_QUERIES:
+            # declarative xfail: the query still RUNS, so a query that starts
+            # passing surfaces as XPASS instead of silently going stale
+            marks.append(pytest.mark.xfail(
+                reason=f"q{qnum}: {XFAIL_QUERIES[qnum]}", strict=False))
+        yield pytest.param(qnum, marks=marks)
+
+
+@pytest.mark.parametrize("qnum", _params())
+def test_query(tpcds_context, qnum):
+    result = tpcds_context.sql(QUERIES[qnum]).compute()
+    assert result is not None
+    assert len(result.columns) > 0
