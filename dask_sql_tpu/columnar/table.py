@@ -84,9 +84,11 @@ class Table:
         return Table({mapping.get(n, n): c for n, c in self.columns.items()}, self._num_rows)
 
     def filter(self, mask) -> "Table":
-        cols = {n: c.filter(mask) for n, c in self.columns.items()}
-        n = len(next(iter(cols.values()))) if cols else int(np.asarray(mask).sum())
-        return Table(cols, n)
+        # one nonzero for the whole table, then integer gathers per column —
+        # per-column boolean indexing pays the bool->index expansion N times
+        indices = jnp.nonzero(jnp.asarray(mask))[0]
+        return Table({n: c.take(indices) for n, c in self.columns.items()},
+                     int(indices.shape[0]))
 
     def take(self, indices) -> "Table":
         indices = jnp.asarray(indices)

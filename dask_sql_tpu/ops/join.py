@@ -160,6 +160,41 @@ def _dense_match(lgid, rgid):
     return matched, ri_cand
 
 
+def dense_unique_lut(key: jnp.ndarray, valid=None):
+    """(rmin, lut) for a unique-dense-int key column, or None if ineligible.
+
+    lut[v - rmin] = row index holding key v, -1 where no row does.  NULL
+    rows (valid=False) never enter the table.  Shares the eligibility rules
+    of _dense_match; used by the compiled join pipeline, which builds LUTs
+    eagerly per build table and probes inside one jit."""
+    nr = int(key.shape[0])
+    if nr == 0 or not jnp.issubdtype(key.dtype, jnp.integer):
+        return None
+    k = key.astype(jnp.int64)
+    if valid is not None:
+        # exclude NULLs from the range scan so they can't blow the gate
+        big = jnp.iinfo(jnp.int64).max
+        small = jnp.iinfo(jnp.int64).min
+        rmin = int(jnp.min(jnp.where(valid, k, big)))
+        rmax = int(jnp.max(jnp.where(valid, k, small)))
+        if rmin > rmax:
+            return None  # all NULL
+    else:
+        rmin, rmax = (int(x) for x in _minmax(k))
+    size = rmax - rmin + 1
+    if size <= 0 or size > max(_DENSE_RANGE_SLACK * nr, _DENSE_RANGE_FLOOR):
+        return None
+    idx = k - rmin
+    if valid is not None:
+        idx = jnp.where(valid, idx, size)  # out of bounds -> dropped
+    counts = jnp.zeros(size, dtype=jnp.int32).at[idx].add(1, mode="drop")
+    if int(jnp.max(counts)) > 1:
+        return None
+    lut = jnp.full(size, -1, dtype=jnp.int64)
+    lut = lut.at[idx].set(jnp.arange(nr, dtype=jnp.int64), mode="drop")
+    return rmin, lut
+
+
 def inner_join_indices(lgid: jnp.ndarray, rgid: jnp.ndarray,
                        use_jit: bool = False) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """(left_idx, right_idx) pairs of matches, left-major order."""

@@ -77,6 +77,64 @@ class _Unsupported(Exception):
     pass
 
 
+def segment_agg_outputs(ev, slots, agg_exprs, sel, gid, domain, ssum):
+    """Per-aggregate segment reductions under jit tracing.
+
+    Shared by the scan->aggregate pipeline (CompiledAggregate) and the
+    join->aggregate pipeline (compiled_join.py).  Returns one
+    (values[domain], validity_or_None[domain]) pair per AggExpr; `sel`
+    is the row-selection mask (deferred filters — nothing compacts)."""
+    outs = []
+    for a in agg_exprs:
+        valid = sel
+        if a.filter is not None:
+            fd, fv = ev.eval(a.filter, slots)
+            fm = fd if fv is None else (fd & fv)
+            valid = valid & fm
+        if a.func == "count_star":
+            outs.append((ssum(valid.astype(jnp.int64), gid), None))
+            continue
+        ad, av = ev.eval(a.args[0], slots)
+        v = valid if av is None else (valid & av)
+        if jnp.issubdtype(ad.dtype, jnp.floating):
+            v = v & ~jnp.isnan(ad)
+        cnt = ssum(v.astype(jnp.int64), gid)
+        if a.func == "count":
+            outs.append((cnt, None))
+            continue
+        if a.func in ("sum", "avg"):
+            acc = ad.astype(jnp.int64) if jnp.issubdtype(ad.dtype, jnp.integer) else ad
+            s = ssum(jnp.where(v, acc, jnp.zeros_like(acc)), gid)
+            if a.func == "avg":
+                outs.append((s.astype(jnp.float64) / jnp.maximum(cnt, 1), cnt > 0))
+            else:
+                outs.append((s, cnt > 0))
+            continue
+        if a.func in ("min", "max"):
+            if jnp.issubdtype(ad.dtype, jnp.floating):
+                fill = jnp.array(jnp.inf if a.func == "min" else -jnp.inf,
+                                 dtype=ad.dtype)
+            else:
+                info = jnp.iinfo(ad.dtype)
+                fill = jnp.array(info.max if a.func == "min" else info.min,
+                                 dtype=ad.dtype)
+            contrib = jnp.where(v, ad, fill)
+            red = (jax.ops.segment_min if a.func == "min"
+                   else jax.ops.segment_max)(contrib, gid, domain)
+            outs.append((jnp.where(cnt > 0, red, jnp.zeros_like(red)), cnt > 0))
+            continue
+        # variance family
+        x = ad.astype(jnp.float64)
+        s1 = ssum(jnp.where(v, x, 0.0), gid)
+        s2 = ssum(jnp.where(v, x * x, 0.0), gid)
+        ddof = 1 if a.func.endswith("samp") else 0
+        mean = s1 / jnp.maximum(cnt, 1)
+        var = jnp.maximum(s2 - cnt * mean * mean, 0.0) / jnp.maximum(cnt - ddof, 1)
+        out = jnp.sqrt(var) if a.func.startswith("stddev") else var
+        outs.append((out, cnt > ddof))
+    return outs
+
+
 class _TraceEval:
     """Expression evaluator usable under jit tracing.
 
@@ -507,54 +565,7 @@ class CompiledAggregate:
                 gid = jnp.zeros(n_rows, dtype=jnp.int64)
             sel = mask if mask is not None else jnp.ones(n_rows, dtype=bool)
             hit = ssum(sel.astype(jnp.int32), gid) > 0
-            outs = []
-            for a in agg_exprs:
-                valid = sel
-                if a.filter is not None:
-                    fd, fv = ev.eval(a.filter, slots)
-                    fm = fd if fv is None else (fd & fv)
-                    valid = valid & fm
-                if a.func == "count_star":
-                    outs.append((ssum(valid.astype(jnp.int64), gid), None))
-                    continue
-                ad, av = ev.eval(a.args[0], slots)
-                v = valid if av is None else (valid & av)
-                if jnp.issubdtype(ad.dtype, jnp.floating):
-                    v = v & ~jnp.isnan(ad)
-                cnt = ssum(v.astype(jnp.int64), gid)
-                if a.func == "count":
-                    outs.append((cnt, None))
-                    continue
-                if a.func in ("sum", "avg"):
-                    acc = ad.astype(jnp.int64) if jnp.issubdtype(ad.dtype, jnp.integer) else ad
-                    s = ssum(jnp.where(v, acc, jnp.zeros_like(acc)), gid)
-                    if a.func == "avg":
-                        outs.append((s.astype(jnp.float64) / jnp.maximum(cnt, 1), cnt > 0))
-                    else:
-                        outs.append((s, cnt > 0))
-                    continue
-                if a.func in ("min", "max"):
-                    if jnp.issubdtype(ad.dtype, jnp.floating):
-                        fill = jnp.array(jnp.inf if a.func == "min" else -jnp.inf,
-                                         dtype=ad.dtype)
-                    else:
-                        info = jnp.iinfo(ad.dtype)
-                        fill = jnp.array(info.max if a.func == "min" else info.min,
-                                         dtype=ad.dtype)
-                    contrib = jnp.where(v, ad, fill)
-                    red = (jax.ops.segment_min if a.func == "min"
-                           else jax.ops.segment_max)(contrib, gid, domain)
-                    outs.append((jnp.where(cnt > 0, red, jnp.zeros_like(red)), cnt > 0))
-                    continue
-                # variance family
-                x = ad.astype(jnp.float64)
-                s1 = ssum(jnp.where(v, x, 0.0), gid)
-                s2 = ssum(jnp.where(v, x * x, 0.0), gid)
-                ddof = 1 if a.func.endswith("samp") else 0
-                mean = s1 / jnp.maximum(cnt, 1)
-                var = jnp.maximum(s2 - cnt * mean * mean, 0.0) / jnp.maximum(cnt - ddof, 1)
-                out = jnp.sqrt(var) if a.func.startswith("stddev") else var
-                outs.append((out, cnt > ddof))
+            outs = segment_agg_outputs(ev, slots, agg_exprs, sel, gid, domain, ssum)
             flat = [hit]
             for d, v in outs:
                 flat.append(d)

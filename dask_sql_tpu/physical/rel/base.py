@@ -47,13 +47,24 @@ class BaseRelPlugin:
 
 
 def unique_names(names: List[str]) -> List[str]:
-    seen = {}
+    """Disambiguate duplicates with __N suffixes, collision-proof against
+    inputs that already carry a suffix (a 3-way self-join's second 'g' must
+    not collide with an existing 'g__1' — Table columns are a dict, so a
+    collision silently DROPS a column)."""
+    seen = set()
+    counts: dict = {}
     out = []
     for n in names:
         if n not in seen:
-            seen[n] = 0
+            seen.add(n)
             out.append(n)
-        else:
-            seen[n] += 1
-            out.append(f"{n}__{seen[n]}")
+            continue
+        i = counts.get(n, 0) + 1
+        cand = f"{n}__{i}"
+        while cand in seen:
+            i += 1
+            cand = f"{n}__{i}"
+        counts[n] = i
+        seen.add(cand)
+        out.append(cand)
     return out

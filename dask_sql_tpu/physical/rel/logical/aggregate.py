@@ -50,6 +50,11 @@ class AggregatePlugin(BaseRelPlugin):
         streamed = try_streaming_aggregate(rel, executor)
         if streamed is not None:
             return streamed
+        from ...compiled_join import try_compiled_join_aggregate
+
+        joined = try_compiled_join_aggregate(rel, executor)
+        if joined is not None:
+            return joined
         compiled = try_compiled_aggregate(rel, executor)
         if compiled is not None:
             return compiled
