@@ -190,8 +190,10 @@ def dense_unique_lut(key: jnp.ndarray, valid=None):
     counts = jnp.zeros(size, dtype=jnp.int32).at[idx].add(1, mode="drop")
     if int(jnp.max(counts)) > 1:
         return None
-    lut = jnp.full(size, -1, dtype=jnp.int64)
-    lut = lut.at[idx].set(jnp.arange(nr, dtype=jnp.int64), mode="drop")
+    # row ids always fit int32 (single-shard row counts < 2^31); int64
+    # gathers/compares are emulated on TPU
+    lut = jnp.full(size, -1, dtype=jnp.int32)
+    lut = lut.at[idx].set(jnp.arange(nr, dtype=jnp.int32), mode="drop")
     return rmin, lut
 
 

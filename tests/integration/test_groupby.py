@@ -168,3 +168,42 @@ def test_global_aggregate_over_empty_input(c):
         g = c.sql("SELECT a, COUNT(*) AS n FROM df_simple WHERE a > 1e9 "
                   "GROUP BY a", config_options=opts).compute()
         assert len(g) == 0
+
+
+def test_narrow_int_group_key_span_overflow(c):
+    """Regression (r3 review): int8/int16 group keys whose span exceeds the
+    dtype's positive range must widen before the radix offset subtraction —
+    otherwise rows silently merge into the wrong group."""
+    rng = np.random.RandomState(11)
+    vals = rng.choice(np.array([-100, -3, 0, 7, 100], dtype=np.int8), 500)
+    df = pd.DataFrame({"g": vals, "v": rng.rand(500)})
+    c.create_table("narrowkey", df)
+    got = c.sql("SELECT g, COUNT(*) AS n, SUM(v) AS s FROM narrowkey GROUP BY g"
+                ).compute().sort_values("g").reset_index(drop=True)
+    ref = (df.groupby("g", as_index=False)
+             .agg(n=("v", "size"), s=("v", "sum"))
+             .sort_values("g").reset_index(drop=True))
+    assert list(got["g"].astype(np.int64)) == list(ref["g"].astype(np.int64))
+    assert list(got["n"].astype(np.int64)) == list(ref["n"].astype(np.int64))
+    np.testing.assert_allclose(got["s"], ref["s"], rtol=1e-6)
+
+
+def test_narrow_int_join_key_span_overflow(c):
+    """Regression (r3 review): int16 join keys spanning past the dtype's
+    positive range must widen before `key - rmin` in the compiled LUT probe."""
+    build = pd.DataFrame({"k": np.array([-30000, -5, 0, 9, 30000], dtype=np.int16),
+                          "name": ["a", "b", "c", "d", "e"]})
+    rng = np.random.RandomState(12)
+    probe = pd.DataFrame({"k": rng.choice(
+        np.array([-30000, 0, 30000], dtype=np.int16), 300),
+        "v": rng.rand(300)})
+    c.create_table("nj_dim", build)
+    c.create_table("nj_fact", probe)
+    got = c.sql(
+        "SELECT d.name, COUNT(*) AS n FROM nj_fact f, nj_dim d "
+        "WHERE f.k = d.k GROUP BY d.name"
+    ).compute().sort_values("name").reset_index(drop=True)
+    ref = (probe.merge(build, on="k").groupby("name", as_index=False)
+           .agg(n=("v", "size")).sort_values("name").reset_index(drop=True))
+    assert list(got["name"]) == list(ref["name"])
+    assert list(got["n"].astype(np.int64)) == list(ref["n"].astype(np.int64))
