@@ -66,7 +66,10 @@ class Column:
     @staticmethod
     def _encode_strings(arr: np.ndarray, mask: Optional[np.ndarray]) -> "Column":
         obj = np.asarray(arr, dtype=object)
-        isnull = np.array([v is None or (isinstance(v, float) and np.isnan(v)) for v in obj])
+        # dtype=bool: an empty comprehension otherwise yields float64, which
+        # breaks ~mask and boolean indexing (empty frames, TPC-DS q84)
+        isnull = np.array([v is None or (isinstance(v, float) and np.isnan(v))
+                           for v in obj], dtype=bool)
         mask = _merge_mask(mask, ~isnull)
         filled = obj.copy()
         filled[isnull] = ""

@@ -287,6 +287,12 @@ def _probe(lgid, rgid, use_jit: bool = False):
 def take_with_nulls(col: Column, indices: jnp.ndarray) -> Column:
     """Gather rows; index -1 produces NULL (outer-join fill)."""
     n = len(col)
+    if n == 0:
+        # empty source: every index is the -1 fill (outer join against an
+        # empty side, TPC-DS q77) — an all-NULL column of the output length
+        m = int(indices.shape[0])
+        return Column(jnp.zeros(m, dtype=col.data.dtype), col.sql_type,
+                      jnp.zeros(m, dtype=bool), col.dictionary)
     neg = indices < 0
     safe = jnp.clip(indices, 0, max(n - 1, 0))
     data = col.data[safe]

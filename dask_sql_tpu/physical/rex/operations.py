@@ -246,7 +246,7 @@ _rand_state = {"counter": 0}
 
 def _fresh_key(seed: Optional[Column]) -> "jax.Array":
     if seed is not None:
-        return jax.random.PRNGKey(int(np.asarray(seed.data)[0]))
+        return jax.random.PRNGKey(int(_const_value(seed)))
     _rand_state["counter"] += 1
     return jax.random.PRNGKey(
         int(np.random.SeedSequence().entropy % (2**31)) + _rand_state["counter"])
@@ -268,7 +268,7 @@ def _op_rand_integer(*args: Column, length: int = 1) -> Column:
         seed = None
         length = len(bound)
     key = _fresh_key(seed)
-    n = int(np.asarray(bound.data)[0])
+    n = int(_const_value(bound))
     return Column(jax.random.randint(key, (length,), 0, max(n, 1)).astype(jnp.int32),
                   SqlType.INTEGER)
 
@@ -285,7 +285,9 @@ def _op_coalesce(*cols: Column) -> Column:
         arrs = [c.to_numpy() for c in cols]
         out = arrs[0].copy()
         for arr in arrs[1:]:
-            mask = np.array([v is None for v in out])
+            # dtype=bool: an empty comprehension otherwise yields float64,
+            # which is rejected as an index (TPC-DS q84 on empty frames)
+            mask = np.array([v is None for v in out], dtype=bool)
             out[mask] = arr[mask]
         return Column.from_numpy(out)
     cols = [c.cast(target) for c in cols]
@@ -350,8 +352,8 @@ def _op_concat(*cols: Column) -> Column:
 def _op_substring(a: Column, start: Column, length: Optional[Column] = None) -> Column:
     a = _require_dict(a)
     if _is_const(start) and (length is None or _is_const(length)):
-        s = int(np.asarray(start.data)[0])
-        ln = int(np.asarray(length.data)[0]) if length is not None else None
+        s = int(_const_value(start))
+        ln = int(_const_value(length)) if length is not None else None
 
         def fn(x: str) -> str:
             begin = max(s - 1, 0) if s > 0 else max(len(x) + s, 0) if s < 0 else 0
@@ -381,6 +383,14 @@ def _op_substring(a: Column, start: Column, length: Optional[Column] = None) -> 
 
 def _is_const(c: Column) -> bool:
     return hasattr(c, "_lit_value") or len(c) == 1
+
+
+def _const_value(c: Column):
+    """Scalar value of a constant column — via its literal tag when the
+    column itself has zero rows (empty input tables, TPC-DS q8/q85)."""
+    if hasattr(c, "_lit_value"):
+        return c._lit_value
+    return _const_value(c)
 
 
 def _col_rows(c: Column, n: int) -> np.ndarray:
@@ -489,8 +499,8 @@ def _op_overlay(a: Column, repl: Column, start: Column, length: Optional[Column]
     consts = _is_const(repl) and _is_const(start) and (length is None or _is_const(length))
     if consts:
         r = str(repl.to_numpy()[0])
-        s = int(np.asarray(start.data)[0])
-        ln = int(np.asarray(length.data)[0]) if length is not None else None
+        s = int(_const_value(start))
+        ln = int(_const_value(length)) if length is not None else None
         return str_ops.map_unary(a, lambda x: _overlay_one(x, r, s, ln))
     cols = [a, _require_dict(repl), start] + ([length] if length is not None else [])
     return _rowwise_fallback(
@@ -506,7 +516,7 @@ def _op_split_part(a: Column, delim: Column, n: Column) -> Column:
     a = _require_dict(a)
     if _is_const(delim) and _is_const(n):
         d = str(delim.to_numpy()[0])
-        k = int(np.asarray(n.data)[0])
+        k = int(_const_value(n))
         return str_ops.map_unary(a, lambda x: _split_one(x, d, k))
     return _rowwise_fallback([a, _require_dict(delim), n],
                              lambda x, d, k: _split_one(x, d, int(k)))
@@ -538,7 +548,7 @@ def _str_num_op(a: Column, n: Column, fn) -> Column:
     """String op with one integer argument; const fast path else row-wise."""
     a = _require_dict(a)
     if _is_const(n):
-        k = int(np.asarray(n.data)[0])
+        k = int(_const_value(n))
         return str_ops.map_unary(a, lambda x: fn(x, k))
     return _rowwise_fallback([a, n], lambda x, k: fn(x, int(k)))
 
@@ -554,7 +564,7 @@ def _pad_one(x: str, k: int, c: str, left: bool) -> str:
 def _pad_op(a: Column, n: Column, p: Optional[Column], left: bool) -> Column:
     a = _require_dict(a)
     if _is_const(n) and (p is None or _is_const(p)):
-        k = int(np.asarray(n.data)[0])
+        k = int(_const_value(n))
         c = str(p.to_numpy()[0]) if p is not None else " "
         return str_ops.map_unary(a, lambda x: _pad_one(x, k, c, left))
     cols = [a, n] + ([_require_dict(p)] if p is not None else [])

@@ -99,6 +99,27 @@ class ScalarFunc(Expr):
 
 
 @dataclass(frozen=True)
+class GroupingExpr(Expr):
+    """GROUPING(e1, ...) — binder-internal marker, resolved during grouping
+    sets expansion to a per-branch literal bitmask (leftmost arg = most
+    significant bit, 1 = aggregated in this set).  Parity: the reference
+    surfaces DataFusion's grouping-id through aggregate.rs getGroupSets;
+    here the binder lowers it while expanding ROLLUP/CUBE/GROUPING SETS."""
+
+    args: Tuple[Expr, ...]
+    sql_type: SqlType
+
+    def children(self):
+        return list(self.args)
+
+    def with_children(self, children):
+        return replace(self, args=tuple(children))
+
+    def __str__(self):
+        return f"grouping({', '.join(map(str, self.args))})"
+
+
+@dataclass(frozen=True)
 class Cast(Expr):
     arg: Expr
     sql_type: SqlType

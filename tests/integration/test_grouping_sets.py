@@ -50,3 +50,54 @@ def test_rollup_with_order(c, gdf):
         "SELECT g1, SUM(v) AS s FROM gs GROUP BY ROLLUP (g1) ORDER BY s DESC"
     ).compute()
     assert list(result["s"]) == [10, 7, 3]
+
+
+def test_grouping_function_rollup(c):
+    """GROUPING() bitmask per grouping set (leftmost arg = MSB).
+    Parity: reference surfaces DataFusion grouping-id via aggregate.rs
+    getGroupSets; lowered here during binder expansion."""
+    import pandas as pd
+
+    df = pd.DataFrame({"a": ["x", "x", "y"], "b": ["p", "q", "p"],
+                       "v": [1.0, 2.0, 3.0]})
+    c.create_table("gfr", df)
+    r = c.sql(
+        "SELECT a, b, GROUPING(a) AS ga, GROUPING(b) AS gb, "
+        "GROUPING(a, b) AS gab, SUM(v) AS s "
+        "FROM gfr GROUP BY ROLLUP(a, b) ORDER BY a, b"
+    ).compute()
+    # detail rows: 0/0/0 ; per-a subtotals: 0/1/1 ; grand total: 1/1/3
+    import numpy as np
+
+    assert list(r["gab"]) == [0, 0, 1, 0, 1, 3]
+    assert list(r["ga"]) == [0, 0, 0, 0, 0, 1]
+    assert list(r["gb"]) == [0, 0, 1, 0, 1, 1]
+    total = r[r["gab"] == 3]["s"].iloc[0]
+    np.testing.assert_allclose(total, 6.0)
+
+
+def test_grouping_function_plain_group_by(c):
+    import pandas as pd
+
+    df = pd.DataFrame({"a": ["x", "y"], "v": [1.0, 2.0]})
+    c.create_table("gfp", df)
+    r = c.sql("SELECT a, GROUPING(a) AS g FROM gfp GROUP BY a").compute()
+    assert list(r["g"]) == [0, 0]
+
+
+def test_having_references_select_alias(c):
+    """HAVING may reference a select alias of an aggregate (TPC-DS q33/q56/
+    q60/q71 shape; the reference resolves via DataFusion SqlToRel)."""
+    import pandas as pd
+
+    df = pd.DataFrame({"g": ["a", "a", "b", "c"], "v": [1.0, 2.0, 7.0, 10.0]})
+    c.create_table("hav", df)
+    r = c.sql("SELECT g, SUM(v) AS total FROM hav GROUP BY g "
+              "HAVING total > 4 ORDER BY total DESC").compute()
+    assert list(r["g"]) == ["c", "b"]
+    # a real column named like the alias wins over the alias
+    df2 = pd.DataFrame({"g": ["a", "b"], "total": [1.0, 100.0]})
+    c.create_table("hav2", df2)
+    r2 = c.sql("SELECT g, SUM(total) AS total FROM hav2 "
+               "GROUP BY g, total HAVING total > 50").compute()
+    assert list(r2["g"]) == ["b"]
