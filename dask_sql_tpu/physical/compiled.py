@@ -121,6 +121,12 @@ class SegmentReducer:
         self._fdedup: Dict[Tuple[int, int], Tuple[int, Optional[int]]] = {}
         self._cnt_dedup: Dict[int, object] = {}
         self._out = None
+        # id()-keyed dedup is only sound while the keyed objects stay alive:
+        # transient registrands (e.g. the x and x*x arrays of a variance
+        # aggregate) would otherwise be collected right after registration,
+        # letting a later allocation reuse the id and falsely hit the cache
+        # (ADVICE r3).  Pin every keyed object for the reducer's lifetime.
+        self._keepalive: List = []
 
     # -- immediate scatter reductions ---------------------------------------
     def _scatter(self, x):
@@ -141,6 +147,7 @@ class SegmentReducer:
             else:
                 h = ("done", self._scatter(mask.astype(self._cnt_dtype)))
             self._cnt_dedup[id(mask)] = h
+            self._keepalive.append(mask)
         return h
 
     def sum_float(self, data, mask):
@@ -159,6 +166,7 @@ class SegmentReducer:
         else:
             h = self._push(jnp.where(mask, data, jnp.zeros_like(data)))
         self._fdedup[key] = h
+        self._keepalive.append((data, mask))
         return h
 
     def sum_int(self, data, mask):

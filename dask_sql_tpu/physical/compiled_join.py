@@ -363,12 +363,23 @@ class CompiledJoinAggregate:
                 if rmin:
                     info = jnp.iinfo(kd.dtype)
                     if info.min <= rmin <= info.max:
-                        idx = kd - jnp.asarray(rmin, dtype=kd.dtype)
+                        # in-dtype subtraction can wrap for probe keys far
+                        # outside the build range (e.g. kd < INT_MIN + rmin)
+                        # and land back inside [0, size) — bound the KEY
+                        # itself first; within [rmin, rmin+size-1] the
+                        # subtraction is exact (ADVICE r3)
+                        lo_k = jnp.asarray(rmin, dtype=kd.dtype)
+                        hi_k = jnp.asarray(min(rmin + size - 1, int(info.max)),
+                                           dtype=kd.dtype)
+                        inb = (kd >= lo_k) & (kd <= hi_k)
+                        idx = jnp.where(inb, kd - lo_k,
+                                        jnp.zeros_like(kd))
                     else:
                         idx = kd.astype(jnp.int64) - rmin
+                        inb = (idx >= 0) & (idx < size)
                 else:
                     idx = kd
-                inb = (idx >= 0) & (idx < size)
+                    inb = (idx >= 0) & (idx < size)
                 idx32 = jnp.clip(idx, 0, size - 1).astype(jnp.int32)
                 ri = jnp.where(inb, lut[idx32].astype(jnp.int32), jnp.int32(-1))
                 if kv is not None:

@@ -387,10 +387,19 @@ def _is_const(c: Column) -> bool:
 
 def _const_value(c: Column):
     """Scalar value of a constant column — via its literal tag when the
-    column itself has zero rows (empty input tables, TPC-DS q8/q85)."""
+    column itself has zero rows (empty input tables, TPC-DS q8/q85),
+    else from the single materialized row (1-row tables also satisfy
+    _is_const without carrying a _lit_value tag)."""
     if hasattr(c, "_lit_value"):
         return c._lit_value
-    return _const_value(c)
+    if len(c) != 1:
+        raise ValueError(
+            f"_const_value called on a non-constant {len(c)}-row column")
+    if c.validity is not None and not bool(np.asarray(c.validity)[0]):
+        return None  # SQL NULL, not the zero-filled backing datum
+    if c.sql_type in STRING_TYPES:
+        return c.to_numpy()[0]
+    return np.asarray(c.data)[0]
 
 
 def _col_rows(c: Column, n: int) -> np.ndarray:

@@ -1,0 +1,96 @@
+"""Regression tests for ADVICE round-3 findings.
+
+1. _const_value must read the concrete value of a 1-row non-literal column
+   (it recursed forever).
+2. SegmentReducer id()-keyed dedup must not alias transient registrands
+   (variance aggregates register x and x*x arrays that used to be
+   collectable right after registration).
+3. Compiled-join probe `key - rmin` must not wrap in the key's own dtype
+   and land back inside the LUT (spurious matches for far-out-of-range
+   probe keys when the build range extends past the probe dtype's max).
+"""
+import numpy as np
+import pandas as pd
+import pytest
+
+from dask_sql_tpu import Context
+from tests.utils import assert_eq
+
+
+def test_substring_column_args_one_row_table():
+    # SUBSTRING(s, 1, n) with a column length arg on a 1-row table: n is
+    # "constant" by row count but carries no _lit_value tag (ADVICE r3 high)
+    c = Context()
+    c.create_table("t", pd.DataFrame({"s": ["hello"], "n": [3]}))
+    got = c.sql("SELECT SUBSTRING(s, 1, n) AS r FROM t", return_futures=False)
+    assert list(got["r"]) == ["hel"]
+
+
+def test_substring_const_start_one_row_table():
+    c = Context()
+    c.create_table("t", pd.DataFrame({"s": ["abcdef"], "k": [2]}))
+    got = c.sql("SELECT SUBSTRING(s, k) AS r FROM t", return_futures=False)
+    assert list(got["r"]) == ["bcdef"]
+
+
+def test_repeated_variance_aggregates_distinct_results():
+    # Two variance-family aggregates over the same argument register
+    # transient x / x*x arrays; stale id() reuse would swap sum and
+    # sum-of-squares silently (ADVICE r3 medium)
+    rng = np.random.default_rng(7)
+    df = pd.DataFrame({
+        "g": np.repeat(np.arange(8), 50),
+        "v": rng.normal(10.0, 3.0, 400),
+    })
+    c = Context()
+    c.create_table("t", df)
+    got = c.sql(
+        "SELECT g, VAR_SAMP(v) AS vs, STDDEV_SAMP(v) AS sd, VAR_POP(v) AS vp "
+        "FROM t GROUP BY g ORDER BY g",
+        return_futures=False,
+    )
+    grp = df.groupby("g")["v"]
+    exp = pd.DataFrame({
+        "g": np.arange(8),
+        "vs": grp.var(ddof=1).values,
+        "sd": grp.std(ddof=1).values,
+        "vp": grp.var(ddof=0).values,
+    })
+    assert_eq(got, exp, check_dtype=False, rtol=1e-6)
+
+
+def test_join_probe_key_underflow_no_spurious_match():
+    # Build keys straddle INT32_MAX (int64, dense); probe keys are int32
+    # including INT32_MIN.  In-dtype `kd - rmin` wraps INT32_MIN back into
+    # the LUT's [0, size) window (ADVICE r3 medium): the old code joined
+    # INT32_MIN against a key near 2**31.
+    lo = (1 << 31) - 5
+    build_keys = np.arange(lo, lo + 106, dtype=np.int64)
+    build = pd.DataFrame({"k": build_keys, "tag": np.arange(106)})
+    probe = pd.DataFrame({
+        "k": np.array([-(1 << 31), lo + 3, 12, -(1 << 31) + 2], dtype=np.int32),
+        "x": [1.0, 2.0, 3.0, 4.0],
+    })
+    c = Context()
+    c.create_table("build", build)
+    c.create_table("probe", probe)
+    got = c.sql(
+        "SELECT probe.x AS x, build.tag AS tag FROM probe, build "
+        "WHERE probe.k = build.k",
+        return_futures=False,
+    )
+    exp = probe.assign(k64=probe["k"].astype(np.int64)).merge(
+        build, left_on="k64", right_on="k")[["x", "tag"]]
+    assert_eq(
+        got.sort_values("x").reset_index(drop=True),
+        exp.sort_values("x").reset_index(drop=True),
+        check_dtype=False,
+    )
+    # aggregate over the same join exercises the compiled-join probe kernel
+    got2 = c.sql(
+        "SELECT SUM(probe.x) AS s, COUNT(*) AS n FROM probe, build "
+        "WHERE probe.k = build.k",
+        return_futures=False,
+    )
+    assert float(got2["s"][0]) == 2.0
+    assert int(got2["n"][0]) == 1
