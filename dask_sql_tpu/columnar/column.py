@@ -154,7 +154,9 @@ class Column:
         remap = np.zeros(max(len(self.dictionary), 1), dtype=np.int32)
         remap[used[order]] = np.arange(len(used), dtype=np.int32)
         new_codes = remap[np.clip(codes, 0, len(remap) - 1)]
-        return Column(jnp.asarray(new_codes), self.sql_type, self.validity, new_dict)
+        # host-resident columns (tiny post-aggregate tables) stay host-resident
+        data = new_codes if isinstance(self.data, np.ndarray) else jnp.asarray(new_codes)
+        return Column(data, self.sql_type, self.validity, new_dict)
 
     def cast(self, target: SqlType) -> "Column":
         from . import casts

@@ -16,6 +16,7 @@ jax/XLA kernels through the physical plugin registries.
 from __future__ import annotations
 
 import logging
+from collections import OrderedDict
 from typing import Any, Callable, Dict, List, Optional, Tuple, Union
 
 import numpy as np
@@ -115,6 +116,12 @@ class Context:
     DEFAULT_SCHEMA_NAME = "root"
 
     def __init__(self, logging_level=logging.INFO):
+        # join the multi-host runtime if DSQL_COORDINATOR is set (parity:
+        # the reference front-ends connecting a Client to the scheduler
+        # address, reference server/app.py:249-252); no-op single-host
+        from .parallel.bootstrap import initialize_from_env
+
+        initialize_from_env()
         self.schema_name = self.DEFAULT_SCHEMA_NAME
         self.schema: Dict[str, SchemaContainer] = {
             self.DEFAULT_SCHEMA_NAME: SchemaContainer(self.DEFAULT_SCHEMA_NAME)
@@ -122,7 +129,44 @@ class Context:
         self._views: Dict[str, Dict[str, Any]] = {self.DEFAULT_SCHEMA_NAME: {}}
         self.config = config_module.config
         self.server = None
+        #: bound+optimized plans for repeated SQL text (keyed on the catalog
+        #: signature, so any table/view/function/config change re-plans)
+        self._plan_cache: "OrderedDict[Tuple, List[Any]]" = OrderedDict()
+        #: bumped on every view/function (re)definition or drop
+        self._catalog_serial = 0
         logging.basicConfig(level=logging_level)
+
+    _PLAN_CACHE_CAP = 128
+
+    def _plan_cache_key(self, sql: str, config_options) -> Optional[Tuple]:
+        """Cache key for a SQL text against the current catalog state, or
+        None when the statement must be re-planned every time (plan-time
+        data reads: DPP runs the dim side during optimization, so its
+        inputs are pinned by the table uids in the signature)."""
+        try:
+            parts: List[Any] = [sql, self.schema_name]
+            for schema_name in sorted(self.schema):
+                container = self.schema[schema_name]
+                parts.append(schema_name)
+                parts.append(tuple(sorted(
+                    (name, dc.uid) for name, dc in container.tables.items())))
+                stats = container.statistics
+                parts.append(tuple(sorted(
+                    (name, s.row_count) for name, s in stats.items()
+                    if s is not None)))
+                parts.append(tuple(sorted(self._views.get(schema_name, {}))))
+                parts.append(tuple(sorted(container.function_lists)))
+            # id()-free: view/function redefinitions bump _catalog_serial
+            # (id reuse after a drop would silently replay a stale plan)
+            parts.append(self._catalog_serial)
+            parts.append(tuple(sorted(self.config._values.items())))
+            if config_options:
+                parts.append(tuple(sorted(config_options.items())))
+            key = tuple(parts)
+            hash(key)  # unhashable config values -> skip caching
+            return key
+        except TypeError:
+            return None
 
     # ------------------------------------------------------------ tables
     def create_table(
@@ -173,13 +217,15 @@ class Context:
         filepath = getattr(dc, "filepath", None)
         if filepath:
             self.schema[schema_name].filepaths[table_name] = filepath
-        self._views.setdefault(schema_name, {}).pop(table_name, None)
+        if self._views.setdefault(schema_name, {}).pop(table_name, None) is not None:
+            self._catalog_serial += 1
 
     def drop_table(self, table_name: str, schema_name: Optional[str] = None) -> None:
         schema_name = schema_name or self.schema_name
         self.schema[schema_name].tables.pop(table_name, None)
         self.schema[schema_name].statistics.pop(table_name, None)
-        self._views.get(schema_name, {}).pop(table_name, None)
+        if self._views.get(schema_name, {}).pop(table_name, None) is not None:
+            self._catalog_serial += 1
 
     def alter_table(self, old_name: str, new_name: str,
                     schema_name: Optional[str] = None) -> None:
@@ -200,7 +246,8 @@ class Context:
         if schema_name == self.schema_name:
             self.schema_name = self.DEFAULT_SCHEMA_NAME
         self.schema.pop(schema_name, None)
-        self._views.pop(schema_name, None)
+        if self._views.pop(schema_name, None):
+            self._catalog_serial += 1
 
     def alter_schema(self, old_name: str, new_name: str) -> None:
         if old_name in self.schema:
@@ -261,6 +308,7 @@ class Context:
         else:
             schema.function_lists[lower] = [fd]
         schema.functions[lower] = fd
+        self._catalog_serial += 1
 
     # ------------------------------------------------------------ models
     def register_model(self, model_name: str, model: Any,
@@ -269,6 +317,7 @@ class Context:
         """Parity: context.py:626."""
         schema_name = schema_name or self.schema_name
         self.schema[schema_name].models[model_name] = (model, list(training_columns))
+        self._catalog_serial += 1
 
     # ------------------------------------------------------------ queries
     def sql(
@@ -286,18 +335,35 @@ class Context:
         with self.config.set(config_options or {}):
             if not isinstance(sql, str):
                 raise ValueError("sql must be a string (plans are internal here)")
-            statements = parse_sql(sql)
+            key = self._plan_cache_key(sql, config_options)
+            plans = self._plan_cache.get(key) if key is not None else None
             result = None
-            for stmt in statements:
-                result = self._run_statement(stmt, config_options)
+            if plans is not None:
+                self._plan_cache.move_to_end(key)
+                for plan in plans:
+                    result = self._run_plan(plan, config_options)
+            else:
+                statements = parse_sql(sql)
+                plans = []
+                # plan each statement right before running it: a later
+                # statement may read what an earlier one created
+                for stmt in statements:
+                    plan = self._get_ral(stmt)
+                    plans.append(plan)
+                    result = self._run_plan(plan, config_options)
+                # only single-statement texts are cacheable — a script's later
+                # plans were bound against mid-script catalog state
+                if key is not None and len(plans) == 1:
+                    self._plan_cache[key] = plans
+                    while len(self._plan_cache) > self._PLAN_CACHE_CAP:
+                        self._plan_cache.popitem(last=False)
             if result is None:
                 return None
             if return_futures:
                 return result
             return result.compute()
 
-    def _run_statement(self, stmt, config_options=None) -> Optional[TpuFrame]:
-        plan = self._get_ral(stmt)
+    def _run_plan(self, plan, config_options=None) -> Optional[TpuFrame]:
         if isinstance(plan, plan_nodes.CustomNode) and not isinstance(
                 plan, (plan_nodes.PredictModelNode,)):
             # DDL / side-effecting statements run eagerly (parity: reference
@@ -392,6 +458,7 @@ class Context:
 
     def _register_view(self, name: str, plan, schema_name: str) -> None:
         self._views.setdefault(schema_name, {})[name] = plan
+        self._catalog_serial += 1
 
     def _table_schema_name(self, parts: List[str]) -> Tuple[str, str]:
         if len(parts) >= 2:

@@ -20,7 +20,33 @@ from ..planner.expressions import SortKey
 
 def sort_permutation(cols: Sequence[Column], ascendings: Sequence[bool],
                      nulls_firsts: Sequence[bool]) -> jnp.ndarray:
-    """Stable permutation ordering rows by the given keys."""
+    """Stable permutation ordering rows by the given keys.
+
+    Host-resident inputs (tiny post-aggregate tables, see
+    CompiledAggregate.run) sort via np.lexsort — no device round trip for
+    a handful of group rows."""
+    import numpy as np
+
+    if all(isinstance(c.data, np.ndarray) for c in cols):
+        nkeys: List[np.ndarray] = []
+        for col, asc, nf in zip(cols, ascendings, nulls_firsts):
+            if col.sql_type in STRING_TYPES:
+                col = col.compact_dictionary()
+            data = np.asarray(col.data)
+            if data.dtype == np.bool_:
+                data = data.astype(np.int32)
+            if data.dtype.kind == "f":
+                data = np.where(np.isnan(data), np.inf, data)
+            if not asc:
+                data = -data
+            if col.validity is not None:
+                valid = np.asarray(col.validity)
+                nkeys.append(np.where(valid, 1, 0) if nf
+                             else np.where(valid, 0, 1))
+                nkeys.append(data)
+            else:
+                nkeys.append(data)
+        return np.lexsort(tuple(reversed(nkeys)))
     keys: List[jnp.ndarray] = []
     for col, asc, nf in zip(cols, ascendings, nulls_firsts):
         if col.sql_type in STRING_TYPES:

@@ -38,6 +38,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..columnar.column import Column
 from ..columnar.dtypes import SqlType, STRING_TYPES, sql_to_np
+from .bootstrap import host_read
 from .mesh import AXIS, default_mesh, pad_to_multiple, row_sharding
 
 logger = logging.getLogger(__name__)
@@ -652,17 +653,18 @@ def try_dist_aggregate(rel, executor, inp) -> Optional[object]:
         fk, fv_, iout, fout, overflow = fn(keys_mat, ivals_mat, fvals_mat,
                                            vvalid_mat, rowvalid)
         STATS["agg_kernel"] += 1
-        if not bool(np.asarray(overflow).any()):
+        if not bool(host_read(overflow).any()):
             break
         cap = _ladder_next(GROUP_CAPACITY_LADDER, cap)
     else:
         raise RuntimeError("distributed aggregate exceeded capacity ladder")
 
-    # host finalize: concat per-device owned tables (keys are disjoint)
-    fk_h = np.asarray(fk)            # [ndev, nk, cap]
-    fv_h = np.asarray(fv_).reshape(-1)            # [ndev*cap]
-    iout_h = np.asarray(iout)        # [ndev, nv, cap, 3]
-    fout_h = np.asarray(fout)
+    # host finalize: concat per-device owned tables (keys are disjoint);
+    # host_read all-gathers first when the mesh spans processes
+    fk_h = host_read(fk)             # [ndev, nk, cap]
+    fv_h = host_read(fv_).reshape(-1)             # [ndev*cap]
+    iout_h = host_read(iout)         # [ndev, nv, cap, 3]
+    fout_h = host_read(fout)
     keys_flat = [fk_h[:, i, :].reshape(-1) for i in range(nk)]
     sel = fv_h
     key_cols = decode_key_outputs([k[sel] for k in keys_flat], key_infos)

@@ -33,13 +33,14 @@ def shard_table(table: Table, mesh=None) -> Table:
     n = table.num_rows
     target = ((n + ndev - 1) // ndev) * ndev
 
+    from .bootstrap import make_global_array
     from .mesh import pad_to_multiple
 
     def place(arr):
         if target == n:
-            return jax.device_put(arr, sharding)
+            return make_global_array(arr, sharding)
         padded, _ = pad_to_multiple(arr, ndev)
-        return jax.device_put(padded, sharding)[:n]
+        return make_global_array(padded, sharding)[:n]
 
     cols = {}
     for name, col in table.columns.items():

@@ -289,7 +289,10 @@ class CompiledJoinAggregate:
             domain_est = 1
         from ..ops.pallas_kernels import choose_segsum_impl
 
+        self.domain = domain_est
         self.segsum_mode = choose_segsum_impl(executor.config, domain_est)
+        #: (kind, np.dtype) per packed output row; filled when _fn traces
+        self._pack_tags: List[Tuple[str, np.dtype]] = []
         self._fn = jax.jit(self._build())
 
     @staticmethod
@@ -428,7 +431,7 @@ class CompiledJoinAggregate:
             else:
                 gid = ri_safe[gid_join].astype(jnp.int32)
                 domain = build_domains[gid_join]
-            from .compiled import SegmentReducer
+            from .compiled import SegmentReducer, pack_flat
 
             reducer = SegmentReducer(gid, domain, segsum_mode, n_rows)
             hit_h = reducer.count(mask)
@@ -439,7 +442,7 @@ class CompiledJoinAggregate:
             for d, v in outs:
                 flat.append(d)
                 flat.append(v if v is not None else jnp.ones_like(hit))
-            return tuple(flat)
+            return pack_flat(flat, self._pack_tags)
 
         # domains are python ints (build table row counts) — bind them now
         build_domains = [bt.num_rows for bt in self.build_tables]
@@ -455,14 +458,20 @@ class CompiledJoinAggregate:
             bt = self.build_tables[k]
             c = bt.columns[bt.column_names[col]]
             build_cols[(k, col)] = (c.data, c.validity)
-        flat = self._fn(probe_datas, probe_valids, luts, build_cols)
-        hit = flat[0]
-        present = jnp.nonzero(hit)[0]
+        packed = self._fn(probe_datas, probe_valids, luts, build_cols)
+        from .compiled import fetch_packed, unpack_row
+
+        tags = self._pack_tags
+        host, present = fetch_packed(packed, self.domain)
         is_global = self.radix_spec is None and (self.gid_join is None
                                                  or self.gid_join < 0)
-        if is_global and int(present.shape[0]) == 0:
+        if is_global and present.shape[0] == 0:
             # SQL: global aggregate over zero rows still yields one row
-            present = jnp.zeros(1, dtype=present.dtype)
+            present = np.zeros(1, dtype=np.int64)
+            host = np.zeros((host.shape[0], 1), dtype=np.float64)
+            for i, a in enumerate(self.rel.agg_exprs):
+                if a.func in ("count", "count_star"):
+                    host[2 + 2 * i] = 1.0  # COUNT stays valid (= 0), not NULL
 
         from .rel.base import unique_names
 
@@ -476,21 +485,24 @@ class CompiledJoinAggregate:
                 strides.append(s)
                 s *= spec["r"]
             strides = list(reversed(strides))
+            # host numpy decode: the group table is tiny, downstream operators
+            # consume it without another device round trip
             for name, spec, stride in zip(names, self.radix_spec, strides):
                 r = spec["r"]
                 code = (present // stride) % r
                 is_null = code == (r - 1)
                 validity = ~is_null if bool(is_null.any()) else None
-                code = jnp.minimum(code, r - 2)
+                code = np.minimum(code, r - 2)
                 col = spec["col"]
                 if spec["kind"] == "str":
-                    out[name] = Column(code.astype(jnp.int32), col.sql_type,
+                    out[name] = Column(code.astype(np.int32), col.sql_type,
                                        validity, col.dictionary)
                 elif spec["kind"] == "bool":
                     out[name] = Column(code == 1, col.sql_type, validity)
                 else:
-                    out[name] = Column((code + spec["off"]).astype(col.data.dtype),
-                                       col.sql_type, validity)
+                    out[name] = Column(
+                        (code + spec["off"]).astype(np.dtype(col.data.dtype)),
+                        col.sql_type, validity)
             n_groups = len(self.radix_spec)
         elif self.gid_join is not None and self.gid_join >= 0:
             bt = self.build_tables[self.gid_join]
@@ -501,8 +513,8 @@ class CompiledJoinAggregate:
         else:
             n_groups = 0
         for i, a in enumerate(self.rel.agg_exprs):
-            d = flat[1 + 2 * i][present]
-            v = flat[2 + 2 * i][present]
+            d = unpack_row(host, 1 + 2 * i, tags)
+            v = unpack_row(host, 2 + 2 * i, tags) != 0.0
             target = sql_to_np(a.sql_type)
             d = d.astype(target) if d.dtype != target else d
             validity = None if bool(v.all()) else v

@@ -1,0 +1,212 @@
+"""sqlite-based differential oracle for the TPC-DS q1-q99 corpus.
+
+Parity: the reference value-checks its feature corpus against live engines
+(reference tests/integration/test_postgres.py:13-53 and
+tests/integration/test_compatibility.py eq_sqlite) — this module does the
+same for the flagship TPC-DS suite using the stdlib sqlite3 (>= 3.39:
+window functions, FULL JOIN, INTERSECT/EXCEPT are native).
+
+Dialect gap handling:
+- dates are loaded as ISO text ('YYYY-MM-DD' when day-resolution), and
+  ``cast('X' as date)`` folds to the text literal, so comparisons match;
+- ``a + interval 'N' day`` becomes ``date(a, '+N days')``;
+- STDDEV_SAMP is registered as a python aggregate;
+- ``GROUP BY ROLLUP(c1..ck)`` expands to a UNION ALL of the k+1 grouping
+  levels (grouped-out columns become NULL, ``GROUPING(c)`` becomes the
+  level's 0/1 constant).  Window functions in those queries partition by
+  the grouping level, so evaluating them per-branch is equivalent.
+"""
+from __future__ import annotations
+
+import math
+import re
+import sqlite3
+from typing import Dict, Optional
+
+import numpy as np
+import pandas as pd
+
+
+# ----------------------------------------------------------- sqlite loading
+class _Stddev:
+    """Sample standard deviation aggregate (sqlite has none built in)."""
+
+    def __init__(self):
+        self.vals = []
+
+    def step(self, v):
+        if v is not None:
+            self.vals.append(float(v))
+
+    def finalize(self):
+        n = len(self.vals)
+        if n < 2:
+            return None
+        mean = sum(self.vals) / n
+        var = sum((x - mean) ** 2 for x in self.vals) / (n - 1)
+        return math.sqrt(var)
+
+
+def make_sqlite(tables: Dict[str, pd.DataFrame]) -> sqlite3.Connection:
+    conn = sqlite3.connect(":memory:")
+    conn.create_aggregate("stddev_samp", 1, _Stddev)
+    conn.create_aggregate("stddev", 1, _Stddev)
+    for name, df in tables.items():
+        out = df.copy()
+        for col in out.columns:
+            s = out[col]
+            if s.dtype.kind == "M":
+                day_res = s.dropna().eq(s.dropna().dt.normalize()).all()
+                fmt = "%Y-%m-%d" if day_res else "%Y-%m-%d %H:%M:%S"
+                out[col] = s.dt.strftime(fmt)
+        out.to_sql(name, conn, index=False)
+    return conn
+
+
+# ----------------------------------------------------------- translation
+def _expand_rollup(sql: str) -> Optional[str]:
+    m = re.search(r"group\s+by\s+rollup\s*\(([^)]*)\)", sql, re.I)
+    if m is None:
+        return sql
+    cols = [c.strip() for c in m.group(1).split(",")]
+    if not all(re.fullmatch(r"[A-Za-z_][A-Za-z0-9_.]*", c) for c in cols):
+        return None
+    msel = re.search(r"select\s", sql, re.I)
+    mfrom = re.search(r"\sfrom\s", sql, re.I)
+    if msel is None or mfrom is None or msel.end() > mfrom.start():
+        return None
+    select_list = sql[msel.end():mfrom.start()]
+    body = sql[mfrom.start():m.start()]
+    tail = sql[m.end():]
+    if re.search(r"group\s+by|rollup", tail, re.I):
+        return None  # only the single-rollup shape is supported
+
+    items = _split_top_level(select_list)
+    branches = []
+    for level in range(len(cols), -1, -1):
+        kept, dropped = cols[:level], cols[level:]
+        branch_items = []
+        for item in items:
+            expr, alias = _split_alias(item)
+            for c in kept:
+                expr = re.sub(r"grouping\s*\(\s*%s\s*\)" % re.escape(c),
+                              "0", expr, flags=re.I)
+            for c in dropped:
+                expr = re.sub(r"grouping\s*\(\s*%s\s*\)" % re.escape(c),
+                              "1", expr, flags=re.I)
+                expr = re.sub(r"\b%s\b" % re.escape(c), "null", expr)
+            if alias is None and expr.strip() == "null":
+                alias = item.strip()  # bare rolled-out column keeps its name
+            branch_items.append(expr + (f" as {alias}" if alias else ""))
+        branch = "select " + ", ".join(branch_items) + body
+        if kept:
+            branch += " group by " + ", ".join(kept)
+        branches.append("select * from (" + branch + ")")
+    return ("select * from (" + " union all ".join(branches) + ") " + tail)
+
+
+def _split_top_level(s: str):
+    items, depth, cur = [], 0, []
+    for ch in s:
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+        if ch == "," and depth == 0:
+            items.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+    items.append("".join(cur))
+    return [i.strip() for i in items if i.strip()]
+
+
+def _split_alias(item: str):
+    m = re.search(r"\s+as\s+([A-Za-z_][A-Za-z0-9_]*)\s*$", item, re.I)
+    if m:
+        return item[: m.start()], m.group(1)
+    return item, None
+
+
+def translate(sql: str) -> Optional[str]:
+    """TPC-DS dialect -> sqlite, or None when no faithful translation exists."""
+    out = sql
+    # cast('X' as date) -> 'X'  (dates live as ISO text in the oracle db)
+    out = re.sub(r"cast\s*\(\s*('[^']*')\s+as\s+date\s*\)", r"\1", out,
+                 flags=re.I)
+    # a + interval 'N' day -> date(a, '+N days')
+    out = re.sub(
+        r"([A-Za-z_][A-Za-z0-9_.]*)\s*\+\s*interval\s*'(\d+)'\s*day",
+        r"date(\1, '+\2 days')", out, flags=re.I)
+    if re.search(r"\binterval\b", out, re.I):
+        return None
+    if re.search(r"grouping\s+sets|\bcube\s*\(", out, re.I):
+        return None
+    out = _expand_rollup(out)
+    return out
+
+
+# ----------------------------------------------------------- comparison
+def _normalize(df: pd.DataFrame) -> pd.DataFrame:
+    out = pd.DataFrame()
+    for i, col in enumerate(df.columns):
+        s = df[col]
+        if s.dtype.kind == "M":
+            s = s.dt.strftime("%Y-%m-%d")
+        elif s.dtype == object:
+            s = s.map(lambda v: None if v is None or (isinstance(v, float)
+                                                      and np.isnan(v)) else str(v))
+        out[i] = s
+    return out
+
+
+def assert_same_result(got: pd.DataFrame, exp: pd.DataFrame, qnum,
+                       rtol: float = 1e-4):
+    """Order-insensitive equality of two result frames.
+
+    Both frames are normalized (datetimes to ISO text, objects to str) and
+    sorted by every column; numerics compare with `rtol` (the matmul segsum
+    path documents a ~5e-6 relative float bound)."""
+    assert len(got.columns) == len(exp.columns), (
+        f"q{qnum}: column count {len(got.columns)} != oracle {len(exp.columns)}")
+    assert len(got) == len(exp), (
+        f"q{qnum}: row count {len(got)} != oracle {len(exp)}")
+    if len(got) == 0:
+        return
+    g = _normalize(got)
+    e = _normalize(exp)
+
+    def sortkey(df):
+        key = df.copy()
+        for c in key.columns:
+            v = key[c]
+            if v.dtype.kind == "f":
+                key[c] = v.round(6)
+            key[c] = key[c].map(lambda x: "\x00" if x is None or
+                                (isinstance(x, float) and np.isnan(x)) else str(x))
+        return df.loc[key.sort_values(list(key.columns)).index].reset_index(drop=True)
+
+    g = sortkey(g)
+    e = sortkey(e)
+    for c in g.columns:
+        gv, ev = g[c], e[c]
+        g_num = pd.to_numeric(gv, errors="coerce")
+        e_num = pd.to_numeric(ev, errors="coerce")
+        if g_num.notna().equals(e_num.notna()) and g_num.notna().any():
+            both = g_num.notna()
+            np.testing.assert_allclose(
+                g_num[both].astype(float), e_num[both].astype(float),
+                rtol=rtol, atol=1e-6, err_msg=f"q{qnum} col#{c}")
+            assert gv[~both].map(_isnull).equals(ev[~both].map(_isnull)), (
+                f"q{qnum} col#{c}: NULL placement differs")
+        else:
+            assert list(gv.map(_nullstr)) == list(ev.map(_nullstr)), (
+                f"q{qnum} col#{c}: values differ")
+
+
+def _isnull(v) -> bool:
+    return v is None or (isinstance(v, float) and np.isnan(v))
+
+
+def _nullstr(v):
+    return None if _isnull(v) else str(v)

@@ -1,0 +1,69 @@
+"""Plan-cache correctness: repeated SQL reuses the bound plan, and every
+catalog/config change invalidates it."""
+import numpy as np
+import pandas as pd
+
+from dask_sql_tpu import Context
+
+
+def _ctx():
+    c = Context()
+    c.create_table("t", pd.DataFrame({"a": [1, 2, 3], "b": [1.5, 2.5, 3.5]}))
+    return c
+
+
+def test_repeated_sql_hits_cache():
+    c = _ctx()
+    q = "SELECT SUM(a) AS s FROM t"
+    assert int(c.sql(q, return_futures=False)["s"][0]) == 6
+    assert len(c._plan_cache) == 1
+    key = next(iter(c._plan_cache))
+    c.sql(q, return_futures=False)
+    assert list(c._plan_cache) == [key]  # same entry, no re-plan
+
+
+def test_table_replacement_invalidates():
+    c = _ctx()
+    q = "SELECT SUM(a) AS s FROM t"
+    assert int(c.sql(q, return_futures=False)["s"][0]) == 6
+    c.create_table("t", pd.DataFrame({"a": [10, 20]}))
+    assert int(c.sql(q, return_futures=False)["s"][0]) == 30
+
+
+def test_config_options_partition_cache():
+    c = _ctx()
+    q = "SELECT SUM(a) AS s FROM t"
+    a = c.sql(q, return_futures=False)
+    b = c.sql(q, config_options={"sql.compile": False}, return_futures=False)
+    np.testing.assert_allclose(a["s"], b["s"])
+    assert len(c._plan_cache) == 2  # distinct entries per config
+
+
+def test_unhashable_config_skips_cache():
+    c = _ctx()
+    r = c.sql("SELECT SUM(a) AS s FROM t",
+              config_options={"sql.weird": ["not", "hashable"]},
+              return_futures=False)
+    assert int(r["s"][0]) == 6
+    assert len(c._plan_cache) == 0
+
+
+def test_view_redefinition_not_stale():
+    c = _ctx()
+    c.sql("CREATE VIEW v AS SELECT a FROM t")
+    r1 = c.sql("SELECT * FROM v", return_futures=False)
+    c.sql("DROP VIEW v")
+    c.sql("CREATE VIEW v AS SELECT b FROM t")
+    r2 = c.sql("SELECT * FROM v", return_futures=False)
+    assert list(r1.columns) == ["a"]
+    assert list(r2.columns) == ["b"]
+
+
+def test_multi_statement_not_cached_but_correct():
+    c = _ctx()
+    script = ("CREATE OR REPLACE TABLE ms AS (SELECT a FROM t); "
+              "SELECT SUM(a) AS s FROM ms")
+    assert int(c.sql(script, return_futures=False)["s"][0]) == 6
+    # second run replans statement-by-statement (scripts are never cached)
+    assert int(c.sql(script, return_futures=False)["s"][0]) == 6
+    assert len(c._plan_cache) == 0
