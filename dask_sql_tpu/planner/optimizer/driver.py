@@ -57,4 +57,71 @@ def optimize_plan(plan, config, catalog, context=None):
         plan = dpp.apply(plan, config, catalog, context)
     # reorder/DPP introduce projections and filters of their own — prune again
     plan = rules.PushDownProjection().apply(plan, config, catalog)
+    plan = _optimize_embedded_subqueries(plan, config, catalog, context)
     return plan
+
+
+def _optimize_embedded_subqueries(plan, config, catalog, context):
+    """Run the full pipeline on plans embedded INSIDE expressions.
+
+    Uncorrelated subqueries that decorrelation leaves as runtime expressions
+    (scalar subquery broadcast, IN/EXISTS probes) carry whole plan trees the
+    node-walking rules never see — q23's max_store_sales CTE executed as a
+    three-way CROSS join (182M rows at 1000-row scale) because its equijoin
+    predicates were never pushed.  Correlated remnants (carrying _OuterRef,
+    the reference-xfail shapes) are left untouched: pushdown's column
+    remapping must not rewrite outer indices."""
+    from dataclasses import replace as _dc_replace
+
+    from ..binder import _OuterRef
+    from ..expressions import (
+        ExistsExpr,
+        InSubqueryExpr,
+        ScalarSubqueryExpr,
+        transform,
+        walk,
+    )
+    from . import rules as R
+
+    def subplan_correlated(sub) -> bool:
+        found = [False]
+
+        def check(e):
+            for x in walk(e):
+                if isinstance(x, _OuterRef):
+                    found[0] = True
+                # walk() stops at expression boundaries — a correlated
+                # remnant one subquery level deeper must also fence off
+                # this whole subtree (its outer refs point into OUR schema)
+                if (isinstance(x, (ScalarSubqueryExpr, InSubqueryExpr,
+                                   ExistsExpr))
+                        and getattr(x, "plan", None) is not None
+                        and subplan_correlated(x.plan)):
+                    found[0] = True
+            return e
+
+        def go(node):
+            R._map_node_exprs(node, check)
+            for k in node.inputs():
+                go(k)
+
+        go(sub)
+        return found[0]
+
+    def fix_expr(e):
+        def fn(x):
+            if (isinstance(x, (ScalarSubqueryExpr, InSubqueryExpr, ExistsExpr))
+                    and getattr(x, "plan", None) is not None
+                    and not subplan_correlated(x.plan)):
+                new = optimize_plan(x.plan, config, catalog, context)
+                if new is not x.plan:
+                    return _dc_replace(x, plan=new)
+            return x
+
+        return transform(e, fn)
+
+    def go(node):
+        node = R._rewrite_children(node, go)
+        return R._map_node_exprs(node, fix_expr)
+
+    return go(plan)
