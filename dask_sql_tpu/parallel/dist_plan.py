@@ -52,7 +52,8 @@ GROUP_CAPACITY_LADDER = (1024, 16384, 262144, 1 << 22)
 PEER_CAPACITY_LADDER = (2048, 16384, 131072, 1 << 20, 1 << 23)
 
 #: test/observability hooks: counts of kernel executions this process
-STATS = {"agg_kernel": 0, "join_kernel": 0, "agg_fallback": 0}
+STATS = {"agg_kernel": 0, "join_kernel": 0, "agg_fallback": 0,
+         "broadcast_join": 0, "sharded_join_agg": 0}
 
 
 # ---------------------------------------------------------------------------
@@ -476,6 +477,37 @@ def _place_rows(arr: jnp.ndarray, mesh: Mesh, fill=0):
     padded, valid = pad_to_multiple(arr, ndev, fill=fill)
     sh = row_sharding(mesh)
     return jax.device_put(padded, sh), jax.device_put(valid, sh)
+
+
+def broadcast_inner_pairs(big_gid, big_valid, small_gid, small_valid):
+    """Broadcast-join matching: the small side stays replicated, the big
+    side is NEVER shuffled (parity: reference join.py:228-246 small-side
+    broadcast merge under `sql.join.broadcast`).
+
+    Builds a dense LUT over the (unique-key) small side and probes it with
+    the sharded big-side gids — a pure per-shard gather, no collectives.
+    The pair compaction happens on host after ONE read (multi-host safe:
+    the probe output is what the caller materializes anyway).  Returns
+    (big_idx, small_idx, big_matched) or None when the small side's keys
+    are not unique-dense ints (the all_to_all engine handles those)."""
+    from ..ops.join import dense_unique_lut
+
+    sv = None if bool(small_valid.all()) else small_valid
+    prep = dense_unique_lut(small_gid, sv)
+    if prep is None:
+        return None
+    rmin, lut = prep
+    size = lut.shape[0]
+    idx = big_gid.astype(I64) - rmin
+    inb = (idx >= 0) & (idx < size) & big_valid
+    safe = jnp.clip(idx, 0, size - 1).astype(jnp.int32)
+    cand = jnp.where(inb, lut[safe].astype(jnp.int64), jnp.int64(-1))
+    STATS["broadcast_join"] += 1
+    cand_h = host_read(cand)
+    matched = cand_h >= 0
+    bi = np.nonzero(matched)[0].astype(np.int64)
+    si = cand_h[bi]
+    return jnp.asarray(bi), jnp.asarray(si), matched
 
 
 def dist_inner_pairs(mesh: Mesh, lgid: jnp.ndarray, lvalid: jnp.ndarray,

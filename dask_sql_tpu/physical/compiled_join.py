@@ -452,6 +452,12 @@ class CompiledJoinAggregate:
         pt = self.probe_table
         probe_datas = tuple(pt.columns[n].data for n in pt.column_names)
         probe_valids = tuple(pt.columns[n].validity for n in pt.column_names)
+        from ..parallel import dist_plan as _dp
+
+        if any(_dp.array_is_sharded(d) for d in probe_datas):
+            # SPMD over the sharded probe: GSPMD inserts the all-reduce for
+            # the segment outputs; joined rows never materialize anywhere
+            _dp.STATS["sharded_join_agg"] += 1
         luts = tuple(lut for _, lut in self.luts)
         build_cols = {}
         for (k, col), _slot in self.used_build_slots.items():

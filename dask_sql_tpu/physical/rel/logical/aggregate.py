@@ -38,11 +38,21 @@ class AggregatePlugin(BaseRelPlugin):
         from ...compiled import try_compiled_aggregate
         from ...streaming import try_streaming_aggregate
 
-        # collectives-routed path for mesh-sharded inputs (round-2 engine:
-        # the distributed shuffle IS the execution layer, not GSPMD fallout);
-        # when it declines (knob off / non-decomposable agg) fall through to
-        # the streaming/compiled fast paths like any other input
+        from ...compiled_join import try_compiled_join_aggregate
+
+        # mesh-sharded inputs: the one-jit join->aggregate pipeline runs
+        # SPMD over the sharded probe (GSPMD turns its segment reductions
+        # into partial-reduce + all-reduce; build-side LUT probes are local
+        # gathers of the replicated small sides = broadcast joins).  The
+        # joined rows NEVER materialize, on host or device — this is the
+        # no-gather-between-merge-and-groupby path (VERDICT r3 #4/#5);
+        # the explicit all_to_all shuffle engine remains the general path
+        tried_join_pipeline = False
         if dist_plan.plan_has_sharded_scan(rel.input, executor.context):
+            joined = try_compiled_join_aggregate(rel, executor)
+            tried_join_pipeline = True
+            if joined is not None:
+                return joined
             (inp,) = self.assert_inputs(rel, 1, executor)
             dist = dist_plan.try_dist_aggregate(rel, executor, inp)
             if dist is not None:
@@ -50,11 +60,10 @@ class AggregatePlugin(BaseRelPlugin):
         streamed = try_streaming_aggregate(rel, executor)
         if streamed is not None:
             return streamed
-        from ...compiled_join import try_compiled_join_aggregate
-
-        joined = try_compiled_join_aggregate(rel, executor)
-        if joined is not None:
-            return joined
+        if not tried_join_pipeline:
+            joined = try_compiled_join_aggregate(rel, executor)
+            if joined is not None:
+                return joined
         compiled = try_compiled_aggregate(rel, executor)
         if compiled is not None:
             return compiled

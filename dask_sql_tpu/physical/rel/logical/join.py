@@ -178,12 +178,6 @@ class JoinPlugin(BaseRelPlugin):
             executor, "sql.distributed.join", left, right)
         if mesh is None:
             return None
-        broadcast = executor.config.get("sql.join.broadcast", None)
-        small = min(left.num_rows, right.num_rows)
-        if broadcast is True:
-            return None  # always-broadcast: replicated small side, local probe
-        if broadcast not in (None, False) and small <= float(broadcast):
-            return None
         lvalid = jnp.ones(left.num_rows, dtype=bool)
         for c in lkeys:
             if c.validity is not None:
@@ -192,6 +186,37 @@ class JoinPlugin(BaseRelPlugin):
         for c in rkeys:
             if c.validity is not None:
                 rvalid &= c.valid_mask()
+
+        # broadcast join: replicated small side probed in place, the big
+        # side never shuffles (reference join.py:228-246).  True = always;
+        # a number = row threshold; None/auto = small side well under the
+        # big one and bounded
+        broadcast = executor.config.get("sql.join.broadcast", None)
+        small = min(left.num_rows, right.num_rows)
+        big = max(left.num_rows, right.num_rows)
+        explicit = (broadcast is True
+                    or (broadcast not in (None, False)
+                        and small <= float(broadcast)))
+        auto = broadcast is None and small <= 65536 and small * 4 <= big
+        if explicit or auto:
+            if right.num_rows <= left.num_rows:
+                got = dist_plan.broadcast_inner_pairs(lgid, lvalid,
+                                                      rgid, rvalid)
+                if got is not None:
+                    return got
+            else:
+                got = dist_plan.broadcast_inner_pairs(rgid, rvalid,
+                                                      lgid, lvalid)
+                if got is not None:
+                    ri, li, _rmatch = got
+                    lmatch = np.zeros(left.num_rows, dtype=bool)
+                    lmatch[np.asarray(li)] = True
+                    return li, ri, lmatch
+            if explicit:
+                # the knob promises no shuffle: when the LUT declines
+                # (non-unique/sparse keys) keep the local replicated probe
+                # rather than the all_to_all engine
+                return None
         return dist_plan.dist_inner_pairs(mesh, lgid, lvalid, rgid, rvalid)
 
 

@@ -131,16 +131,29 @@ def test_sql_groupby_routes_through_agg_kernel(dist_ctx):
 
 @needs_mesh
 def test_sql_join_routes_through_join_kernel(dist_ctx):
+    """Round 4: a small dim side takes the broadcast path by default; the
+    all_to_all shuffle kernel remains the route when broadcast is off."""
     from dask_sql_tpu.parallel import dist_plan as dp
 
     c, df, dim = dist_ctx
-    before = dp.STATS["join_kernel"]
+    m = df[df.y > 50].merge(dim, on="k")
+    expected = m[["k", "y", "w"]]
+
+    before_bc = dp.STATS["broadcast_join"]
     result = c.sql(
         "SELECT big.k, big.y, dim.w FROM big JOIN dim ON big.k = dim.k "
         "WHERE big.y > 50").compute()
-    assert dp.STATS["join_kernel"] > before, "sharded join must use the kernel"
-    m = df[df.y > 50].merge(dim, on="k")
-    expected = m[["k", "y", "w"]]
+    assert dp.STATS["broadcast_join"] > before_bc, (
+        "small-dim sharded join must take the broadcast path")
+    assert_eq(result, expected, check_dtype=False, sort_results=True)
+
+    before_jk = dp.STATS["join_kernel"]
+    result = c.sql(
+        "SELECT big.k, big.y, dim.w FROM big JOIN dim ON big.k = dim.k "
+        "WHERE big.y > 50",
+        config_options={"sql.join.broadcast": False}).compute()
+    assert dp.STATS["join_kernel"] > before_jk, (
+        "shuffle kernel must run when broadcast is disabled")
     assert_eq(result, expected, check_dtype=False, sort_results=True)
 
 
