@@ -36,6 +36,10 @@ DEFAULTS: Dict[str, Any] = {
     "sql.compile.segsum": "auto",  # scatter | matmul | pallas segment sums
     "sql.streaming.enabled": True,  # out-of-core parquet batch aggregation
     "sql.streaming.batch_rows": 2_000_000,
+    "sql.compile.join_pipeline": True,  # one-jit scan->joins->aggregate
+    "sql.distributed.aggregate": "auto",  # collectives engine routing
+    "sql.distributed.join": "auto",
+    "sql.distributed.sort": "auto",  # range-partition sort over the mesh
 }
 
 

@@ -53,7 +53,7 @@ PEER_CAPACITY_LADDER = (2048, 16384, 131072, 1 << 20, 1 << 23)
 
 #: test/observability hooks: counts of kernel executions this process
 STATS = {"agg_kernel": 0, "join_kernel": 0, "agg_fallback": 0,
-         "broadcast_join": 0, "sharded_join_agg": 0}
+         "broadcast_join": 0, "sharded_join_agg": 0, "sort_kernel": 0}
 
 
 # ---------------------------------------------------------------------------
@@ -469,8 +469,269 @@ def get_join_kernel(mesh: Mesh, cpeer: int, out_cap: int):
 
 
 # ---------------------------------------------------------------------------
-# host wrappers (pad, place, run, ladder-retry, decode)
+# distributed range-partition sort
 # ---------------------------------------------------------------------------
+_SORT_KERNELS: Dict[tuple, object] = {}
+
+
+def get_sort_kernel(mesh: Mesh, nk: int, nc: int, cpeer: int, cpeer2: int,
+                    rows_out: int):
+    """Two-exchange distributed sort (parity: the reference's persist +
+    range-shuffle sort_values, reference physical/utils/sort.py:9-87 — here
+    sample splitters + all_to_all range partition + local sort + a second
+    all_to_all that rebalances to equal-size sorted shards).
+
+    nk encoded i64 sort-key arrays, nc i64 payload arrays, cpeer/cpeer2
+    per-peer bucket capacities for the two exchanges, rows_out rows per
+    device in the dense output."""
+    key = (tuple(d.id for d in mesh.devices.flat), nk, nc, cpeer, cpeer2,
+           rows_out)
+    fn = _SORT_KERNELS.get(key)
+    if fn is not None:
+        return fn
+    ndev = mesh.devices.size
+
+    def per_shard(keys, payload, rowvalid, splitters):
+        # keys [nk, n]; payload [nc, n]; rowvalid [n]; splitters [nk, ndev-1]
+        n = rowvalid.shape[0]
+        # 1. destination by lexicographic rank among the splitters
+        dest = jnp.zeros(n, dtype=jnp.int32)
+        for s in range(ndev - 1):
+            gt = jnp.zeros(n, dtype=bool)
+            eq = jnp.ones(n, dtype=bool)
+            for i in range(nk):
+                ki = keys[i]
+                si = splitters[i, s]
+                gt = gt | (eq & (ki > si))
+                eq = eq & (ki == si)
+            dest = dest + (gt | eq).astype(jnp.int32)  # ties go right
+        # 2. exchange rows to their range owner
+        iblock = jnp.concatenate(
+            [jnp.stack([keys[i] for i in range(nk)], axis=-1),
+             jnp.stack([payload[j] for j in range(nc)], axis=-1)], axis=-1)
+        fblock = jnp.zeros((n, 0), jnp.float64)
+        bi, bf, bv, of1 = _bucket_rows(dest, rowvalid, iblock, fblock, ndev,
+                                       cpeer)
+        ri, _, rv = _exchange(bi, bf, bv)
+        nrecv = rv.shape[0]
+        # 3. local sort (invalid rows last)
+        inv = (~rv).astype(jnp.int32)
+        iota = jnp.arange(nrecv, dtype=I64)
+        ops = (inv,) + tuple(ri[:, i] for i in range(nk)) + (iota,)
+        order = jax.lax.sort(ops, num_keys=1 + nk)[-1]
+        rs = ri[order]
+        vs = rv[order]
+        # 4. global sorted position: device-prefix offset + local rank
+        cnt = jnp.sum(rv.astype(I64))
+        counts = jax.lax.all_gather(cnt, AXIS)  # [ndev]
+        me = jax.lax.axis_index(AXIS)
+        offset = jnp.sum(jnp.where(jnp.arange(ndev) < me, counts, 0))
+        pos = offset + jnp.arange(nrecv, dtype=I64)  # valid rows come first
+        # 5. rebalance so device d owns rows [d*rows_out, (d+1)*rows_out)
+        dest2 = jnp.clip(pos // rows_out, 0, ndev - 1).astype(jnp.int32)
+        iblock2 = jnp.concatenate([pos[:, None], rs[:, nk:]], axis=-1)
+        bi2, bf2, bv2, of2 = _bucket_rows(
+            dest2, vs, iblock2, jnp.zeros((nrecv, 0), jnp.float64),
+            ndev, cpeer2)
+        ri2, _, rv2 = _exchange(bi2, bf2, bv2)
+        # 6. order the received rows by global position, keep rows_out
+        n2 = rv2.shape[0]
+        ops2 = (jnp.where(rv2, ri2[:, 0], I64_MAX),
+                jnp.arange(n2, dtype=I64))
+        order2 = jax.lax.sort(ops2, num_keys=1)[-1][:rows_out]
+        out = ri2[order2][:, 1:]          # [rows_out, nc]
+        return out.T[:, None, :], of1[None], of2[None]
+
+    mapped = shard_map(
+        per_shard, mesh=mesh,
+        in_specs=(P(None, AXIS), P(None, AXIS), P(AXIS), P(None, None)),
+        out_specs=(P(None, AXIS, None), P(AXIS), P(AXIS)),
+    )
+    fn = jax.jit(mapped)
+    _SORT_KERNELS[key] = fn
+    return fn
+
+
+def _ladder_next_or_none(ladder, v):
+    """Next rung, or None at the top (caller falls back instead of dying)."""
+    try:
+        return _ladder_next(ladder, v)
+    except Exception:
+        return None
+
+
+def _encode_sort_key(col: Column, ascending: bool, nulls_first: bool):
+    """Column -> list of ascending-order int64 arrays (leading null key when
+    nullable).  Dictionary strings must be compact (sorted dict) first.
+
+    MUST stay semantically in lockstep with the single-device
+    ops/sorting.py:sort_permutation (NaN sorts as +inf, null-indicator key
+    leads, descending = monotone reversal): tests compare the two paths
+    row-for-row (tests/integration/test_dist_sort.py)."""
+    data = col.data
+    if col.sql_type in STRING_TYPES:
+        col = col.compact_dictionary()
+        data = col.data
+    if data.dtype == jnp.bool_:
+        enc = data.astype(I64)
+    elif jnp.issubdtype(data.dtype, jnp.floating):
+        clean = jnp.where(jnp.isnan(data), jnp.inf, data)  # NaN sorts last
+        enc = _float_to_ordered_i64(clean)
+    else:
+        enc = data.astype(I64)
+    if not ascending:
+        enc = -1 - enc  # monotone reversal, no overflow
+    arrays = []
+    if col.validity is not None:
+        valid = col.valid_mask()
+        nullkey = jnp.where(valid, 1, 0) if nulls_first else \
+            jnp.where(valid, 0, 1)
+        arrays.append(nullkey.astype(I64))
+        enc = jnp.where(valid, enc, 0)
+    arrays.append(enc)
+    return arrays
+
+
+def _encode_payload(col: Column):
+    """Column -> (list of i64 transport arrays, decode(arr_list)->Column)."""
+    data = col.data
+    sql_type = col.sql_type
+    dictionary = col.dictionary
+    np_dtype = np.dtype(data.dtype)
+    if np_dtype.kind == "f":
+        enc = jax.lax.bitcast_convert_type(data.astype(jnp.float64), I64)
+    elif np_dtype.kind == "b":
+        enc = data.astype(I64)
+    else:
+        enc = data.astype(I64)
+    arrays = [enc]
+    nullable = col.validity is not None
+    if nullable:
+        arrays.append(col.valid_mask().astype(I64))
+
+    def decode(dev_arrays: List[jnp.ndarray], n: int, sharding) -> Column:
+        # elementwise device ops, then an explicit row-block re-pin: the
+        # sorted table stays sharded on the mesh (device order IS the sort
+        # order)
+        def place(x):
+            if sharding is None:
+                return x[:n]
+            ndev_ = sharding.mesh.devices.size
+            if n % ndev_ == 0:
+                # divisible: commit the sliced output to the row sharding
+                return jax.jit(lambda a: a[:n], out_shardings=sharding)(x)
+            # non-divisible lengths cannot be row-block committed; pin the
+            # padded layout and slice (same trade as distribute.shard_table)
+            return jax.jit(lambda a: a, out_shardings=sharding)(x)[:n]
+
+        raw = dev_arrays[0]
+        if np_dtype.kind == "f":
+            vals = jax.lax.bitcast_convert_type(
+                raw, jnp.float64).astype(np_dtype)
+        elif np_dtype.kind == "b":
+            vals = raw.astype(bool)
+        else:
+            vals = raw.astype(np_dtype)
+        vals = place(vals)
+        validity = None
+        if nullable:
+            v = place(dev_arrays[1].astype(bool))
+            # scalar reduce on device — never pull the whole mask to host
+            if not bool(host_read(jnp.all(v))):
+                validity = v
+        return Column(vals, sql_type, validity, dictionary)
+
+    return arrays, decode
+
+
+def dist_sort_table(mesh: Mesh, table, sort_cols: List[Column],
+                    ascendings: List[bool], nulls_firsts: List[bool]):
+    """Sort a mesh-sharded Table globally; output stays row-sharded.
+
+    Sample-based splitters + the two-exchange kernel above.  Returns the
+    sorted Table (device order IS the sort order) or None when ineligible."""
+    n = table.num_rows
+    ndev = mesh.devices.size
+    if n == 0 or ndev <= 1:
+        return None
+
+    key_arrays: List[jnp.ndarray] = []
+    for col, asc, nf in zip(sort_cols, ascendings, nulls_firsts):
+        key_arrays.extend(_encode_sort_key(col, asc, nf))
+    # string sort keys whose dictionaries were re-encoded produce NEW code
+    # arrays; the payload still carries the ORIGINAL columns
+    payload_arrays: List[jnp.ndarray] = []
+    decoders = []
+    for name in table.column_names:
+        arrs, dec = _encode_payload(table.columns[name])
+        payload_arrays.append(arrs)
+        decoders.append(dec)
+    flat_payload = [a for arrs in payload_arrays for a in arrs]
+
+    nk = len(key_arrays)
+    nc = len(flat_payload)
+
+    # placement (pad to ndev multiple)
+    def place_stack(arrs):
+        padded = [pad_to_multiple(a.astype(I64), ndev)[0] for a in arrs]
+        return jax.device_put(jnp.stack(padded),
+                              NamedSharding(mesh, P(None, AXIS)))
+
+    keys_mat = place_stack(key_arrays)
+    pay_mat = place_stack(flat_payload) if nc else jnp.zeros(
+        (0, keys_mat.shape[1]), I64)
+    rowvalid = jax.device_put(
+        pad_to_multiple(jnp.ones(n, bool), ndev, fill=False)[0],
+        row_sharding(mesh))
+
+    # splitters from an evenly-spaced sample (host: tiny)
+    ns = min(n, max(ndev * 64, 512))
+    sample_idx = np.linspace(0, n - 1, ns).astype(np.int64)
+    sample = np.stack([host_read(k[jnp.asarray(sample_idx)])
+                       for k in key_arrays])  # [nk, ns]
+    order = np.lexsort(sample[::-1])
+    qs = sample[:, order][:, np.linspace(0, ns - 1, ndev + 1
+                                         ).astype(int)[1:-1]]
+    splitters = jnp.asarray(qs.reshape(nk, ndev - 1))
+
+    n_padded = keys_mat.shape[1]
+    rows_out = n_padded // ndev
+    cpeer = _ladder_at_least(PEER_CAPACITY_LADDER, 2 * rows_out + 256)
+    cpeer2 = _ladder_at_least(PEER_CAPACITY_LADDER,
+                              2 * rows_out // ndev + 256)
+    for _ in range(10):
+        fn = get_sort_kernel(mesh, nk, nc, cpeer, cpeer2, rows_out)
+        out, of1, of2 = fn(keys_mat, pay_mat, rowvalid, splitters)
+        STATS["sort_kernel"] += 1
+        grew = False
+        if bool(host_read(of1).any()):
+            cpeer = _ladder_next_or_none(PEER_CAPACITY_LADDER, cpeer)
+            if cpeer is None:
+                return None  # fall back to the single-program sort
+            grew = True
+        if bool(host_read(of2).any()):
+            cpeer2 = _ladder_next_or_none(PEER_CAPACITY_LADDER, cpeer2)
+            if cpeer2 is None:
+                return None
+            grew = True
+        if not grew:
+            break
+    else:
+        return None  # pathological skew: keep the single-program sort
+
+    # out [nc, ndev, rows_out] sharded on the device axis; flatten to global
+    # row order and slice the padding off (stays sharded, like shard_table)
+    from ..columnar.table import Table as _Table
+
+    cols = {}
+    i = 0
+    flat = out.reshape(nc, n_padded) if nc else out
+    sh = row_sharding(mesh)
+    for name, arrs, dec in zip(table.column_names, payload_arrays, decoders):
+        k = len(arrs)
+        cols[name] = dec([flat[i + j] for j in range(k)], n, sh)
+        i += k
+    return _Table(cols, n)
 def _place_rows(arr: jnp.ndarray, mesh: Mesh, fill=0):
     """Pad to a multiple of ndev and row-shard; returns (placed, valid)."""
     ndev = mesh.devices.size

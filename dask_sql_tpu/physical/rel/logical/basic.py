@@ -122,6 +122,22 @@ class SortPlugin(BaseRelPlugin):
         if inp.num_rows == 0:
             return inp
         cols = [executor.eval_expr(k.expr, inp) for k in rel.keys]
+        # mesh-sharded input + full sort: sample-based range-partition sort
+        # over the mesh (output stays row-sharded; device order IS the sort
+        # order).  LIMIT keeps the top-k path below — the k survivors are
+        # tiny regardless of sharding.
+        if rel.fetch is None and cols:
+            from ....parallel import dist_plan
+
+            mesh = dist_plan.should_distribute(
+                executor, "sql.distributed.sort", inp)
+            if mesh is not None:
+                sorted_t = dist_plan.dist_sort_table(
+                    mesh, inp, cols,
+                    [k.ascending for k in rel.keys],
+                    [k.nulls_first_resolved() for k in rel.keys])
+                if sorted_t is not None:
+                    return self.fix_column_to_row_type(sorted_t, rel.schema)
         limit = executor.config.get("sql.sort.topk-nelem-limit", 1_000_000)
         if (rel.fetch is not None and len(cols) >= 1
                 and rel.fetch * max(len(inp.columns), 1) <= limit):
