@@ -696,9 +696,13 @@ def dist_sort_table(mesh: Mesh, table, sort_cols: List[Column],
 
     n_padded = keys_mat.shape[1]
     rows_out = n_padded // ndev
-    cpeer = _ladder_at_least(PEER_CAPACITY_LADDER, 2 * rows_out + 256)
-    cpeer2 = _ladder_at_least(PEER_CAPACITY_LADDER,
-                              2 * rows_out // ndev + 256)
+    # a source holds exactly rows_out rows, so no src->peer pair can exceed
+    # rows_out in either exchange; a target also receives exactly rows_out
+    # rows total in exchange 2.  rows_out + slack is therefore overflow-free
+    # by construction (the of1/of2 ladders only matter past the ladder top,
+    # where the single-program sort takes over).
+    cpeer = _ladder_at_least(PEER_CAPACITY_LADDER, rows_out + 16)
+    cpeer2 = cpeer
     for _ in range(10):
         fn = get_sort_kernel(mesh, nk, nc, cpeer, cpeer2, rows_out)
         out, of1, of2 = fn(keys_mat, pay_mat, rowvalid, splitters)

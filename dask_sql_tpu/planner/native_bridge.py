@@ -121,6 +121,13 @@ _K_LIKE = 61; _K_ISNULL = 62; _K_ISBOOL = 63; _K_ISDIST = 64; _K_EXTRACT = 65
 _K_SUBSTRING = 66; _K_TRIM = 67; _K_POSITION = 68; _K_OVERLAY = 69
 _K_CEILFLOORTO = 70; _K_GROUPING_SETS = 71; _K_SET_NODE = 72; _K_ROLLUP = 73
 _K_CUBE = 74
+_K_QNAME = 79; _K_CREATE_TABLE_WITH = 80; _K_CREATE_TABLE_AS = 81
+_K_DROP_TABLE = 82; _K_CREATE_SCHEMA = 83; _K_DROP_SCHEMA = 84
+_K_USE_SCHEMA = 85; _K_ALTER_SCHEMA = 86; _K_ALTER_TABLE = 87
+_K_SHOW_SCHEMAS = 88; _K_SHOW_TABLES = 89; _K_SHOW_COLUMNS = 90
+_K_SHOW_MODELS = 91; _K_ANALYZE_TABLE = 92; _K_CREATE_MODEL = 93
+_K_DROP_MODEL = 94; _K_DESCRIBE_MODEL = 95; _K_EXPORT_MODEL = 96
+_K_CREATE_EXPERIMENT = 97; _K_KWARGS = 98; _K_KV = 99; _K_KWLIST = 100
 
 _FRAME_KINDS = ["UNBOUNDED_PRECEDING", "PRECEDING", "CURRENT_ROW",
                 "FOLLOWING", "UNBOUNDED_FOLLOWING"]
@@ -464,12 +471,102 @@ def native_parse(sql: str):
 
     stmts = []
     for sid in f.kids(f.root):
-        kind, flags, _, _, _, _, _, _ = f.nodes[sid]
-        if kind == _K_QUERY_STMT:
-            stmts.append(a.QueryStatement(_decode_select(f, f.kids(sid)[0])))
-        elif kind == _K_EXPLAIN_STMT:
-            stmts.append(a.ExplainStatement(_decode_select(f, f.kids(sid)[0]),
-                                            bool(flags & 1)))
-        else:
+        stmt = _decode_statement(f, sid)
+        if stmt is None:
             return None
+        stmts.append(stmt)
     return stmts
+
+
+def _decode_qname(f: "_FlatAst", nid: int):
+    return [f.s(f.nodes[p][4]) for p in f.kids(nid)]
+
+
+def _decode_kwarg_value(f: "_FlatAst", nid: int):
+    kind, flags, ival, dval, s0, s1, _, _ = f.nodes[nid]
+    if kind == _K_LIT_STR:
+        return f.s(s0)
+    if kind == _K_LIT_INT:
+        return ival
+    if kind == _K_LIT_FLOAT:
+        return dval
+    if kind == _K_LIT_BOOL:
+        return bool(ival)
+    if kind == _K_LIT_NULL:
+        return None
+    if kind == _K_KWLIST:
+        return [_decode_kwarg_value(f, k) for k in f.kids(nid)]
+    if kind == _K_KWARGS:
+        return _decode_kwargs(f, nid)
+    raise ValueError(f"bad kwarg value kind {kind}")
+
+
+def _decode_kwargs(f: "_FlatAst", nid: int):
+    out = {}
+    for kv in f.kids(nid):
+        _, _, _, _, s0, _, _, _ = f.nodes[kv]
+        out[f.s(s0)] = _decode_kwarg_value(f, f.kids(kv)[0])
+    return out
+
+
+def _decode_statement(f: "_FlatAst", sid: int):
+    """One statement node -> sqlast.Statement, or None for unknown kinds
+    (the caller then falls back to the Python parser wholesale)."""
+    from . import sqlast as a
+
+    kind, flags, _, _, s0, s1, _, _ = f.nodes[sid]
+    kids = f.kids(sid)
+    ine = bool(flags & 1)
+    orr = bool(flags & 2)
+    if kind == _K_QUERY_STMT:
+        return a.QueryStatement(_decode_select(f, kids[0]))
+    if kind == _K_EXPLAIN_STMT:
+        return a.ExplainStatement(_decode_select(f, kids[0]), bool(flags & 1))
+    if kind == _K_CREATE_TABLE_WITH:
+        return a.CreateTableWith(_decode_qname(f, kids[0]),
+                                 _decode_kwargs(f, kids[1]), ine, orr)
+    if kind == _K_CREATE_TABLE_AS:
+        return a.CreateTableAs(_decode_qname(f, kids[0]),
+                               _decode_select(f, kids[1]),
+                               persist=bool(flags & 4),
+                               if_not_exists=ine, or_replace=orr)
+    if kind == _K_DROP_TABLE:
+        return a.DropTable(_decode_qname(f, kids[0]), bool(flags & 1))
+    if kind == _K_CREATE_SCHEMA:
+        return a.CreateSchema(f.s(s0), ine, orr)
+    if kind == _K_DROP_SCHEMA:
+        return a.DropSchema(f.s(s0), bool(flags & 1))
+    if kind == _K_USE_SCHEMA:
+        return a.UseSchema(f.s(s0))
+    if kind == _K_ALTER_SCHEMA:
+        return a.AlterSchema(f.s(s0), f.s(s1))
+    if kind == _K_ALTER_TABLE:
+        return a.AlterTable(_decode_qname(f, kids[0]), f.s(s0),
+                            bool(flags & 1))
+    if kind == _K_SHOW_SCHEMAS:
+        return a.ShowSchemas(f.s(s0))
+    if kind == _K_SHOW_TABLES:
+        return a.ShowTables(f.s(s0))
+    if kind == _K_SHOW_COLUMNS:
+        return a.ShowColumns(_decode_qname(f, kids[0]))
+    if kind == _K_SHOW_MODELS:
+        return a.ShowModels(f.s(s0))
+    if kind == _K_ANALYZE_TABLE:
+        cols = [f.s(f.nodes[p][4]) for p in kids[1:]]
+        return a.AnalyzeTable(_decode_qname(f, kids[0]), cols)
+    if kind == _K_CREATE_MODEL:
+        return a.CreateModel(_decode_qname(f, kids[0]),
+                             _decode_kwargs(f, kids[1]),
+                             _decode_select(f, kids[2]), ine, orr)
+    if kind == _K_DROP_MODEL:
+        return a.DropModel(_decode_qname(f, kids[0]), bool(flags & 1))
+    if kind == _K_DESCRIBE_MODEL:
+        return a.DescribeModel(_decode_qname(f, kids[0]))
+    if kind == _K_EXPORT_MODEL:
+        return a.ExportModel(_decode_qname(f, kids[0]),
+                             _decode_kwargs(f, kids[1]))
+    if kind == _K_CREATE_EXPERIMENT:
+        return a.CreateExperiment(_decode_qname(f, kids[0]),
+                                  _decode_kwargs(f, kids[1]),
+                                  _decode_select(f, kids[2]), ine, orr)
+    return None

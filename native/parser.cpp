@@ -59,6 +59,21 @@ enum Kind : int32_t {
   K_SUBSTRING = 66, K_TRIM = 67, K_POSITION = 68, K_OVERLAY = 69,
   K_CEILFLOORTO = 70, K_GROUPING_SETS = 71, K_SET_NODE = 72, K_ROLLUP = 73,
   K_CUBE = 74,
+  // DDL / ML dialect statements (round 4: the native parser covers the
+  // whole dialect — parity src/parser.rs:552-1350 which implements the
+  // same statements over sqlparser-rs)
+  K_QNAME = 79, K_CREATE_TABLE_WITH = 80, K_CREATE_TABLE_AS = 81,
+  K_DROP_TABLE = 82, K_CREATE_SCHEMA = 83, K_DROP_SCHEMA = 84,
+  K_USE_SCHEMA = 85, K_ALTER_SCHEMA = 86, K_ALTER_TABLE = 87,
+  K_SHOW_SCHEMAS = 88, K_SHOW_TABLES = 89, K_SHOW_COLUMNS = 90,
+  K_SHOW_MODELS = 91, K_ANALYZE_TABLE = 92, K_CREATE_MODEL = 93,
+  K_DROP_MODEL = 94, K_DESCRIBE_MODEL = 95, K_EXPORT_MODEL = 96,
+  K_CREATE_EXPERIMENT = 97, K_KWARGS = 98, K_KV = 99, K_KWLIST = 100,
+};
+
+// statement flag bits
+enum {
+  F_IF_NOT_EXISTS = 1, F_OR_REPLACE = 2, F_PERSIST = 4, F_IF_EXISTS = 1,
 };
 
 // frame bound kinds
@@ -377,7 +392,252 @@ class Parser {
       accept_keyword("VERBOSE");
       return b_.add(K_EXPLAIN_STMT, {parse_query()}, analyze ? 1 : 0);
     }
-    throw Unsupported{};  // DDL/ML statements stay on the Python parser
+    if (at_keyword("CREATE")) return parse_create();
+    if (at_keyword("DROP")) return parse_drop();
+    if (at_keyword("SHOW")) return parse_show();
+    if (at_keyword("DESCRIBE") || at_keyword("DESC")) {
+      next();
+      if (accept_keyword("MODEL"))
+        return b_.add(K_DESCRIBE_MODEL, {parse_qname()});
+      return b_.add(K_SHOW_COLUMNS, {parse_qname()});
+    }
+    if (at_keyword("ANALYZE")) {
+      next();
+      expect_keyword("TABLE");
+      int32_t qn = parse_qname();
+      expect_keyword("COMPUTE");
+      expect_keyword("STATISTICS");
+      std::vector<int32_t> kids{qn};
+      if (accept_keyword("FOR")) {
+        if (accept_keyword("ALL")) {
+          expect_keyword("COLUMNS");
+        } else {
+          expect_keyword("COLUMNS");
+          kids.push_back(b_.add(K_PART, {}, 0, 0, 0.0,
+                                b_.intern(parse_identifier())));
+          while (accept(","))
+            kids.push_back(b_.add(K_PART, {}, 0, 0, 0.0,
+                                  b_.intern(parse_identifier())));
+        }
+      }
+      return b_.add(K_ANALYZE_TABLE, kids);
+    }
+    if (at_keyword("USE")) {
+      next();
+      expect_keyword("SCHEMA");
+      return b_.add(K_USE_SCHEMA, {}, 0, 0, 0.0,
+                    b_.intern(parse_identifier()));
+    }
+    if (at_keyword("ALTER")) return parse_alter();
+    if (at_keyword("EXPORT")) {
+      next();
+      expect_keyword("MODEL");
+      int32_t qn = parse_qname();
+      expect_keyword("WITH");
+      return b_.add(K_EXPORT_MODEL, {qn, parse_kwargs()});
+    }
+    // unknown statement heads fall back wholesale to the Python parser,
+    // which owns the user-facing "Unsupported statement" error
+    throw Unsupported{};
+  }
+
+  int32_t parse_qname() { return b_.add(K_QNAME, parse_qualified_parts()); }
+
+  int32_t parse_create() {
+    expect_keyword("CREATE");
+    int32_t flags = 0;
+    if (accept_keyword("OR")) {
+      expect_keyword("REPLACE");
+      flags |= F_OR_REPLACE;
+    }
+    if (accept_keyword("SCHEMA")) {
+      if (if_not_exists()) flags |= F_IF_NOT_EXISTS;
+      return b_.add(K_CREATE_SCHEMA, {}, flags, 0, 0.0,
+                    b_.intern(parse_identifier()));
+    }
+    if (accept_keyword("MODEL")) {
+      if (if_not_exists()) flags |= F_IF_NOT_EXISTS;
+      int32_t qn = parse_qname();
+      expect_keyword("WITH");
+      int32_t kw = parse_kwargs();
+      expect_keyword("AS");
+      accept("(");
+      int32_t q = parse_query();
+      accept(")");
+      return b_.add(K_CREATE_MODEL, {qn, kw, q}, flags);
+    }
+    if (accept_keyword("EXPERIMENT")) {
+      if (if_not_exists()) flags |= F_IF_NOT_EXISTS;
+      int32_t qn = parse_qname();
+      expect_keyword("WITH");
+      int32_t kw = parse_kwargs();
+      expect_keyword("AS");
+      accept("(");
+      int32_t q = parse_query();
+      accept(")");
+      return b_.add(K_CREATE_EXPERIMENT, {qn, kw, q}, flags);
+    }
+    bool is_view = accept_keyword("VIEW");
+    if (!is_view) expect_keyword("TABLE");
+    if (if_not_exists()) flags |= F_IF_NOT_EXISTS;
+    int32_t qn = parse_qname();
+    if (accept_keyword("WITH"))
+      return b_.add(K_CREATE_TABLE_WITH, {qn, parse_kwargs()}, flags);
+    if (accept_keyword("AS")) {
+      accept("(");
+      int32_t q = parse_query();
+      accept(")");
+      if (!is_view) flags |= F_PERSIST;
+      return b_.add(K_CREATE_TABLE_AS, {qn, q}, flags);
+    }
+    throw ParseErr{peek().pos,
+                   "Expected WITH (...) or AS (...) in CREATE TABLE"};
+  }
+
+  bool if_not_exists() {
+    if (accept_keyword("IF")) {
+      expect_keyword("NOT");
+      expect_keyword("EXISTS");
+      return true;
+    }
+    return false;
+  }
+
+  bool if_exists() {
+    if (accept_keyword("IF")) {
+      expect_keyword("EXISTS");
+      return true;
+    }
+    return false;
+  }
+
+  int32_t parse_drop() {
+    expect_keyword("DROP");
+    if (accept_keyword("SCHEMA")) {
+      int32_t flags = if_exists() ? F_IF_EXISTS : 0;
+      return b_.add(K_DROP_SCHEMA, {}, flags, 0, 0.0,
+                    b_.intern(parse_identifier()));
+    }
+    if (accept_keyword("MODEL")) {
+      int32_t flags = if_exists() ? F_IF_EXISTS : 0;
+      return b_.add(K_DROP_MODEL, {parse_qname()}, flags);
+    }
+    if (accept_keyword("TABLE") || accept_keyword("VIEW")) {
+      int32_t flags = if_exists() ? F_IF_EXISTS : 0;
+      return b_.add(K_DROP_TABLE, {parse_qname()}, flags);
+    }
+    throw ParseErr{peek().pos,
+                   "Expected TABLE, VIEW, SCHEMA or MODEL after DROP"};
+  }
+
+  int32_t parse_show() {
+    expect_keyword("SHOW");
+    if (accept_keyword("SCHEMAS")) {
+      int32_t like = -1;
+      if (accept_keyword("LIKE")) like = b_.intern(next().value);
+      return b_.add(K_SHOW_SCHEMAS, {}, 0, 0, 0.0, like);
+    }
+    if (accept_keyword("TABLES")) {
+      int32_t schema = -1;
+      if (accept_keyword("FROM") || accept_keyword("IN"))
+        schema = b_.intern(parse_identifier());
+      return b_.add(K_SHOW_TABLES, {}, 0, 0, 0.0, schema);
+    }
+    if (accept_keyword("COLUMNS")) {
+      expect_keyword("FROM");
+      return b_.add(K_SHOW_COLUMNS, {parse_qname()});
+    }
+    if (accept_keyword("MODELS")) {
+      int32_t schema = -1;
+      if (accept_keyword("FROM") || accept_keyword("IN"))
+        schema = b_.intern(parse_identifier());
+      return b_.add(K_SHOW_MODELS, {}, 0, 0, 0.0, schema);
+    }
+    throw ParseErr{peek().pos,
+                   "Expected SCHEMAS, TABLES, COLUMNS or MODELS after SHOW"};
+  }
+
+  int32_t parse_alter() {
+    expect_keyword("ALTER");
+    if (accept_keyword("SCHEMA")) {
+      int32_t old_s = b_.intern(parse_identifier());
+      expect_keyword("RENAME");
+      expect_keyword("TO");
+      return b_.add(K_ALTER_SCHEMA, {}, 0, 0, 0.0, old_s,
+                    b_.intern(parse_identifier()));
+    }
+    expect_keyword("TABLE");
+    int32_t flags = if_exists() ? F_IF_EXISTS : 0;
+    int32_t qn = parse_qname();
+    expect_keyword("RENAME");
+    expect_keyword("TO");
+    return b_.add(K_ALTER_TABLE, {qn}, flags, 0, 0.0,
+                  b_.intern(parse_identifier()));
+  }
+
+  // WITH ( key = value, ... ) — values: literal, ident, list, nested map
+  int32_t parse_kwargs() {
+    expect("(");
+    std::vector<int32_t> kvs;
+    if (!accept(")")) {
+      while (true) {
+        std::string key = parse_identifier();
+        expect("=");
+        kvs.push_back(b_.add(K_KV, {parse_kwarg_value()}, 0, 0, 0.0,
+                             b_.intern(key)));
+        if (!accept(",")) break;
+      }
+      expect(")");
+    }
+    return b_.add(K_KWARGS, kvs);
+  }
+
+  int32_t parse_kwarg_value() {
+    const Token& t = peek();
+    if (t.type == T_STRING) {
+      next();
+      return b_.add(K_LIT_STR, {}, 0, 0, 0.0, b_.intern(t.value));
+    }
+    if (t.type == T_NUMBER) {
+      next();
+      return number_literal(t.value);
+    }
+    if (peek_is(0, "(")) {
+      // nested map when "( ident =" follows; else a parenthesized list
+      if ((peek(1).type == T_IDENT || peek(1).type == T_QUOTED) &&
+          peek_is(2, "="))
+        return parse_kwargs();
+      next();  // consume "("
+      std::vector<int32_t> items;
+      if (!accept(")")) {
+        while (true) {
+          items.push_back(parse_kwarg_value());
+          if (!accept(",")) break;
+        }
+        expect(")");
+      }
+      return b_.add(K_KWLIST, items);
+    }
+    if (peek_is(0, "[")) {
+      next();
+      std::vector<int32_t> items;
+      if (!accept("]")) {
+        while (true) {
+          items.push_back(parse_kwarg_value());
+          if (!accept(",")) break;
+        }
+        expect("]");
+      }
+      return b_.add(K_KWLIST, items);
+    }
+    if (t.type == T_IDENT) {
+      next();
+      if (t.upper == "TRUE") return b_.add(K_LIT_BOOL, {}, 0, 1);
+      if (t.upper == "FALSE") return b_.add(K_LIT_BOOL, {}, 0, 0);
+      if (t.upper == "NULL") return b_.add(K_LIT_NULL, {});
+      return b_.add(K_LIT_STR, {}, 0, 0, 0.0, b_.intern(t.value));
+    }
+    throw ParseErr{t.pos, "Expected kwarg value"};
   }
 
   // -- queries ------------------------------------------------------------

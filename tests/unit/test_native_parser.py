@@ -78,13 +78,54 @@ def test_grammar_case_differential(sql):
     assert nat == Parser(sql).parse_statements()
 
 
+DDL_CASES = [
+    # round 4: the native parser is fallback-off for the ENTIRE dialect
+    # (VERDICT r3 #8; bar: reference src/parser.rs:552-1350)
+    "SHOW SCHEMAS",
+    "SHOW SCHEMAS LIKE 'oth%'",
+    "SHOW TABLES",
+    "SHOW TABLES FROM myschema",
+    "SHOW COLUMNS FROM myschema.tbl",
+    "SHOW MODELS",
+    "DESCRIBE some_table",
+    "DESCRIBE MODEL my_model",
+    "USE SCHEMA other",
+    "ANALYZE TABLE t COMPUTE STATISTICS FOR ALL COLUMNS",
+    "ANALYZE TABLE s.t COMPUTE STATISTICS FOR COLUMNS a, b, c",
+    "CREATE SCHEMA IF NOT EXISTS abc",
+    "CREATE OR REPLACE SCHEMA abc",
+    "DROP SCHEMA IF EXISTS abc",
+    "ALTER SCHEMA old_s RENAME TO new_s",
+    "ALTER TABLE IF EXISTS s.old_t RENAME TO new_t",
+    "CREATE TABLE t WITH (location = 'x.parquet', format = 'parquet', "
+    "persist = True, statistics = (row_count = 100))",
+    "CREATE OR REPLACE TABLE t AS (SELECT a, SUM(b) FROM x GROUP BY a)",
+    "CREATE TABLE IF NOT EXISTS t AS SELECT 1 AS one",
+    "CREATE VIEW v AS (SELECT * FROM t WHERE a > 2)",
+    "DROP TABLE IF EXISTS t",
+    "DROP VIEW v",
+    "CREATE MODEL my_model WITH (model_class = 'GradientBoostingClassifier',"
+    " wrap_predict = True, target_column = 'target', "
+    "fit_kwargs = (single_quoted = 'yes', number = 3.5, flag = False, "
+    "list_arg = (1, 2, 'three'), arr = [4, 5], nothing = NULL)) AS ("
+    "SELECT x, y, x*y > 0 AS target FROM timeseries LIMIT 100)",
+    "CREATE OR REPLACE MODEL IF NOT EXISTS m WITH (model_class='c') AS SELECT 1",
+    "DROP MODEL IF EXISTS my_model",
+    "EXPORT MODEL my_model WITH (format = 'pickle', location = '/tmp/m.pkl')",
+    "CREATE EXPERIMENT ex WITH (model_class = 'x', experiment_class = 'y',"
+    " tune_parameters = (n_estimators = [16, 32], learning_rate = [0.1]))"
+    " AS (SELECT * FROM train)",
+    "CREATE TABLE t1 AS (SELECT 1); SELECT * FROM t1; DROP TABLE t1",
+]
+
+
 @needs_native
-def test_ddl_falls_back_to_python():
-    # DDL statements are Python-parser territory: native returns None
-    assert native_parse("SHOW TABLES") is None
-    assert native_parse("CREATE TABLE t WITH (location='x.parquet')") is None
-    assert native_parse(
-        "CREATE MODEL m WITH (model_class='x') AS SELECT 1") is None
+@pytest.mark.parametrize("sql", DDL_CASES)
+def test_ddl_parses_natively(sql):
+    """Fallback-off: every dialect statement goes through the C++ parser."""
+    nat = native_parse(sql)
+    assert nat is not None, "DDL statement fell back to the Python parser"
+    assert nat == Parser(sql).parse_statements(), "DDL AST mismatch"
 
 
 @needs_native
