@@ -168,6 +168,14 @@ class Column:
         """Materialize to a host numpy array with NULLs as None/NaN/NaT."""
         data = np.asarray(self.data)
         mask = None if self.validity is None else ~np.asarray(self.validity)
+        return self.decode_host(data, mask)
+
+    def decode_host(self, data: np.ndarray,
+                    mask: Optional[np.ndarray]) -> np.ndarray:
+        """Host decode of already-transferred buffers (mask = ~validity).
+
+        Split from to_numpy so Table.to_pandas can pull every column in ONE
+        packed device transfer and decode here."""
         if self.sql_type in STRING_TYPES:
             codes = np.clip(data, 0, max(len(self.dictionary) - 1, 0))
             out = self.dictionary[codes].astype(object) if len(self.dictionary) else np.full(len(data), "", dtype=object)

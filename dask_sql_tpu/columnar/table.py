@@ -113,10 +113,53 @@ class Table:
     def to_pandas(self):
         import pandas as pd
 
-        data = {n: c.to_numpy() for n, c in self.columns.items()}
+        data = self._host_columns()
         if not data:
             return pd.DataFrame(index=range(self._num_rows))
         return pd.DataFrame(data)
+
+    def _host_columns(self):
+        """{name: numpy} with NULL decoding.
+
+        On accelerator backends every device buffer rides ONE packed
+        transfer (per-column pulls each cost a dispatch round trip, which
+        dominates on a tunneled chip); host-resident columns and the CPU
+        backend use the plain per-column path."""
+        import os
+
+        import jax
+
+        cols = self.columns
+        force = os.environ.get("DSQL_PACK_TO_PANDAS") == "1"  # for tests
+        if not cols or self._num_rows == 0 or (
+                jax.default_backend() == "cpu" and not force):
+            return {n: c.to_numpy() for n, c in cols.items()}
+        from .pack import packed_host_arrays
+
+        bufs = []
+        for c in cols.values():
+            bufs.append(c.data)
+            if c.validity is not None:
+                bufs.append(c.validity)
+        try:
+            host = packed_host_arrays(bufs)
+        except Exception:  # noqa: BLE001 - backend pack quirk -> per-column
+            host = None
+        if host is None:
+            return {n: c.to_numpy() for n, c in cols.items()}
+        # decode errors propagate: a silent fallback here would double-pay
+        # the transfer on every call while hiding the defect
+        out = {}
+        i = 0
+        for n, c in cols.items():
+            data = host[i]
+            i += 1
+            mask = None
+            if c.validity is not None:
+                mask = ~host[i]
+                i += 1
+            out[n] = c.decode_host(data, mask)
+        return out
 
     def to_arrow(self):
         from . import interop

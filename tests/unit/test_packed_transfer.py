@@ -1,0 +1,78 @@
+"""Packed single-transfer host materialization (columnar/pack.py): the
+accelerator-backend Table.to_pandas path must agree exactly with the
+per-column path over every dtype family and NULL placement."""
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from dask_sql_tpu.columnar.column import Column
+from dask_sql_tpu.columnar.table import Table
+
+
+@pytest.fixture()
+def mixed_table():
+    rng = np.random.RandomState(0)
+    n = 257
+    f64 = rng.randn(n)
+    f64[3] = np.nan  # becomes NULL at ingest
+    f32 = rng.randn(n).astype(np.float32)
+    i64 = rng.randint(-(2 ** 62), 2 ** 62, n)
+    i32 = rng.randint(-100, 100, n).astype(np.int32)
+    b = rng.rand(n) < 0.5
+    s = rng.choice(["x", "yy", "zzz", None], n)
+    d = (np.datetime64("2020-01-01") +
+         rng.randint(0, 1000, n).astype("timedelta64[D]"))
+    cols = {
+        "f64": Column.from_numpy(f64),
+        "f32": Column.from_numpy(f32),
+        "i64": Column.from_numpy(i64),
+        "i32": Column.from_numpy(i32),
+        "b": Column.from_numpy(b),
+        "s": Column.from_numpy(s),
+        "d": Column.from_numpy(d),
+    }
+    return Table(cols, n)
+
+
+def test_packed_path_matches_per_column(mixed_table, monkeypatch):
+    plain = mixed_table.to_pandas()
+    monkeypatch.setenv("DSQL_PACK_TO_PANDAS", "1")
+    packed = mixed_table.to_pandas()
+    assert list(plain.columns) == list(packed.columns)
+    for col in plain.columns:
+        a, b = plain[col], packed[col]
+        assert str(a.dtype) == str(b.dtype), col
+        if a.dtype.kind == "f":
+            np.testing.assert_array_equal(np.isnan(a), np.isnan(b))
+            np.testing.assert_array_equal(a[~np.isnan(a)], b[~np.isnan(b)])
+        else:
+            assert a.equals(b), col
+
+
+def test_packed_helper_bit_exact():
+    from dask_sql_tpu.columnar.pack import packed_host_arrays
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(1)
+    f64 = rng.randn(100)
+    f32 = rng.randn(100).astype(np.float32)
+    i64 = np.array([np.iinfo(np.int64).min, -1, 0, np.iinfo(np.int64).max]
+                   ).repeat(25)
+    got = packed_host_arrays([jnp.asarray(f64), jnp.asarray(f32),
+                              jnp.asarray(i64)])
+    np.testing.assert_array_equal(got[0], f64)
+    np.testing.assert_array_equal(got[1], f32)
+    np.testing.assert_array_equal(got[2], i64)
+    assert got[0].dtype == np.float64 and got[1].dtype == np.float32
+    assert got[2].dtype == np.int64
+
+
+def test_packed_helper_declines_mixed_lengths():
+    from dask_sql_tpu.columnar.pack import packed_host_arrays
+    import jax.numpy as jnp
+
+    assert packed_host_arrays([jnp.zeros(3), jnp.zeros(4)]) is None
+    assert packed_host_arrays([np.zeros(3), np.zeros(3)]) is None
+    assert packed_host_arrays([jnp.zeros(3)]) is None
