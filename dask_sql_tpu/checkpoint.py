@@ -1,0 +1,213 @@
+"""Session checkpoint / restore: survive a process or host loss.
+
+Role parity (SURVEY §5 failure detection / recovery): the reference leans on
+dask.distributed — a lost worker's partitions are recomputed from the task
+graph and `persist`/`publish_dataset` pin state on the cluster.  The JAX
+multi-controller runtime has no per-worker recovery (a lost process ends the
+program), so the TPU-native recovery story is CHECKPOINTING: snapshot the
+catalog and re-hydrate a fresh Context after restart — the same pattern TPU
+training stacks use (orbax-style atomic save/restore) applied to SQL session
+state.
+
+Guarantees:
+- column-exact: every column round-trips with its SQL type, storage dtype
+  and validity mask intact (arrow arrays are written WITH masks; numeric
+  NULLs do not degrade to NaN values);
+- atomic: each save writes a fresh `snap-NNNNNN/` directory and then
+  atomically repoints the `CURRENT` file, so a crash mid-save leaves the
+  previous complete snapshot live; older snapshots are pruned on success;
+- name-safe: schema/table/model names are URL-quoted path components.
+
+NOT captured (recorded in the manifest under `not_restored` and warned at
+save time): views, registered UDFs/aggregations, and experiment objects —
+they hold live plan/callable objects; re-issue their DDL after restore.
+
+Layout under `location/`:
+    CURRENT                              name of the live snapshot dir
+    snap-NNNNNN/manifest.json            inventories + column specs
+    snap-NNNNNN/tables/<schema>/<table>.parquet
+    snap-NNNNNN/models/<schema>/<model>.pkl
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import pickle
+import shutil
+from dataclasses import replace as _dc_replace
+from typing import TYPE_CHECKING
+from urllib.parse import quote, unquote
+
+import jax.numpy as jnp
+import numpy as np
+
+if TYPE_CHECKING:  # pragma: no cover
+    from .context import Context
+
+logger = logging.getLogger(__name__)
+
+
+def _q(name: str) -> str:
+    return quote(name, safe="")
+
+
+# ----------------------------------------------------------------- columns
+def _write_table(table, path: str) -> list:
+    """Write a columnar Table as parquet with EXPLICIT validity masks.
+
+    Returns the per-column spec list for the manifest (sql_type + storage
+    dtype; arrow alone cannot represent e.g. TIMESTAMP-as-int64-ns or CHAR
+    vs VARCHAR)."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from .columnar.dtypes import STRING_TYPES
+
+    arrays, names, specs = [], [], []
+    for name, col in table.columns.items():
+        if col.sql_type in STRING_TYPES:
+            arrays.append(pa.array(col.to_numpy(), type=pa.string()))
+            specs.append({"name": name, "sql_type": col.sql_type.value,
+                          "storage": "string"})
+        else:
+            raw = np.asarray(col.data)
+            mask = None if col.validity is None else ~np.asarray(col.validity)
+            arrays.append(pa.array(raw, mask=mask))
+            specs.append({"name": name, "sql_type": col.sql_type.value,
+                          "storage": str(raw.dtype)})
+        names.append(name)
+    pq.write_table(pa.table(arrays, names=names), path)
+    return specs
+
+
+def _read_table(path: str, specs: list, num_rows: int):
+    """Inverse of _write_table: columns come back bit-exact."""
+    import pyarrow.parquet as pq
+
+    from .columnar.column import Column
+    from .columnar.dtypes import SqlType
+    from .columnar.table import Table
+
+    at = pq.read_table(path)
+    cols = {}
+    for spec in specs:
+        name = spec["name"]
+        sql_type = SqlType(spec["sql_type"])
+        arr = at.column(name).combine_chunks()
+        if spec["storage"] == "string":
+            col = Column.from_numpy(arr.to_numpy(zero_copy_only=False))
+            col = _dc_replace(col, sql_type=sql_type)
+        else:
+            import pyarrow as pa
+
+            dt = np.dtype(spec["storage"])
+            nulls = arr.is_null().to_numpy(zero_copy_only=False)
+            fill = False if pa.types.is_boolean(arr.type) else 0
+            vals = arr.fill_null(fill).to_numpy(
+                zero_copy_only=False).astype(dt)
+            validity = None if not nulls.any() else jnp.asarray(~nulls)
+            col = Column(jnp.asarray(vals), sql_type, validity)
+        cols[name] = col
+    return Table(cols, num_rows)
+
+
+# ------------------------------------------------------------------- save
+def save_state(context: "Context", location: str) -> dict:
+    """Write a restartable snapshot of every schema; returns the manifest."""
+    from .datacontainer import LazyParquetContainer
+
+    os.makedirs(location, exist_ok=True)
+    existing = sorted(d for d in os.listdir(location) if d.startswith("snap-"))
+    snap = f"snap-{(int(existing[-1][5:]) + 1) if existing else 1:06d}"
+    snap_dir = os.path.join(location, snap)
+
+    manifest = {"version": 2, "current_schema": context.schema_name,
+                "schemas": {}, "not_restored": {}}
+    for schema_name, container in context.schema.items():
+        os.makedirs(os.path.join(snap_dir, "tables", _q(schema_name)),
+                    exist_ok=True)
+        os.makedirs(os.path.join(snap_dir, "models", _q(schema_name)),
+                    exist_ok=True)
+        entry = {"tables": {}, "models": [], "statistics": {}}
+        for tname, dc in container.tables.items():
+            if isinstance(dc, LazyParquetContainer):
+                entry["tables"][tname] = {"kind": "parquet",
+                                          "path": dc.location}
+                continue
+            rel = os.path.join("tables", _q(schema_name),
+                               _q(tname) + ".parquet")
+            table = dc.assign()
+            specs = _write_table(table, os.path.join(snap_dir, rel))
+            entry["tables"][tname] = {"kind": "materialized", "file": rel,
+                                      "columns": specs,
+                                      "num_rows": table.num_rows}
+        for mname, (model, train_cols) in container.models.items():
+            rel = os.path.join("models", _q(schema_name), _q(mname) + ".pkl")
+            with open(os.path.join(snap_dir, rel), "wb") as f:
+                pickle.dump((model, train_cols), f)
+            entry["models"].append({"name": mname, "file": rel})
+        for tname, stats in container.statistics.items():
+            if stats is not None and stats.row_count is not None:
+                entry["statistics"][tname] = float(stats.row_count)
+        manifest["schemas"][schema_name] = entry
+        dropped = {}
+        if container.function_lists:
+            dropped["functions"] = sorted(container.function_lists)
+        if getattr(container, "experiments", None):
+            dropped["experiments"] = sorted(container.experiments)
+        views = context._views.get(schema_name)
+        if views:
+            dropped["views"] = sorted(views)
+        if dropped:
+            manifest["not_restored"][schema_name] = dropped
+            logger.warning(
+                "save_state: schema %r has live objects a snapshot cannot "
+                "carry (%s) — re-issue their DDL after load_state",
+                schema_name, ", ".join(sorted(dropped)))
+
+    with open(os.path.join(snap_dir, "manifest.json"), "w") as f:
+        json.dump(manifest, f, indent=1)
+    # atomic publish: CURRENT flips only after the snapshot is complete
+    tmp = os.path.join(location, f".CURRENT.tmp.{os.getpid()}")
+    with open(tmp, "w") as f:
+        f.write(snap)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, os.path.join(location, "CURRENT"))
+    for old in existing:
+        shutil.rmtree(os.path.join(location, old), ignore_errors=True)
+    return manifest
+
+
+# ------------------------------------------------------------------- load
+def load_state(context: "Context", location: str) -> dict:
+    """Re-hydrate the live snapshot under `location` into `context`."""
+    from .datacontainer import DataContainer, Statistics
+
+    with open(os.path.join(location, "CURRENT")) as f:
+        snap_dir = os.path.join(location, f.read().strip())
+    with open(os.path.join(snap_dir, "manifest.json")) as f:
+        manifest = json.load(f)
+
+    for schema_name, entry in manifest["schemas"].items():
+        if schema_name not in context.schema:
+            context.create_schema(schema_name)
+        for tname, spec in entry["tables"].items():
+            if spec["kind"] == "parquet":
+                context.create_table(tname, spec["path"],
+                                     schema_name=schema_name)
+            else:
+                table = _read_table(os.path.join(snap_dir, spec["file"]),
+                                    spec["columns"], spec["num_rows"])
+                context.schema[schema_name].tables[tname] = DataContainer(table)
+                context._views.get(schema_name, {}).pop(tname, None)
+        for m in entry["models"]:
+            with open(os.path.join(snap_dir, m["file"]), "rb") as f:
+                model, train_cols = pickle.load(f)
+            context.register_model(m["name"], model, train_cols,
+                                   schema_name=schema_name)
+        for tname, rows in entry.get("statistics", {}).items():
+            context.schema[schema_name].statistics[tname] = Statistics(rows)
+    context.schema_name = manifest.get("current_schema", context.schema_name)
+    return manifest

@@ -310,6 +310,22 @@ class Context:
         schema.functions[lower] = fd
         self._catalog_serial += 1
 
+    # ------------------------------------------------------------ checkpoint
+    def save_state(self, location: str) -> dict:
+        """Snapshot every schema (tables->parquet, models->pickle) so a new
+        process can `load_state` after a crash — the TPU-native recovery
+        story (SURVEY §5; the reference leans on dask worker recomputation,
+        which multi-controller JAX does not have)."""
+        from . import checkpoint
+
+        return checkpoint.save_state(self, location)
+
+    def load_state(self, location: str) -> dict:
+        """Re-hydrate a `save_state` snapshot into this Context."""
+        from . import checkpoint
+
+        return checkpoint.load_state(self, location)
+
     # ------------------------------------------------------------ models
     def register_model(self, model_name: str, model: Any,
                        training_columns: List[str],

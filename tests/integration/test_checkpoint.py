@@ -1,0 +1,139 @@
+"""Session checkpoint/restore (failure recovery, SURVEY §5): a fresh
+Context after `load_state` answers the same queries the crashed one did —
+including NULL/type fidelity for numeric columns and atomic snapshots."""
+import json
+import os
+
+import numpy as np
+import pandas as pd
+
+from dask_sql_tpu import Context
+
+
+def _manifest(loc):
+    cur = open(os.path.join(loc, "CURRENT")).read().strip()
+    return json.load(open(os.path.join(loc, cur, "manifest.json"))), cur
+
+
+def test_save_and_restore_roundtrip(tmp_path):
+    rng = np.random.RandomState(6)
+    df = pd.DataFrame({
+        "g": rng.choice(["a", "b", None], 500),
+        "v": rng.randn(500),
+        "d": pd.to_datetime("2022-03-01")
+        + pd.to_timedelta(rng.randint(0, 30, 500), "D"),
+    })
+    c1 = Context()
+    c1.create_table("t", df)
+    c1.create_schema("aux")
+    c1.create_table("u", pd.DataFrame({"k": [1, 2], "w": [0.5, 1.5]}),
+                    schema_name="aux")
+    from sklearn.linear_model import LinearRegression
+
+    m = LinearRegression().fit(df[["v"]].to_numpy(), np.arange(500))
+    c1.register_model("lm", m, ["v"])
+    q = ("SELECT g, COUNT(*) AS n, SUM(v) AS s FROM t "
+         "GROUP BY g ORDER BY g NULLS LAST")
+    before = c1.sql(q, return_futures=False)
+    c1.save_state(str(tmp_path / "snap"))
+
+    # "crash": brand-new Context, restore, re-ask
+    c2 = Context()
+    c2.load_state(str(tmp_path / "snap"))
+    after = c2.sql(q, return_futures=False)
+    assert list(before["g"].fillna("~")) == list(after["g"].fillna("~"))
+    np.testing.assert_allclose(before["s"], after["s"], rtol=1e-12)
+    assert list(before["n"]) == list(after["n"])
+    r = c2.sql("SELECT SUM(w) AS sw FROM aux.u", return_futures=False)
+    assert float(r["sw"][0]) == 2.0
+    p = c2.sql("SELECT * FROM PREDICT(MODEL lm, SELECT v FROM t LIMIT 3)",
+               return_futures=False)
+    assert "target" in p.columns and len(p) == 3
+
+
+def test_numeric_nulls_and_types_survive(tmp_path):
+    # the hard case: nullable BIGINT must come back as BIGINT with real
+    # NULLs (not DOUBLE with NaN values), nullable DOUBLE keeps NULL vs
+    # value distinction, DATE/TIMESTAMP keep their SQL type
+    df = pd.DataFrame({
+        "i": pd.array([1, None, 3, None, 5], dtype="Int64"),
+        "f": [1.5, np.nan, 2.5, 3.5, np.nan],
+        "b": [True, False, True, False, True],
+    })
+    c1 = Context()
+    c1.create_table("t", df)
+    before = c1.sql(
+        "SELECT COUNT(i) AS ci, COUNT(*) AS n, SUM(i) AS si, "
+        "SUM(CASE WHEN i IS NULL THEN 1 ELSE 0 END) AS nulls_i, "
+        "COUNT(f) AS cf FROM t", return_futures=False)
+    c1.save_state(str(tmp_path / "s"))
+    c2 = Context()
+    c2.load_state(str(tmp_path / "s"))
+    after = c2.sql(
+        "SELECT COUNT(i) AS ci, COUNT(*) AS n, SUM(i) AS si, "
+        "SUM(CASE WHEN i IS NULL THEN 1 ELSE 0 END) AS nulls_i, "
+        "COUNT(f) AS cf FROM t", return_futures=False)
+    assert list(before.iloc[0]) == list(after.iloc[0])
+    assert int(after["ci"][0]) == 3 and int(after["nulls_i"][0]) == 2
+    assert int(after["cf"][0]) == 3
+    # type fidelity via DESCRIBE
+    d1 = c1.sql("DESCRIBE t", return_futures=False)
+    d2 = c2.sql("DESCRIBE t", return_futures=False)
+    assert list(d1["Type"]) == list(d2["Type"])
+
+
+def test_atomic_snapshots_and_pruning(tmp_path):
+    loc = str(tmp_path / "s")
+    c = Context()
+    c.create_table("t", pd.DataFrame({"x": [1, 2]}))
+    c.save_state(loc)
+    m1, cur1 = _manifest(loc)
+    c.create_table("t", pd.DataFrame({"x": [10, 20, 30]}))
+    c.save_state(loc)
+    m2, cur2 = _manifest(loc)
+    assert cur1 != cur2
+    assert not os.path.exists(os.path.join(loc, cur1)), "old snapshot pruned"
+    c2 = Context()
+    c2.load_state(loc)
+    assert int(c2.sql("SELECT SUM(x) AS s FROM t",
+                      return_futures=False)["s"][0]) == 60
+
+
+def test_dotted_names_do_not_collide(tmp_path):
+    c = Context()
+    c.create_schema("a.b")
+    c.create_table("c", pd.DataFrame({"x": [1]}), schema_name="a.b")
+    c.create_schema("a")
+    c.create_table("b.c", pd.DataFrame({"x": [2]}), schema_name="a")
+    c.save_state(str(tmp_path / "s"))
+    c2 = Context()
+    c2.load_state(str(tmp_path / "s"))
+    one = c2.schema["a.b"].tables["c"].table.to_pandas()
+    two = c2.schema["a"].tables["b.c"].table.to_pandas()
+    assert list(one["x"]) == [1] and list(two["x"]) == [2]
+
+
+def test_views_reported_not_restored(tmp_path):
+    c = Context()
+    c.create_table("t", pd.DataFrame({"x": [1, 2]}))
+    c.sql("CREATE VIEW v AS SELECT x FROM t")
+    c.save_state(str(tmp_path / "s"))
+    m, _ = _manifest(str(tmp_path / "s"))
+    assert m["not_restored"]["root"]["views"] == ["v"]
+
+
+def test_lazy_parquet_tables_reregister_by_path(tmp_path):
+    df = pd.DataFrame({"x": np.arange(100), "y": np.arange(100) * 0.5})
+    pqpath = str(tmp_path / "data.parquet")
+    df.to_parquet(pqpath)
+    c1 = Context()
+    c1.create_table("lazy", pqpath, persist=False)
+    c1.save_state(str(tmp_path / "snap"))
+    m, _ = _manifest(str(tmp_path / "snap"))
+    spec = m["schemas"]["root"]["tables"]["lazy"]
+    assert spec["kind"] == "parquet" and spec["path"] == pqpath
+
+    c2 = Context()
+    c2.load_state(str(tmp_path / "snap"))
+    r = c2.sql("SELECT SUM(x) AS s FROM lazy", return_futures=False)
+    assert int(r["s"][0]) == int(df.x.sum())
