@@ -81,14 +81,14 @@ def _try_prune(join: p.Join, catalog, context, ratio):
                                context, side="right")
             if new_left is not None:
                 return p.Join(new_left, join.right, join.join_type, join.on,
-                              join.filter, join.schema)
+                              join.filter, join.schema, join.null_aware)
         if lrows / rrows <= (1 - ratio) and _has_filters(join.left) \
                 and isinstance(rkey, ColumnRef) and rscan is not None:
             new_right = _inject(join.right, rscan, rkey, join.left, lkey, nleft,
                                 context, side="left")
             if new_right is not None:
                 return p.Join(join.left, new_right, join.join_type, join.on,
-                              join.filter, join.schema)
+                              join.filter, join.schema, join.null_aware)
     return None
 
 

@@ -123,7 +123,8 @@ def _map_node_exprs(plan, fn):
     if isinstance(plan, p.Join):
         on = [(fn(l), fn(r)) for l, r in plan.on]
         filt = fn(plan.filter) if plan.filter is not None else None
-        return p.Join(plan.left, plan.right, plan.join_type, on, filt, plan.schema)
+        return p.Join(plan.left, plan.right, plan.join_type, on, filt,
+                      plan.schema, plan.null_aware)
     if isinstance(plan, p.Aggregate):
         return p.Aggregate(plan.input, [fn(e) for e in plan.group_exprs],
                            [fn(e) for e in plan.agg_exprs], plan.schema)
@@ -503,7 +504,8 @@ def _prune(plan, required: Set[int]) -> Tuple[p.LogicalPlan, Dict[int, int]]:
             fields_all = list(new_left.schema) + list(new_right.schema)
             fields = fields_all
             mapping = {old: cmap[old] for old in required}
-        j = p.Join(new_left, new_right, plan.join_type, on, filt, fields)
+        j = p.Join(new_left, new_right, plan.join_type, on, filt, fields,
+                   plan.null_aware)
         return j, mapping
 
     if isinstance(plan, p.CrossJoin):
@@ -834,15 +836,14 @@ class DecorrelateSubqueries(Rule):
         if core is None or corr_residuals:
             return None
         # NOT IN with nullable keys has 3VL semantics a plain anti-join
-        # breaks — leave those to direct evaluation
-        if anti and (pred.plan.schema[0].nullable or _nullable_expr(pred.arg)):
-            return None
-        if not pairs and anti is False and not _nullable_expr(pred.arg) \
-                and not pred.plan.schema[0].nullable:
-            pass  # uncorrelated IN -> semi join below
-        elif not pairs and anti is False:
-            pass  # semi join is still fine for IN (NULL arg rows simply drop,
-            #       matching WHERE semantics: NULL predicate filters out)
+        # breaks — rewrite to a *null-aware* anti join instead (the physical
+        # layer implements the empty-set / NULL-in-set / NULL-arg cases; the
+        # reference rewrites this shape in decorrelate_where_in.rs:267)
+        null_aware = anti and (pred.plan.schema[0].nullable
+                               or _nullable_expr(pred.arg))
+        # uncorrelated IN -> semi join below; nullable args need no special
+        # handling there (NULL arg rows simply drop, matching WHERE
+        # semantics: a NULL predicate filters out)
         nleft = len(child.schema)
         out_exprs = [proj_exprs[0]] + [inner for _, inner in pairs]
         fields = [Field(f"__ckey{i}", e.sql_type, True) for i, e in enumerate(out_exprs)]
@@ -853,7 +854,7 @@ class DecorrelateSubqueries(Rule):
                        ColumnRef(nleft + 1 + i, fields[1 + i].name,
                                  out_exprs[1 + i].sql_type, True)))
         jt = "LEFTANTI" if anti else "LEFTSEMI"
-        return p.Join(child, sub, jt, on, None, list(child.schema))
+        return p.Join(child, sub, jt, on, None, list(child.schema), null_aware)
 
 
 class _CannotDecorrelate(Exception):
@@ -1121,7 +1122,7 @@ class EliminateOuterJoin(Rule):
             if new_jt is None:
                 return node
             new_join = p.Join(join.left, join.right, new_jt, join.on,
-                              join.filter, join.schema)
+                              join.filter, join.schema, join.null_aware)
             return p.Filter(new_join, node.predicate, node.schema)
 
         return go(plan)

@@ -103,17 +103,23 @@ class Join(LogicalPlan):
     on: List[Tuple[Expr, Expr]]  # equi-join key pairs (left expr, right expr)
     filter: Optional[Expr]  # residual non-equi condition over combined schema
     schema: Schema
+    # LEFTANTI only: SQL `NOT IN` 3VL semantics (empty build side passes every
+    # probe row; any NULL build key passes none; NULL probe keys never pass).
+    # on[0] is the IN-arg pair, on[1:] are correlation pairs.
+    null_aware: bool = False
 
     def inputs(self):
         return [self.left, self.right]
 
     def with_inputs(self, inputs):
-        return Join(inputs[0], inputs[1], self.join_type, self.on, self.filter, self.schema)
+        return Join(inputs[0], inputs[1], self.join_type, self.on, self.filter,
+                    self.schema, self.null_aware)
 
     def _label(self):
         on = ", ".join(f"{l} = {r}" for l, r in self.on)
         resid = f" filter={self.filter}" if self.filter is not None else ""
-        return f"Join({self.join_type}): on [{on}]{resid}"
+        na = " null_aware" if self.null_aware else ""
+        return f"Join({self.join_type}{na}): on [{on}]{resid}"
 
 
 @dataclass(eq=False)

@@ -7,9 +7,11 @@
 // sqlast dataclasses the Python parser produces — so the two parsers are
 // drop-in interchangeable and differentially testable (AST equality).
 //
-// DDL/ML statements (CREATE MODEL, SHOW, ANALYZE, ...) return `unsupported`
-// and stay on the Python path; queries — the hot path through Context.sql —
-// parse natively.
+// Covers the FULL dialect: queries (SELECT core, set ops, CTEs, TABLESAMPLE,
+// GROUPING SETS/ROLLUP/CUBE) plus DDL/ML statements (CREATE MODEL/EXPERIMENT,
+// PREDICT, EXPORT, SHOW/DESCRIBE/ANALYZE/ALTER/USE) — see parse_statement below.
+// Anything genuinely outside the dialect returns `unsupported` and falls back
+// to the Python parser.
 //
 // Buffer ABI (version 1, little-endian):
 //   header: int32[7]  {magic, n_nodes, n_children, n_strings, str_bytes,
