@@ -53,7 +53,8 @@ PEER_CAPACITY_LADDER = (2048, 16384, 131072, 1 << 20, 1 << 23)
 
 #: test/observability hooks: counts of kernel executions this process
 STATS = {"agg_kernel": 0, "join_kernel": 0, "agg_fallback": 0,
-         "broadcast_join": 0, "sharded_join_agg": 0, "sort_kernel": 0}
+         "broadcast_join": 0, "broadcast_join_sorted": 0,
+         "sharded_join_agg": 0, "sort_kernel": 0}
 
 
 # ---------------------------------------------------------------------------
@@ -747,20 +748,23 @@ def _place_rows(arr: jnp.ndarray, mesh: Mesh, fill=0):
 def broadcast_inner_pairs(big_gid, big_valid, small_gid, small_valid):
     """Broadcast-join matching: the small side stays replicated, the big
     side is NEVER shuffled (parity: reference join.py:228-246 small-side
-    broadcast merge under `sql.join.broadcast`).
+    broadcast merge under `sql.join.broadcast` — which broadcasts ANY small
+    table, so this must too).
 
-    Builds a dense LUT over the (unique-key) small side and probes it with
-    the sharded big-side gids — a pure per-shard gather, no collectives.
-    The pair compaction happens on host after ONE read (multi-host safe:
+    Fast path: unique-dense-int small keys get a value-indexed LUT — one
+    scatter + gather at HBM bandwidth.  General path (string-keyed,
+    non-unique, sparse): sort the replicated small side once, probe with two
+    searchsorteds per shard — still no collectives, no big-side shuffle.
+    Pair compaction happens on host after one packed read (multi-host safe:
     the probe output is what the caller materializes anyway).  Returns
-    (big_idx, small_idx, big_matched) or None when the small side's keys
-    are not unique-dense ints (the all_to_all engine handles those)."""
+    (big_idx, small_idx, big_matched); never declines a small build side."""
     from ..ops.join import dense_unique_lut
 
     sv = None if bool(small_valid.all()) else small_valid
     prep = dense_unique_lut(small_gid, sv)
     if prep is None:
-        return None
+        return _broadcast_sorted_pairs(big_gid, big_valid,
+                                       small_gid, small_valid)
     rmin, lut = prep
     size = lut.shape[0]
     idx = big_gid.astype(I64) - rmin
@@ -772,6 +776,49 @@ def broadcast_inner_pairs(big_gid, big_valid, small_gid, small_valid):
     matched = cand_h >= 0
     bi = np.nonzero(matched)[0].astype(np.int64)
     si = cand_h[bi]
+    return jnp.asarray(bi), jnp.asarray(si), matched
+
+
+@jax.jit
+def _sorted_probe(big_gid, big_valid, small_gid, small_valid):
+    """Replicated-build probe for arbitrary keys: NULL build rows sort to
+    the end (valid-first lexsort, so no sentinel value can collide with a
+    real key — int64.max is a legal BIGINT) and the match range is clamped
+    to the valid prefix, so NULL rows can never match."""
+    sg = small_gid.astype(I64)
+    # primary: valid first; secondary: key — the valid prefix is key-sorted
+    order = jnp.lexsort((sg, ~small_valid))
+    n_valid = jnp.sum(small_valid.astype(jnp.int64))
+    iota = jnp.arange(sg.shape[0], dtype=jnp.int64)
+    # suffix (invalid rows) holds arbitrary key values after the gather —
+    # overwrite with +inf so the array is globally sorted for binary search
+    sg_sorted = jnp.where(iota < n_valid, sg[order],
+                          jnp.iinfo(jnp.int64).max)
+    bg = big_gid.astype(I64)
+    start = jnp.minimum(jnp.searchsorted(sg_sorted, bg, side="left"), n_valid)
+    end = jnp.minimum(jnp.searchsorted(sg_sorted, bg, side="right"), n_valid)
+    counts = jnp.where(big_valid, jnp.maximum(end - start, 0), 0)
+    return jnp.stack([start.astype(I64), counts.astype(I64)]), order
+
+
+def _broadcast_sorted_pairs(big_gid, big_valid, small_gid, small_valid):
+    ns = int(small_gid.shape[0])
+    nb = int(big_gid.shape[0])
+    STATS["broadcast_join"] += 1
+    STATS["broadcast_join_sorted"] += 1
+    if ns == 0 or nb == 0:
+        empty = jnp.zeros(0, dtype=I64)
+        return empty, empty, np.zeros(nb, dtype=bool)
+    packed, order = _sorted_probe(big_gid, big_valid, small_gid, small_valid)
+    packed_h = host_read(packed)  # one transfer for both per-row arrays
+    order_h = host_read(order)  # replicated small side: tiny
+    start_h, counts_h = packed_h[0], packed_h[1]
+    matched = counts_h > 0
+    total = int(counts_h.sum())
+    bi = np.repeat(np.arange(nb, dtype=np.int64), counts_h)
+    offsets = np.cumsum(counts_h) - counts_h
+    within = np.arange(total, dtype=np.int64) - np.repeat(offsets, counts_h)
+    si = order_h[np.repeat(start_h, counts_h) + within].astype(np.int64)
     return jnp.asarray(bi), jnp.asarray(si), matched
 
 

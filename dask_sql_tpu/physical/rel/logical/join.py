@@ -239,24 +239,16 @@ class JoinPlugin(BaseRelPlugin):
                         and small <= float(broadcast)))
         auto = broadcast is None and small <= 65536 and small * 4 <= big
         if explicit or auto:
+            # never declines: unique-dense keys take the LUT, everything
+            # else (string-keyed, duplicate, sparse) the sorted probe
             if right.num_rows <= left.num_rows:
-                got = dist_plan.broadcast_inner_pairs(lgid, lvalid,
-                                                      rgid, rvalid)
-                if got is not None:
-                    return got
-            else:
-                got = dist_plan.broadcast_inner_pairs(rgid, rvalid,
-                                                      lgid, lvalid)
-                if got is not None:
-                    ri, li, _rmatch = got
-                    lmatch = np.zeros(left.num_rows, dtype=bool)
-                    lmatch[np.asarray(li)] = True
-                    return li, ri, lmatch
-            if explicit:
-                # the knob promises no shuffle: when the LUT declines
-                # (non-unique/sparse keys) keep the local replicated probe
-                # rather than the all_to_all engine
-                return None
+                return dist_plan.broadcast_inner_pairs(lgid, lvalid,
+                                                       rgid, rvalid)
+            ri, li, _rmatch = dist_plan.broadcast_inner_pairs(
+                rgid, rvalid, lgid, lvalid)
+            lmatch = np.zeros(left.num_rows, dtype=bool)
+            lmatch[np.asarray(li)] = True
+            return li, ri, lmatch
         return dist_plan.dist_inner_pairs(mesh, lgid, lvalid, rgid, rvalid)
 
 
