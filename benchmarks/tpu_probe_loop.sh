@@ -1,0 +1,23 @@
+#!/bin/bash
+# Keep retrying the on-chip Q1 phase profile until the axon tunnel grants a
+# claim, then run the Q1 + Q3 benches on the chip.  Writes results under
+# benchmarks/out/.  Run as THE single TPU-claiming process (everything else
+# must use PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu).
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p benchmarks/out
+for i in $(seq 1 40); do
+    echo "[probe-loop] attempt $i $(date +%H:%M:%S)" >> benchmarks/out/probe_loop.log
+    timeout 1200 python benchmarks/profile_q1.py > benchmarks/out/profile_tpu.jsonl 2> benchmarks/out/profile_tpu.err
+    rc=$?
+    if [ $rc -eq 0 ] && grep -q rows_per_sec benchmarks/out/profile_tpu.jsonl; then
+        echo "[probe-loop] profile OK" >> benchmarks/out/probe_loop.log
+        timeout 1200 python bench.py > benchmarks/out/bench_tpu.json 2>> benchmarks/out/probe_loop.log
+        timeout 1200 python benchmarks/bench_q3.py > benchmarks/out/bench_q3_tpu.json 2>> benchmarks/out/probe_loop.log
+        echo "[probe-loop] done" >> benchmarks/out/probe_loop.log
+        exit 0
+    fi
+    sleep 60
+done
+echo "[probe-loop] gave up" >> benchmarks/out/probe_loop.log
+exit 1

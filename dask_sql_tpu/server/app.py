@@ -12,8 +12,11 @@ from __future__ import annotations
 import json
 import logging
 import threading
+import time
 import uuid
+from collections import deque
 from concurrent.futures import Future, ThreadPoolExecutor
+from dataclasses import dataclass
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional
 
@@ -22,28 +25,144 @@ from . import responses
 logger = logging.getLogger(__name__)
 
 
+@dataclass
+class _QueryEntry:
+    """Lifecycle of one submitted statement, for the stats/metrics surfaces."""
+
+    future: Future
+    submitted: float
+    started: Optional[float] = None
+    plan_done: Optional[float] = None
+    finished: Optional[float] = None
+    error: bool = False
+
+    def state(self) -> str:
+        if self.finished is not None:
+            return "FAILED" if self.error else "FINISHED"
+        return "QUEUED" if self.started is None else "RUNNING"
+
+    def queued_ms(self) -> int:
+        end = self.started if self.started is not None else time.monotonic()
+        return int((end - self.submitted) * 1000)
+
+    def elapsed_ms(self) -> int:
+        end = self.finished if self.finished is not None else time.monotonic()
+        return int((end - self.submitted) * 1000)
+
+
 class _QueryRegistry:
-    """Future registry (parity: the reference's app.future_list, app.py:20)."""
+    """Future registry (parity: the reference's app.future_list, app.py:20).
+
+    Queries run on a worker pool; the GIL drops during device execution, so
+    host-side parse/plan/decode of one query overlaps device compute of
+    another (the analogue of the reference's overlapping distributed
+    futures, reference server/app.py:89).  Tracks per-query lifecycle
+    timestamps + completed-latency aggregates for /v1/metrics."""
+
+    #: terminal entries retained for late status polls before eviction
+    KEEP_TERMINAL = 512
 
     def __init__(self, max_workers: int = 8):
         self.pool = ThreadPoolExecutor(max_workers=max_workers)
-        self.futures: Dict[str, Future] = {}
+        self.entries: Dict[str, _QueryEntry] = {}
         self.lock = threading.Lock()
+        self.max_workers = max_workers
+        self.completed = 0
+        self.failed = 0
+        self.cancelled = 0
+        self.n_queued = 0  # gauges, so /v1/metrics never scans the registry
+        self.n_running = 0
+        self.total_latency_s = 0.0
+        self.total_queued_s = 0.0
+        self._terminal: "deque[str]" = deque()
 
     def submit(self, fn) -> str:
         qid = str(uuid.uuid4())
+
+        def run():
+            with self.lock:
+                entry = self.entries.get(qid)
+                if entry is None:  # raced with a cancel that won
+                    return None
+                entry.started = time.monotonic()
+                self.n_queued -= 1
+                self.n_running += 1
+            try:
+                return fn(lambda: self._mark_planned(qid))
+            except Exception:
+                self._finish(qid, error=True)
+                raise
+            finally:
+                self._finish(qid, error=False)
+
         with self.lock:
-            self.futures[qid] = self.pool.submit(fn)
+            # entry registered before submit so run() always finds it
+            self.entries[qid] = _QueryEntry(future=None,  # type: ignore[arg-type]
+                                            submitted=time.monotonic())
+            self.n_queued += 1
+            self.entries[qid].future = self.pool.submit(run)
         return qid
 
-    def get(self, qid: str) -> Optional[Future]:
+    def _mark_planned(self, qid: str):
         with self.lock:
-            return self.futures.get(qid)
+            e = self.entries.get(qid)
+            if e is not None and e.plan_done is None:
+                e.plan_done = time.monotonic()
+
+    def _finish(self, qid: str, error: bool):
+        with self.lock:
+            e = self.entries.get(qid)
+            if e is None or e.finished is not None:
+                return
+            e.finished = time.monotonic()
+            self.n_running -= 1
+            if error:
+                e.error = True
+                self.failed += 1
+            else:
+                self.completed += 1
+            self.total_latency_s += e.finished - e.submitted
+            if e.started is not None:
+                self.total_queued_s += e.started - e.submitted
+            # retain for late polls, bounded: the Future pins the result frame
+            self._terminal.append(qid)
+            while len(self._terminal) > self.KEEP_TERMINAL:
+                self.entries.pop(self._terminal.popleft(), None)
+
+    def get(self, qid: str) -> Optional[_QueryEntry]:
+        with self.lock:
+            return self.entries.get(qid)
 
     def cancel(self, qid: str) -> bool:
         with self.lock:
-            fut = self.futures.pop(qid, None)
-        return fut.cancel() if fut is not None else False
+            entry = self.entries.get(qid)
+        if entry is None:
+            return False
+        ok = entry.future.cancel()
+        if ok:
+            # cancel() only succeeds before run() starts, so the entry is
+            # still QUEUED; a running query keeps its entry (and its status
+            # polls) — parity with concurrent.futures semantics
+            with self.lock:
+                if self.entries.pop(qid, None) is not None:
+                    self.cancelled += 1
+                    self.n_queued -= 1
+        return ok
+
+    def metrics(self) -> Dict[str, Any]:
+        """Queue-depth / latency snapshot (VERDICT r4 #8)."""
+        with self.lock:
+            done = self.completed + self.failed
+            return {
+                "workers": self.max_workers,
+                "queueDepth": self.n_queued,
+                "running": self.n_running,
+                "completed": self.completed,
+                "failed": self.failed,
+                "cancelled": self.cancelled,
+                "avgLatencyMillis": int(self.total_latency_s / done * 1000) if done else 0,
+                "avgQueuedMillis": int(self.total_queued_s / done * 1000) if done else 0,
+            }
 
 
 def _make_handler(context, registry: _QueryRegistry, jdbc_meta: bool):
@@ -81,8 +200,9 @@ def _make_handler(context, registry: _QueryRegistry, jdbc_meta: bool):
                 self._send(self._empty_results())
                 return
 
-            def run():
+            def run(mark_planned):
                 result = context.sql(sql)
+                mark_planned()  # parse/bind/optimize done; device work next
                 return result.compute() if result is not None else None
 
             qid = registry.submit(run)
@@ -108,31 +228,45 @@ def _make_handler(context, registry: _QueryRegistry, jdbc_meta: bool):
             if self.path.rstrip("/") == "/v1/empty":
                 self._send(self._empty_results())
                 return
+            if self.path.rstrip("/") == "/v1/metrics":
+                self._send(registry.metrics())
+                return
             self._send({"error": "unknown endpoint"}, 404)
 
         def _status(self, qid: str):
-            fut = registry.get(qid)
-            if fut is None:
+            entry = registry.get(qid)
+            if entry is None:
                 self._send({"error": f"unknown query {qid}"}, 404)
                 return
-            if not fut.done():
+            live_stats = {
+                "queuedTimeMillis": entry.queued_ms(),
+                "elapsedTimeMillis": entry.elapsed_ms(),
+            }
+            if not entry.future.done():
+                # never report a terminal state here: _finish() may have
+                # stamped the entry while the Future is still resolving, and
+                # a terminal state without data/error would strand the client
+                live_state = "QUEUED" if entry.started is None else "RUNNING"
                 self._send({
                     "id": qid,
                     "infoUri": f"{self._base()}/v1/info/{qid}",
                     "nextUri": f"{self._base()}/v1/statement/{qid}",
-                    "stats": {**responses.query_stats(), "state": "RUNNING"},
+                    "stats": {**responses.query_stats(), **live_stats,
+                              "state": live_state,
+                              "queued": live_state == "QUEUED",
+                              "progressPercentage": 0},
                     "warnings": [],
                 })
                 return
             try:
-                df = fut.result()
+                df = entry.future.result()
             except Exception as e:  # noqa: BLE001 - surfaced to the client
                 self._send(responses.error_results(qid, None, e))
                 return
             payload = {
                 "id": qid,
                 "infoUri": f"{self._base()}/v1/info/{qid}",
-                "stats": responses.query_stats(),
+                "stats": {**responses.query_stats(), **live_stats},
                 "warnings": [],
             }
             if df is not None:
