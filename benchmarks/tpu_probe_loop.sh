@@ -10,7 +10,8 @@ for i in $(seq 1 40); do
     echo "[probe-loop] attempt $i $(date +%H:%M:%S)" >> benchmarks/out/probe_loop.log
     timeout 1200 python benchmarks/profile_q1.py > benchmarks/out/profile_tpu.jsonl 2> benchmarks/out/profile_tpu.err
     rc=$?
-    if [ $rc -eq 0 ] && grep -q rows_per_sec benchmarks/out/profile_tpu.jsonl; then
+    if [ $rc -eq 0 ] && grep -q '"backend": "axon"' benchmarks/out/profile_tpu.jsonl \
+            && grep -q rows_per_sec benchmarks/out/profile_tpu.jsonl; then
         echo "[probe-loop] profile OK" >> benchmarks/out/probe_loop.log
         timeout 1200 python bench.py > benchmarks/out/bench_tpu.json 2>> benchmarks/out/probe_loop.log
         timeout 1200 python benchmarks/bench_q3.py > benchmarks/out/bench_q3_tpu.json 2>> benchmarks/out/probe_loop.log

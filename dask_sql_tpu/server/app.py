@@ -36,9 +36,9 @@ class _QueryEntry:
     finished: Optional[float] = None
     error: bool = False
 
-    def state(self) -> str:
-        if self.finished is not None:
-            return "FAILED" if self.error else "FINISHED"
+    def live_state(self) -> str:
+        """QUEUED/RUNNING only — terminal states must come from the Future
+        (a timestamped entry can be FINISHED before the Future resolves)."""
         return "QUEUED" if self.started is None else "RUNNING"
 
     def queued_ms(self) -> int:
@@ -246,7 +246,7 @@ def _make_handler(context, registry: _QueryRegistry, jdbc_meta: bool):
                 # never report a terminal state here: _finish() may have
                 # stamped the entry while the Future is still resolving, and
                 # a terminal state without data/error would strand the client
-                live_state = "QUEUED" if entry.started is None else "RUNNING"
+                live_state = entry.live_state()
                 self._send({
                     "id": qid,
                     "infoUri": f"{self._base()}/v1/info/{qid}",

@@ -136,7 +136,8 @@ def test_server_concurrent_queries_overlap(server):
             list(pool.map(run, range(2)))
 
     t_pair = min(_timed(pair) for _ in range(3))
-    assert t_pair < 2 * t_single + 0.1, (
+    # a fully serialized server lands at ~2.0x; require real overlap
+    assert t_pair < 1.8 * t_single + 0.1, (
         f"two concurrent queries took {t_pair:.3f}s vs single {t_single:.3f}s "
         "— no overlap between host work and device compute")
 
