@@ -31,6 +31,7 @@ DEFAULTS: Dict[str, Any] = {
     # TPU-native additions
     "sql.backend.default": "tpu",
     "sql.shuffle.num_buckets": None,  # None = number of devices
+    "sql.native.binder": "auto",  # C++ parse+bind (auto|on|off)
     "sql.compile": True,  # whole-pipeline jit for hot aggregation shapes
     "sql.compile.join": "auto",  # jit the shape-stable join probe phase
     "sql.compile.segsum": "auto",  # scatter | matmul | pallas segment sums

@@ -364,7 +364,8 @@ class Context:
                 # plan each statement right before running it: a later
                 # statement may read what an earlier one created
                 for stmt in statements:
-                    plan = self._get_ral(stmt)
+                    plan = self._get_ral(
+                        stmt, sql_text=sql if len(statements) == 1 else None)
                     plans.append(plan)
                     result = self._run_plan(plan, config_options)
                 # only single-statement texts are cacheable — a script's later
@@ -401,8 +402,9 @@ class Context:
             for df_name, df in dataframes.items():
                 self.create_table(df_name, df)
         with self.config.set(config_options or {}):
-            stmt = parse_sql(sql)[0]
-            plan = self._get_ral(stmt)
+            statements = parse_sql(sql)
+            plan = self._get_ral(
+                statements[0], sql_text=sql if len(statements) == 1 else None)
         if isinstance(plan, plan_nodes.Explain):
             plan = plan.input
         return plan.explain()
@@ -414,17 +416,26 @@ class Context:
             f.write(text)
 
     # ------------------------------------------------------------ internals
-    def _get_ral(self, stmt):
+    def _get_ral(self, stmt, sql_text: Optional[str] = None):
         """AST -> bound plan -> optimized plan (parity: context.py:819
-        _get_ral driving parse/bind/optimize in the Rust planner)."""
+        _get_ral driving parse/bind/optimize in the Rust planner).
+
+        When the statement's source text is available, the whole parse+bind
+        stage runs natively (native/binder.cpp, the analogue of the
+        reference's compiled SqlToRel, src/sql.rs:586-674); the Python
+        binder remains the fallback."""
         catalog = self._prepare_catalog()
         case_sensitive = bool(self.config.get("sql.identifier.case_sensitive", True))
         catalog.case_sensitive = case_sensitive
-        binder = Binder(catalog, case_sensitive=case_sensitive)
-        try:
+        plan = None
+        native_mode = str(self.config.get("sql.native.binder", "auto")).lower()
+        if sql_text is not None and native_mode in ("auto", "on", "true"):
+            from .planner.native_bridge import native_bind
+
+            plan = native_bind(sql_text, catalog)
+        if plan is None:
+            binder = Binder(catalog, case_sensitive=case_sensitive)
             plan = binder.bind_statement(stmt)
-        except BindError:
-            raise
         if self.config.get("sql.optimize", True):
             try:
                 plan = optimize_plan(plan, self.config, catalog, context=self)

@@ -158,13 +158,15 @@ def _get_parser_lib():
 class _FlatAst:
     __slots__ = ("nodes", "children", "strings", "root")
 
+    MAGIC = 0x44535131
+
     def __init__(self, buf: bytes):
         import struct
 
         magic, n_nodes, n_children, n_strings, str_bytes, root, _ = \
             struct.unpack_from("<7i", buf, 0)
-        if magic != 0x44535131:
-            raise ValueError("bad native AST magic")
+        if magic != self.MAGIC:
+            raise ValueError("bad native buffer magic")
         self.nodes = []
         off = 28
         for _ in range(n_nodes):
@@ -570,3 +572,481 @@ def _decode_statement(f: "_FlatAst", sid: int):
                                   _decode_kwargs(f, kids[1]),
                                   _decode_select(f, kids[2]), ine, orr)
     return None
+
+
+# ---------------------------------------------------------------------------
+# native binder (C++ binder.cpp) — catalog encode + flat plan buffer decode
+# ---------------------------------------------------------------------------
+_binder_checked = False
+_binder_ok = False
+
+# plan-buffer kinds (keep in sync with native/binder.cpp)
+_P_TABLESCAN = 1; _P_PROJECTION = 2; _P_FILTER = 3; _P_JOIN = 4
+_P_CROSSJOIN = 5; _P_AGGREGATE = 6; _P_WINDOW = 7; _P_SORT = 8; _P_LIMIT = 9
+_P_UNION = 10; _P_INTERSECT = 11; _P_EXCEPT = 12; _P_DISTINCT = 13
+_P_VALUES = 14; _P_EMPTY = 15; _P_SUBQUERY_ALIAS = 16; _P_SAMPLE = 17
+_P_DISTRIBUTE_BY = 18; _P_EXPLAIN = 19
+_P_CREATE_TABLE = 20; _P_CREATE_MEMORY_TABLE = 21; _P_DROP_TABLE = 22
+_P_CREATE_SCHEMA = 23; _P_DROP_SCHEMA = 24; _P_USE_SCHEMA = 25
+_P_ALTER_SCHEMA = 26; _P_ALTER_TABLE = 27; _P_SHOW_SCHEMAS = 28
+_P_SHOW_TABLES = 29; _P_SHOW_COLUMNS = 30; _P_SHOW_MODELS = 31
+_P_ANALYZE_TABLE = 32; _P_CREATE_MODEL = 33; _P_DROP_MODEL = 34
+_P_DESCRIBE_MODEL = 35; _P_EXPORT_MODEL = 36; _P_CREATE_EXPERIMENT = 37
+_P_PREDICT_MODEL = 38
+_P_FIELD = 50; _P_SORTKEY = 51; _P_ON_PAIR = 52; _P_VALUES_ROW = 53
+_P_PART = 54; _P_KWARGS = 55; _P_KV = 56; _P_KWLIST = 57; _P_WINSPEC = 58
+_P_FRAME_BOUND = 59
+_P_KW_STR = 60; _P_KW_INT = 61; _P_KW_FLOAT = 62; _P_KW_BOOL = 63
+_P_KW_NULL = 64
+_E_COLREF = 70; _E_LITERAL = 71; _E_SCALARFN = 72; _E_AGG = 73
+_E_WINDOW = 74; _E_CAST = 75; _E_CASE = 76; _E_INLIST = 77; _E_INSUBQ = 78
+_E_EXISTS = 79; _E_SCALARSUBQ = 80; _E_UDF = 81; _E_OUTERREF = 82
+_E_GROUPING = 83
+
+_LT_NULL = 0; _LT_BOOL = 1; _LT_INT = 2; _LT_FLOAT = 3; _LT_STR = 4
+
+_PLAN_FRAME_KINDS = ["UNBOUNDED_PRECEDING", "PRECEDING", "CURRENT_ROW",
+                     "FOLLOWING", "UNBOUNDED_FOLLOWING"]
+
+
+def _sql_type_ids():
+    from ..columnar.dtypes import SqlType
+
+    return list(SqlType)  # declaration order == C++ Ty enum order
+
+
+def _get_binder_lib():
+    global _binder_checked, _binder_ok
+    lib = get_lib()
+    if lib is None:
+        return None
+    if not _binder_checked:
+        _binder_checked = True
+        try:
+            lib.dsql_bind.restype = ctypes.c_int32
+            lib.dsql_bind.argtypes = [
+                ctypes.c_char_p, ctypes.c_int64,
+                ctypes.c_char_p, ctypes.c_int64,
+                ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+                ctypes.POINTER(ctypes.c_int64),
+            ]
+            lib.dsql_binder_abi_version.restype = ctypes.c_int32
+            _binder_ok = lib.dsql_binder_abi_version() == 1
+        except AttributeError:
+            _binder_ok = False
+    return lib if _binder_ok else None
+
+
+def encode_catalog(catalog) -> bytes:
+    """Serialize the planner catalog for dsql_bind (schemas/tables/columns +
+    UDF signatures; see native/binder.cpp Catalog::load for the layout)."""
+    import struct
+
+    type_ids = {t: i for i, t in enumerate(_sql_type_ids())}
+    out = bytearray()
+
+    def w32(v):
+        out.extend(struct.pack("<i", v))
+
+    def wstr(s):
+        raw = s.encode("utf-8")
+        w32(len(raw))
+        out.extend(raw)
+
+    w32(0x44535143)
+    w32(1 if catalog.case_sensitive else 0)
+    wstr(catalog.current_schema)
+    w32(len(catalog.schemas))
+    for sname, schema in catalog.schemas.items():
+        wstr(sname)
+        w32(len(schema.tables))
+        for tname, table in schema.tables.items():
+            wstr(tname)
+            w32(len(table.fields))
+            for f in table.fields:
+                wstr(f.name)
+                w32(type_ids[f.sql_type])
+                w32(1 if f.nullable else 0)
+        w32(len(schema.functions))
+        for fname, fds in schema.functions.items():
+            wstr(fname)
+            w32(len(fds))
+            for fd in fds:
+                wstr(fd.name)
+                w32(len(fd.parameters))
+                for _, pt in fd.parameters:
+                    w32(type_ids[pt])
+                w32(type_ids[fd.return_type])
+                w32(1 if fd.aggregation else 0)
+                w32(1 if fd.row_udf else 0)
+    return bytes(out)
+
+
+class _FlatPlan(_FlatAst):
+    """Same framing as the AST buffer, 'DSQB' magic."""
+
+    MAGIC = 0x44535142
+
+
+class _PlanDecoder:
+    def __init__(self, f: _FlatPlan):
+        self.f = f
+        self.types = _sql_type_ids()
+        self.plan_memo = {}  # node id -> plan object (preserves CTE sharing)
+
+    # -------- aux --------
+    def field(self, nid):
+        from .expressions import Field
+
+        _, flags, _, _, s0, _, _, _ = self.f.nodes[nid]
+        return Field(self.f.s(s0), self.types[flags >> 8], bool(flags & 1))
+
+    def fields(self, ids):
+        return [self.field(i) for i in ids]
+
+    def sortkey(self, nid):
+        from .expressions import SortKey
+
+        _, flags, _, _, _, _, _, _ = self.f.nodes[nid]
+        nulls_first = bool(flags & 4) if flags & 2 else None
+        return SortKey(self.expr(self.f.kids(nid)[0]), bool(flags & 1),
+                       nulls_first)
+
+    def winspec(self, nid):
+        from .expressions import WindowFrameBound, WindowSpec
+
+        _, flags, npart, _, s0, _, _, _ = self.f.nodes[nid]
+        kids = list(self.f.kids(nid))
+        end_b = kids.pop()
+        start_b = kids.pop()
+        partition = tuple(self.expr(k) for k in kids[:npart])
+        order = tuple(self.sortkey(k) for k in kids[npart:])
+
+        def bound(bid):
+            _, bflags, bival, bdval, _, _, _, _ = self.f.nodes[bid]
+            kind = _PLAN_FRAME_KINDS[bflags >> 4]
+            off = None
+            if bflags & 1:
+                off = bdval if bflags & 2 else bival
+            return WindowFrameBound(kind, off)
+
+        return WindowSpec(partition, order, self.f.s(s0), bound(start_b),
+                          bound(end_b), bool(flags & 1))
+
+    def kwvalue(self, nid):
+        kind, _, ival, dval, s0, _, _, _ = self.f.nodes[nid]
+        if kind == _P_KW_STR:
+            return self.f.s(s0)
+        if kind == _P_KW_INT:
+            return ival
+        if kind == _P_KW_FLOAT:
+            return dval
+        if kind == _P_KW_BOOL:
+            return bool(ival)
+        if kind == _P_KW_NULL:
+            return None
+        if kind == _P_KWLIST:
+            return [self.kwvalue(k) for k in self.f.kids(nid)]
+        if kind == _P_KWARGS:
+            return self.kwargs(nid)
+        raise ValueError(f"bad kw kind {kind}")
+
+    def kwargs(self, nid):
+        out = {}
+        for kv in self.f.kids(nid):
+            _, _, _, _, s0, _, _, _ = self.f.nodes[kv]
+            out[self.f.s(s0)] = self.kwvalue(self.f.kids(kv)[0])
+        return out
+
+    def parts(self, ids):
+        return [self.f.s(self.f.nodes[i][4]) for i in ids]
+
+    # -------- expressions --------
+    def expr(self, nid):
+        from ..columnar.dtypes import SqlType
+        from .binder import _OuterRef
+        from .expressions import (
+            AggExpr, CaseExpr, Cast, ColumnRef, ExistsExpr, GroupingExpr,
+            InListExpr, InSubqueryExpr, Literal, ScalarFunc,
+            ScalarSubqueryExpr, UdfExpr, WindowExpr,
+        )
+
+        kind, flags, ival, dval, s0, s1, _, _ = self.f.nodes[nid]
+        ty = self.types[flags >> 8]
+        kids = self.f.kids(nid)
+        if kind == _E_COLREF:
+            return ColumnRef(ival, self.f.s(s0), ty, bool(flags & 1))
+        if kind == _E_OUTERREF:
+            return _OuterRef(ival, self.f.s(s0), ty, bool(flags & 1))
+        if kind == _E_LITERAL:
+            tag = flags & 0xFF
+            if tag == _LT_NULL:
+                v = None
+            elif tag == _LT_BOOL:
+                v = bool(ival)
+            elif tag == _LT_INT:
+                v = ival
+            elif tag == _LT_FLOAT:
+                v = dval
+            else:
+                v = self.f.s(s0)
+            return Literal(v, ty)
+        if kind == _E_SCALARFN:
+            return ScalarFunc(self.f.s(s0),
+                              tuple(self.expr(k) for k in kids), ty)
+        if kind == _E_AGG:
+            has_filter = bool(flags & 2)
+            args = kids[:-1] if has_filter else kids
+            filt = self.expr(kids[-1]) if has_filter else None
+            return AggExpr(self.f.s(s0), tuple(self.expr(k) for k in args),
+                           ty, bool(flags & 1), filt)
+        if kind == _E_WINDOW:
+            spec = self.winspec(kids[-1])
+            return WindowExpr(self.f.s(s0),
+                              tuple(self.expr(k) for k in kids[:-1]), spec,
+                              ty, bool(flags & 1))
+        if kind == _E_CAST:
+            return Cast(self.expr(kids[0]), ty, bool(flags & 1))
+        if kind == _E_CASE:
+            has_else = bool(flags & 1)
+            body = kids[:-1] if has_else else kids
+            whens = tuple((self.expr(body[2 * i]), self.expr(body[2 * i + 1]))
+                          for i in range(len(body) // 2))
+            else_ = self.expr(kids[-1]) if has_else else None
+            return CaseExpr(whens, else_, ty)
+        if kind == _E_INLIST:
+            return InListExpr(self.expr(kids[0]),
+                              tuple(self.expr(k) for k in kids[1:]),
+                              bool(flags & 1))
+        if kind == _E_INSUBQ:
+            return InSubqueryExpr(self.expr(kids[0]), self.plan(kids[1]),
+                                  bool(flags & 1))
+        if kind == _E_EXISTS:
+            return ExistsExpr(self.plan(kids[0]), bool(flags & 1))
+        if kind == _E_SCALARSUBQ:
+            return ScalarSubqueryExpr(self.plan(kids[0]), ty)
+        if kind == _E_UDF:
+            return UdfExpr(self.f.s(s0), tuple(self.expr(k) for k in kids),
+                           ty, bool(flags & 1))
+        if kind == _E_GROUPING:
+            return GroupingExpr(tuple(self.expr(k) for k in kids),
+                                SqlType.INTEGER)
+        raise ValueError(f"bad expr kind {kind}")
+
+    # -------- plans --------
+    def plan(self, nid):
+        if nid in self.plan_memo:
+            return self.plan_memo[nid]
+        out = self._plan(nid)
+        self.plan_memo[nid] = out
+        return out
+
+    def _split(self, ids, kind):
+        """(of_kind, rest) preserving order."""
+        of_kind = [i for i in ids if self.f.nodes[i][0] == kind]
+        rest = [i for i in ids if self.f.nodes[i][0] != kind]
+        return of_kind, rest
+
+    def _plan(self, nid):
+        from . import plan as p
+
+        kind, flags, ival, dval, s0, s1, _, _ = self.f.nodes[nid]
+        kids = list(self.f.kids(nid))
+        F = self.f
+        if kind == _P_TABLESCAN:
+            return p.TableScan(F.s(s0), F.s(s1), self.fields(kids))
+        if kind == _P_PROJECTION:
+            nf = ival
+            return p.Projection(self.plan(kids[0]),
+                                [self.expr(k) for k in kids[1 + nf:]],
+                                self.fields(kids[1:1 + nf]))
+        if kind == _P_FILTER:
+            nf = ival
+            return p.Filter(self.plan(kids[0]), self.expr(kids[-1]),
+                            self.fields(kids[1:1 + nf]))
+        if kind == _P_JOIN:
+            nf = ival
+            has_resid = bool(flags & 1)
+            fields = self.fields(kids[2:2 + nf])
+            rest = kids[2 + nf:]
+            resid = self.expr(rest[-1]) if has_resid else None
+            pairs_ids = rest[:-1] if has_resid else rest
+            on = [(self.expr(F.kids(pi)[0]), self.expr(F.kids(pi)[1]))
+                  for pi in pairs_ids]
+            return p.Join(self.plan(kids[0]), self.plan(kids[1]), F.s(s0),
+                          on, resid, fields)
+        if kind == _P_CROSSJOIN:
+            return p.CrossJoin(self.plan(kids[0]), self.plan(kids[1]),
+                               self.fields(kids[2:]))
+        if kind == _P_AGGREGATE:
+            nf = ival
+            ngroups = flags
+            fields = self.fields(kids[1:1 + nf])
+            rest = kids[1 + nf:]
+            return p.Aggregate(self.plan(kids[0]),
+                               [self.expr(k) for k in rest[:ngroups]],
+                               [self.expr(k) for k in rest[ngroups:]], fields)
+        if kind == _P_WINDOW:
+            nf = ival
+            return p.Window(self.plan(kids[0]),
+                            [self.expr(k) for k in kids[1 + nf:]],
+                            self.fields(kids[1:1 + nf]))
+        if kind == _P_SORT:
+            nf = ival
+            return p.Sort(self.plan(kids[0]),
+                          [self.sortkey(k) for k in kids[1 + nf:]],
+                          self.fields(kids[1:1 + nf]))
+        if kind == _P_LIMIT:
+            fetch = ival if flags & 1 else None
+            skip = int(F.s(s0))
+            return p.Limit(self.plan(kids[0]), skip, fetch,
+                           self.fields(kids[1:]))
+        if kind == _P_UNION:
+            nf = ival
+            return p.Union([self.plan(k) for k in kids[nf:]], bool(flags & 1),
+                           self.fields(kids[:nf]))
+        if kind == _P_INTERSECT:
+            return p.Intersect(self.plan(kids[0]), self.plan(kids[1]),
+                               bool(flags & 1), self.fields(kids[2:]))
+        if kind == _P_EXCEPT:
+            return p.Except(self.plan(kids[0]), self.plan(kids[1]),
+                            bool(flags & 1), self.fields(kids[2:]))
+        if kind == _P_DISTINCT:
+            return p.Distinct(self.plan(kids[0]), self.fields(kids[1:]))
+        if kind == _P_VALUES:
+            nf = ival
+            rows = [[self.expr(c) for c in F.kids(r)] for r in kids[nf:]]
+            return p.Values(rows, self.fields(kids[:nf]))
+        if kind == _P_EMPTY:
+            return p.EmptyRelation(self.fields(kids), bool(flags & 1))
+        if kind == _P_SUBQUERY_ALIAS:
+            return p.SubqueryAlias(self.plan(kids[0]), F.s(s0),
+                                   self.fields(kids[1:]))
+        if kind == _P_SAMPLE:
+            seed = ival if flags & 1 else None
+            return p.Sample(self.plan(kids[0]), F.s(s0), dval, seed,
+                            self.fields(kids[1:]))
+        if kind == _P_DISTRIBUTE_BY:
+            nf = ival
+            return p.DistributeBy(self.plan(kids[0]),
+                                  [self.expr(k) for k in kids[1 + nf:]],
+                                  self.fields(kids[1:1 + nf]))
+        if kind == _P_EXPLAIN:
+            return p.Explain(self.plan(kids[0]), self.fields(kids[1:]),
+                             bool(flags & 1))
+        # ---- DDL / ML custom nodes ----
+        ine = bool(flags & 1)
+        orr = bool(flags & 2)
+        if kind == _P_CREATE_TABLE:
+            part_ids, rest = self._split(kids, _P_PART)
+            return p.CreateTableNode([], self.parts(part_ids),
+                                     self.kwargs(rest[0]), ine, orr)
+        if kind == _P_CREATE_MEMORY_TABLE:
+            nparts = ival
+            return p.CreateMemoryTableNode([], self.parts(kids[:nparts]),
+                                           self.plan(kids[nparts]),
+                                           bool(flags & 4), ine, orr)
+        if kind == _P_DROP_TABLE:
+            return p.DropTableNode([], self.parts(kids), bool(flags & 1))
+        if kind == _P_CREATE_SCHEMA:
+            return p.CreateSchemaNode([], F.s(s0), ine, orr)
+        if kind == _P_DROP_SCHEMA:
+            return p.DropSchemaNode([], F.s(s0), bool(flags & 1))
+        if kind == _P_USE_SCHEMA:
+            return p.UseSchemaNode([], F.s(s0))
+        if kind == _P_ALTER_SCHEMA:
+            return p.AlterSchemaNode([], F.s(s0), F.s(s1))
+        if kind == _P_ALTER_TABLE:
+            return p.AlterTableNode([], self.parts(kids), F.s(s0),
+                                    bool(flags & 1))
+        if kind == _P_SHOW_SCHEMAS:
+            like = F.s(s0) if flags & 1 else None
+            return p.ShowSchemasNode(self.fields(kids), like)
+        if kind == _P_SHOW_TABLES:
+            sc = F.s(s0) if flags & 1 else None
+            return p.ShowTablesNode(self.fields(kids), sc)
+        if kind == _P_SHOW_COLUMNS:
+            nf = ival
+            return p.ShowColumnsNode(self.fields(kids[:nf]),
+                                     self.parts(kids[nf:]))
+        if kind == _P_SHOW_MODELS:
+            sc = F.s(s0) if flags & 1 else None
+            return p.ShowModelsNode(self.fields(kids), sc)
+        if kind == _P_ANALYZE_TABLE:
+            table = [F.s(F.nodes[i][4]) for i in kids if F.nodes[i][1] == 0]
+            columns = [F.s(F.nodes[i][4]) for i in kids if F.nodes[i][1] == 1]
+            return p.AnalyzeTableNode([], table, columns)
+        if kind == _P_CREATE_MODEL:
+            nparts = ival
+            return p.CreateModelNode([], self.parts(kids[:nparts]),
+                                     self.kwargs(kids[nparts]),
+                                     self.plan(kids[nparts + 1]), ine, orr)
+        if kind == _P_DROP_MODEL:
+            return p.DropModelNode([], self.parts(kids), bool(flags & 1))
+        if kind == _P_DESCRIBE_MODEL:
+            nf = ival
+            return p.DescribeModelNode(self.fields(kids[:nf]),
+                                       self.parts(kids[nf:]))
+        if kind == _P_EXPORT_MODEL:
+            nparts = ival
+            return p.ExportModelNode([], self.parts(kids[:nparts]),
+                                     self.kwargs(kids[nparts]))
+        if kind == _P_CREATE_EXPERIMENT:
+            nparts = ival
+            return p.CreateExperimentNode([], self.parts(kids[:nparts]),
+                                          self.kwargs(kids[nparts]),
+                                          self.plan(kids[nparts + 1]), ine, orr)
+        if kind == _P_PREDICT_MODEL:
+            nf = ival
+            return p.PredictModelNode(self.fields(kids[1:1 + nf]),
+                                      self.parts(kids[1 + nf:]),
+                                      self.plan(kids[0]))
+        raise ValueError(f"bad plan kind {kind}")
+
+
+def native_bind(sql: str, catalog):
+    """Parse + bind via the C++ binder; returns a LogicalPlan, or None when
+    the native path is unavailable / declines (Python binder fallback).
+    Raises BindError for genuine bind errors and ParsingException for syntax
+    errors — same exception surface as the Python binder."""
+    lib = _get_binder_lib()
+    if lib is None:
+        return None
+    raw = sql.encode("utf-8")
+    try:
+        cat_buf = encode_catalog(catalog)
+    except KeyError:  # exotic type in a table/function signature
+        return None
+    out = ctypes.POINTER(ctypes.c_uint8)()
+    out_len = ctypes.c_int64()
+    rc = lib.dsql_bind(raw, len(raw), cat_buf, len(cat_buf),
+                       ctypes.byref(out), ctypes.byref(out_len))
+    if rc == 1:
+        return None
+    try:
+        buf = ctypes.string_at(out, out_len.value) if out_len.value else b""
+    finally:
+        if out:
+            lib.dsql_buf_free(out)
+    if rc == 2:
+        from .binder import BindError
+
+        msg = buf[1:].decode("utf-8", "replace")
+        if buf[:1] == b"\x01":  # missing table/schema: KeyError surface
+            raise KeyError(msg)
+        raise BindError(msg)
+    if rc == 3:
+        import struct
+
+        from .parser import ParsingException
+
+        pos = struct.unpack_from("<q", buf, 0)[0]
+        msg = buf[8:].decode("utf-8", "replace")
+        ctx = sql[max(0, pos - 30): pos + 30]
+        raise ParsingException(f"{msg} at position {pos} (near {ctx!r})")
+    try:
+        f = _FlatPlan(buf)
+        return _PlanDecoder(f).plan(f.root)
+    except Exception:  # noqa: BLE001 - corrupt buffer -> Python fallback
+        logger.debug("native plan decode failed", exc_info=True)
+        return None

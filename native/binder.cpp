@@ -1,0 +1,3176 @@
+// Native binder: AST -> typed LogicalPlan, in C++.
+//
+// Role parity: DataFusion's SqlToRel as driven by the reference
+// (src/sql.rs:586-674 logical_relational_algebra / statement_to_plan) —
+// the reference's entire bind stage is compiled code; this file migrates
+// dask_sql_tpu/planner/binder.py (same semantics, differentially tested
+// for bound-plan equality over the TPC-H/TPC-DS corpora by
+// tests/unit/test_native_binder.py).
+//
+// Layering: dsql_bind() calls the native parser (dsql_parse, parser.cpp)
+// for the flat AST buffer, decodes the catalog buffer the Python side
+// serializes (schemas/tables/columns/UDF signatures), binds, and emits a
+// flat *plan* buffer that planner/native_bridge.py decodes into the same
+// plan.py/expressions.py dataclasses the Python binder produces.
+//
+// Plan-buffer ABI (binder version 1, little-endian): identical framing to
+// the parser buffer (header int32[7] {magic 'DSQB', n_nodes, n_children,
+// n_strings, str_bytes, root, 0}; 40B nodes {kind, flags, ival, dval, s0,
+// s1, child_off, nchild}; children; string table).  Node kinds are the
+// P_* / E_* enums below; see native_bridge._decode_plan for the decoder.
+
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <functional>
+#include <map>
+#include <memory>
+#include <optional>
+#include <set>
+#include <string>
+#include <vector>
+
+extern "C" int32_t dsql_parse(const char* sql, int64_t n, uint8_t** out,
+                              int64_t* out_len);
+extern "C" void dsql_buf_free(uint8_t* p);
+
+namespace {
+
+constexpr int32_t PLAN_MAGIC = 0x44535142;  // "DSQB"
+constexpr int32_t AST_MAGIC = 0x44535131;   // "DSQ1" (parser buffer)
+
+// ---------------------------------------------------------------------------
+// SQL types: ids = declaration order of columnar/dtypes.py SqlType
+// ---------------------------------------------------------------------------
+enum Ty : int32_t {
+  TY_NULL = 0, TY_BOOLEAN, TY_TINYINT, TY_SMALLINT, TY_INTEGER, TY_BIGINT,
+  TY_FLOAT, TY_REAL, TY_DOUBLE, TY_DECIMAL, TY_VARCHAR, TY_CHAR, TY_DATE,
+  TY_TIME, TY_TIMESTAMP, TY_TIMESTAMP_TZ, TY_INTERVAL_DAY_TIME,
+  TY_INTERVAL_YEAR_MONTH, TY_BINARY, TY_VARBINARY, TY_ANY,
+};
+
+const char* TY_NAMES[] = {
+    "NULL", "BOOLEAN", "TINYINT", "SMALLINT", "INTEGER", "BIGINT", "FLOAT",
+    "REAL", "DOUBLE", "DECIMAL", "VARCHAR", "CHAR", "DATE", "TIME",
+    "TIMESTAMP", "TIMESTAMP_WITH_LOCAL_TIME_ZONE", "INTERVAL_DAY_TIME",
+    "INTERVAL_YEAR_MONTH", "BINARY", "VARBINARY", "ANY"};
+
+bool is_string(int t) { return t == TY_VARCHAR || t == TY_CHAR; }
+bool is_datetime(int t) {
+  return t == TY_DATE || t == TY_TIME || t == TY_TIMESTAMP || t == TY_TIMESTAMP_TZ;
+}
+bool is_interval(int t) {
+  return t == TY_INTERVAL_DAY_TIME || t == TY_INTERVAL_YEAR_MONTH;
+}
+bool is_integer(int t) {
+  return t == TY_TINYINT || t == TY_SMALLINT || t == TY_INTEGER || t == TY_BIGINT;
+}
+bool is_float(int t) {
+  return t == TY_FLOAT || t == TY_REAL || t == TY_DOUBLE || t == TY_DECIMAL;
+}
+bool is_numeric(int t) { return is_integer(t) || is_float(t); }
+
+struct BindErr {
+  std::string msg;
+  int klass = 0;  // 0 = BindError, 1 = KeyError (missing table/schema)
+};
+struct Unsupported {};  // -> rc 1, Python binder fallback
+
+[[noreturn]] void bind_error(const std::string& msg) { throw BindErr{msg, 0}; }
+[[noreturn]] void key_error(const std::string& msg) { throw BindErr{msg, 1}; }
+
+// promotion lattice (dtypes.promote parity)
+int promo_rank(int t) {
+  switch (t) {
+    case TY_BOOLEAN: return 0;
+    case TY_TINYINT: return 1;
+    case TY_SMALLINT: return 2;
+    case TY_INTEGER: return 3;
+    case TY_BIGINT: return 4;
+    case TY_FLOAT: return 5;
+    case TY_REAL: return 6;
+    case TY_DOUBLE: return 7;
+    case TY_DECIMAL: return 8;
+    default: return -1;
+  }
+}
+
+int promote(int a, int b) {
+  if (a == b) return a;
+  if (a == TY_NULL) return b;
+  if (b == TY_NULL) return a;
+  if (is_string(a) && is_string(b)) return TY_VARCHAR;
+  if (is_datetime(a) && is_datetime(b)) return TY_TIMESTAMP;
+  if (is_datetime(a) && is_interval(b)) return a;
+  if (is_datetime(b) && is_interval(a)) return b;
+  int ra = promo_rank(a), rb = promo_rank(b);
+  if (ra >= 0 && rb >= 0) {
+    int hi = ra >= rb ? a : b;
+    int lo = ra >= rb ? b : a;
+    if ((hi == TY_FLOAT || hi == TY_REAL) && (lo == TY_INTEGER || lo == TY_BIGINT))
+      return TY_DOUBLE;
+    return hi;
+  }
+  if (is_datetime(a) && is_numeric(b)) return a;
+  if (is_datetime(b) && is_numeric(a)) return b;
+  bind_error(std::string("Cannot promote ") + TY_NAMES[a] + " and " + TY_NAMES[b]);
+}
+
+bool similar_type(int a, int b) {
+  if (is_integer(a) && is_integer(b)) return true;
+  if (is_float(a) && is_float(b)) return true;
+  if (is_string(a) && is_string(b)) return true;
+  if (is_datetime(a) && is_datetime(b)) return true;
+  if (is_interval(a) && is_interval(b)) return true;
+  if (a == TY_BOOLEAN && b == TY_BOOLEAN) return true;
+  return a == b;
+}
+
+std::string upper(const std::string& s) {
+  std::string u = s;
+  for (auto& c : u)
+    if (c >= 'a' && c <= 'z') c -= 32;
+  return u;
+}
+std::string lower(const std::string& s) {
+  std::string u = s;
+  for (auto& c : u)
+    if (c >= 'A' && c <= 'Z') c += 32;
+  return u;
+}
+
+int parse_sql_type(const std::string& raw) {
+  // dtypes.parse_sql_type parity (CAST target names + aliases)
+  std::string name = upper(raw);
+  // strip leading/trailing space
+  while (!name.empty() && name.front() == ' ') name.erase(name.begin());
+  while (!name.empty() && name.back() == ' ') name.pop_back();
+  std::string base = name.substr(0, name.find('('));
+  while (!base.empty() && base.back() == ' ') base.pop_back();
+  static const std::map<std::string, int> aliases = {
+      {"INT", TY_INTEGER}, {"INT2", TY_SMALLINT}, {"INT4", TY_INTEGER},
+      {"INT8", TY_BIGINT}, {"LONG", TY_BIGINT}, {"STRING", TY_VARCHAR},
+      {"TEXT", TY_VARCHAR}, {"BOOL", TY_BOOLEAN}, {"NUMERIC", TY_DECIMAL},
+      {"FLOAT4", TY_FLOAT}, {"FLOAT8", TY_DOUBLE},
+      {"DOUBLE PRECISION", TY_DOUBLE},
+      {"TIMESTAMP WITHOUT TIME ZONE", TY_TIMESTAMP},
+      {"TIMESTAMP WITH TIME ZONE", TY_TIMESTAMP_TZ},
+      {"DATETIME", TY_TIMESTAMP}};
+  auto it = aliases.find(base);
+  if (it != aliases.end()) return it->second;
+  std::string key = base;
+  for (auto& c : key)
+    if (c == ' ') c = '_';
+  for (int i = 0; i <= TY_ANY; ++i)
+    if (key == TY_NAMES[i]) return i;
+  throw Unsupported{};  // Python raises NotImplementedError -> fallback
+}
+
+// ---------------------------------------------------------------------------
+// built-in function tables (planner/functions.py parity)
+// ---------------------------------------------------------------------------
+// result rules: 0 double, 1 bigint, 2 integer, 3 boolean, 4 string,
+// 5 timestamp, 6 interval, 7 arg0, 8 promote, 9 sum
+enum Rule { R_DOUBLE, R_BIGINT, R_INT, R_BOOL, R_STRING, R_TS, R_IV, R_ARG0, R_PROMOTE, R_SUM };
+
+struct ScalarSig {
+  const char* op;
+  int rule;
+  int lo;
+  int hi;
+};
+
+const std::map<std::string, ScalarSig>& scalar_functions() {
+  static const std::map<std::string, ScalarSig> m = {
+      {"ABS", {"abs", R_ARG0, 1, 1}}, {"ACOS", {"acos", R_DOUBLE, 1, 1}},
+      {"ASIN", {"asin", R_DOUBLE, 1, 1}}, {"ATAN", {"atan", R_DOUBLE, 1, 1}},
+      {"ATAN2", {"atan2", R_DOUBLE, 2, 2}}, {"CBRT", {"cbrt", R_DOUBLE, 1, 1}},
+      {"CEIL", {"ceil", R_ARG0, 1, 1}}, {"CEILING", {"ceil", R_ARG0, 1, 1}},
+      {"COS", {"cos", R_DOUBLE, 1, 1}}, {"COT", {"cot", R_DOUBLE, 1, 1}},
+      {"DEGREES", {"degrees", R_DOUBLE, 1, 1}}, {"EXP", {"exp", R_DOUBLE, 1, 1}},
+      {"FLOOR", {"floor", R_ARG0, 1, 1}}, {"LN", {"ln", R_DOUBLE, 1, 1}},
+      {"LOG", {"log", R_DOUBLE, 1, 2}}, {"LOG10", {"log10", R_DOUBLE, 1, 1}},
+      {"LOG2", {"log2", R_DOUBLE, 1, 1}}, {"POWER", {"power", R_DOUBLE, 2, 2}},
+      {"POW", {"power", R_DOUBLE, 2, 2}}, {"RADIANS", {"radians", R_DOUBLE, 1, 1}},
+      {"ROUND", {"round", R_ARG0, 1, 2}}, {"SIGN", {"sign", R_ARG0, 1, 1}},
+      {"SIN", {"sin", R_DOUBLE, 1, 1}}, {"SQRT", {"sqrt", R_DOUBLE, 1, 1}},
+      {"TAN", {"tan", R_DOUBLE, 1, 1}}, {"TRUNCATE", {"truncate", R_ARG0, 1, 2}},
+      {"TRUNC", {"truncate", R_ARG0, 1, 2}}, {"MOD", {"mod", R_PROMOTE, 2, 2}},
+      {"RAND", {"rand", R_DOUBLE, 0, 1}}, {"RANDOM", {"rand", R_DOUBLE, 0, 1}},
+      {"RAND_INTEGER", {"rand_integer", R_INT, 1, 2}}, {"PI", {"pi", R_DOUBLE, 0, 0}},
+      {"CHAR_LENGTH", {"char_length", R_BIGINT, 1, 1}},
+      {"CHARACTER_LENGTH", {"char_length", R_BIGINT, 1, 1}},
+      {"LENGTH", {"char_length", R_BIGINT, 1, 1}},
+      {"UPPER", {"upper", R_STRING, 1, 1}}, {"LOWER", {"lower", R_STRING, 1, 1}},
+      {"CONCAT", {"concat", R_STRING, 1, 99}},
+      {"INITCAP", {"initcap", R_STRING, 1, 1}},
+      {"REPLACE", {"replace", R_STRING, 3, 3}},
+      {"REVERSE", {"reverse", R_STRING, 1, 1}},
+      {"LEFT", {"left", R_STRING, 2, 2}}, {"RIGHT", {"right", R_STRING, 2, 2}},
+      {"REPEAT", {"repeat_str", R_STRING, 2, 2}},
+      {"LPAD", {"lpad", R_STRING, 2, 3}}, {"RPAD", {"rpad", R_STRING, 2, 3}},
+      {"ASCII", {"ascii", R_INT, 1, 1}}, {"CHR", {"chr", R_STRING, 1, 1}},
+      {"STRPOS", {"position", R_INT, 2, 2}},
+      {"SPLIT_PART", {"split_part", R_STRING, 3, 3}},
+      {"SUBSTR", {"substring", R_STRING, 2, 3}},
+      {"SUBSTRING", {"substring", R_STRING, 2, 3}},
+      {"BTRIM", {"btrim", R_STRING, 1, 2}}, {"LTRIM", {"ltrim", R_STRING, 1, 2}},
+      {"RTRIM", {"rtrim", R_STRING, 1, 2}}, {"TRIM", {"btrim", R_STRING, 1, 2}},
+      {"COALESCE", {"coalesce", R_PROMOTE, 1, 99}},
+      {"NULLIF", {"nullif", R_ARG0, 2, 2}},
+      {"NVL", {"coalesce", R_PROMOTE, 2, 2}},
+      {"IFNULL", {"coalesce", R_PROMOTE, 2, 2}},
+      {"GREATEST", {"greatest", R_PROMOTE, 1, 99}},
+      {"LEAST", {"least", R_PROMOTE, 1, 99}},
+      {"YEAR", {"extract_year", R_BIGINT, 1, 1}},
+      {"MONTH", {"extract_month", R_BIGINT, 1, 1}},
+      {"DAY", {"extract_day", R_BIGINT, 1, 1}},
+      {"HOUR", {"extract_hour", R_BIGINT, 1, 1}},
+      {"MINUTE", {"extract_minute", R_BIGINT, 1, 1}},
+      {"SECOND", {"extract_second", R_BIGINT, 1, 1}},
+      {"QUARTER", {"extract_quarter", R_BIGINT, 1, 1}},
+      {"DAYOFWEEK", {"extract_dow", R_BIGINT, 1, 1}},
+      {"DAYOFYEAR", {"extract_doy", R_BIGINT, 1, 1}},
+      {"WEEK", {"extract_week", R_BIGINT, 1, 1}},
+      {"LAST_DAY", {"last_day", R_TS, 1, 1}},
+      {"TO_TIMESTAMP", {"to_timestamp", R_TS, 1, 2}},
+      {"DSQL_TOTIMESTAMP", {"to_timestamp", R_TS, 1, 2}},
+      {"TIMESTAMPADD", {"timestampadd", R_TS, 3, 3}},
+      {"TIMESTAMPDIFF", {"timestampdiff", R_BIGINT, 3, 3}},
+      {"DATEDIFF", {"timestampdiff", R_BIGINT, 3, 3}},
+      {"DATE_TRUNC", {"date_trunc", R_TS, 2, 2}},
+      {"CURRENT_TIMESTAMP", {"current_timestamp", R_TS, 0, 0}},
+      {"CURRENT_DATE", {"current_date", R_TS, 0, 0}},
+      {"NOW", {"current_timestamp", R_TS, 0, 0}},
+      {"MD5", {"md5", R_STRING, 1, 1}},
+      {"HASH", {"hash64", R_BIGINT, 1, 99}},
+  };
+  return m;
+}
+
+struct AggSig {
+  const char* op;
+  int rule;
+};
+
+const std::map<std::string, AggSig>& aggregate_functions() {
+  static const std::map<std::string, AggSig> m = {
+      {"SUM", {"sum", R_SUM}}, {"MIN", {"min", R_ARG0}}, {"MAX", {"max", R_ARG0}},
+      {"COUNT", {"count", R_BIGINT}}, {"AVG", {"avg", R_DOUBLE}},
+      {"MEAN", {"avg", R_DOUBLE}}, {"STDDEV", {"stddev_samp", R_DOUBLE}},
+      {"STDDEV_SAMP", {"stddev_samp", R_DOUBLE}},
+      {"STDDEV_POP", {"stddev_pop", R_DOUBLE}},
+      {"VARIANCE", {"var_samp", R_DOUBLE}}, {"VAR_SAMP", {"var_samp", R_DOUBLE}},
+      {"VAR_POP", {"var_pop", R_DOUBLE}}, {"BIT_AND", {"bit_and", R_ARG0}},
+      {"BIT_OR", {"bit_or", R_ARG0}}, {"BIT_XOR", {"bit_xor", R_ARG0}},
+      {"EVERY", {"every", R_BOOL}}, {"BOOL_AND", {"every", R_BOOL}},
+      {"BOOL_OR", {"bool_or", R_BOOL}}, {"ANY_VALUE", {"single_value", R_ARG0}},
+      {"SINGLE_VALUE", {"single_value", R_ARG0}},
+      {"FIRST_VALUE", {"first_value", R_ARG0}},
+      {"LAST_VALUE", {"last_value", R_ARG0}},
+      {"REGR_COUNT", {"regr_count", R_BIGINT}},
+      {"REGR_SXX", {"regr_sxx", R_DOUBLE}}, {"REGR_SYY", {"regr_syy", R_DOUBLE}},
+      {"APPROX_COUNT_DISTINCT", {"approx_count_distinct", R_BIGINT}},
+      {"MEDIAN", {"percentile", R_DOUBLE}},
+      {"APPROX_PERCENTILE", {"percentile", R_DOUBLE}},
+      {"PERCENTILE_CONT", {"percentile", R_DOUBLE}},
+      {"QUANTILE", {"percentile", R_DOUBLE}},
+  };
+  return m;
+}
+
+const std::map<std::string, int>& window_functions() {
+  static const std::map<std::string, int> m = {
+      {"ROW_NUMBER", R_BIGINT}, {"RANK", R_BIGINT}, {"DENSE_RANK", R_BIGINT},
+      {"PERCENT_RANK", R_DOUBLE}, {"CUME_DIST", R_DOUBLE}, {"NTILE", R_BIGINT},
+      {"LAG", R_ARG0}, {"LEAD", R_ARG0}, {"NTH_VALUE", R_ARG0},
+  };
+  return m;
+}
+
+int resolve_type(int rule, const std::vector<int>& arg_types) {
+  switch (rule) {
+    case R_DOUBLE: return TY_DOUBLE;
+    case R_BIGINT: return TY_BIGINT;
+    case R_INT: return TY_INTEGER;
+    case R_BOOL: return TY_BOOLEAN;
+    case R_STRING: return TY_VARCHAR;
+    case R_TS: return TY_TIMESTAMP;
+    case R_IV: return TY_INTERVAL_DAY_TIME;
+    case R_ARG0: return arg_types.empty() ? TY_DOUBLE : arg_types[0];
+    case R_PROMOTE: {
+      int t = arg_types[0];
+      for (size_t i = 1; i < arg_types.size(); ++i) t = promote(t, arg_types[i]);
+      return t;
+    }
+    case R_SUM: {
+      int t = arg_types[0];
+      if (is_integer(t)) return TY_BIGINT;
+      if (is_float(t)) return t == TY_DECIMAL ? TY_DOUBLE : t;
+      return t;
+    }
+  }
+  bind_error("bad type rule");
+}
+
+// ---------------------------------------------------------------------------
+// datetime / interval literal parsing (binder._bind_literal/_bind_interval)
+// ---------------------------------------------------------------------------
+// days since 1970-01-01 for a civil date (Hinnant's algorithm)
+int64_t days_from_civil(int64_t y, int m, int d) {
+  y -= m <= 2;
+  int64_t era = (y >= 0 ? y : y - 399) / 400;
+  int64_t yoe = y - era * 400;
+  int64_t doy = (153 * (m + (m > 2 ? -3 : 9)) + 2) / 5 + d - 1;
+  int64_t doe = yoe * 365 + yoe / 4 - yoe / 100 + doy;
+  return era * 146097 + doe - 719468;
+}
+
+// "YYYY-MM-DD[ HH:MM[:SS[.frac]]]" -> epoch nanoseconds; throws BindErr
+int64_t parse_datetime_ns(const std::string& raw) {
+  std::string s = raw;
+  while (!s.empty() && s.front() == ' ') s.erase(s.begin());
+  while (!s.empty() && s.back() == ' ') s.pop_back();
+  const char* p = s.c_str();
+  auto read_int = [&](int n_min, int n_max, int64_t* out) -> bool {
+    int64_t v = 0;
+    int n = 0;
+    while (*p >= '0' && *p <= '9' && n < n_max) {
+      v = v * 10 + (*p - '0');
+      ++p;
+      ++n;
+    }
+    if (n < n_min) return false;
+    *out = v;
+    return true;
+  };
+  int64_t y, mo, d;
+  bool neg = false;
+  if (*p == '-') {
+    neg = true;
+    ++p;
+  }
+  if (!read_int(1, 6, &y) || *p != '-') bind_error("Cannot bind literal '" + raw + "'");
+  ++p;
+  if (neg) y = -y;
+  if (!read_int(1, 2, &mo) || *p != '-') bind_error("Cannot bind literal '" + raw + "'");
+  ++p;
+  if (!read_int(1, 2, &d)) bind_error("Cannot bind literal '" + raw + "'");
+  int64_t ns = days_from_civil(y, (int)mo, (int)d) * 86400000000000LL;
+  if (*p == ' ' || *p == 'T') {
+    ++p;
+    int64_t hh, mi, ss = 0;
+    if (!read_int(1, 2, &hh) || *p != ':') bind_error("Cannot bind literal '" + raw + "'");
+    ++p;
+    if (!read_int(1, 2, &mi)) bind_error("Cannot bind literal '" + raw + "'");
+    if (*p == ':') {
+      ++p;
+      if (!read_int(1, 2, &ss)) bind_error("Cannot bind literal '" + raw + "'");
+    }
+    ns += (hh * 3600 + mi * 60 + ss) * 1000000000LL;
+    if (*p == '.') {
+      ++p;
+      int64_t frac = 0;
+      int n = 0;
+      while (*p >= '0' && *p <= '9' && n < 9) {
+        frac = frac * 10 + (*p - '0');
+        ++p;
+        ++n;
+      }
+      while (*p >= '0' && *p <= '9') ++p;  // truncate past ns
+      for (; n < 9; ++n) frac *= 10;
+      ns += frac;
+    }
+  }
+  if (*p != '\0') bind_error("Cannot bind literal '" + raw + "'");
+  return ns;
+}
+
+const std::map<std::string, int64_t>& interval_ns_units() {
+  static const std::map<std::string, int64_t> m = {
+      {"NANOSECOND", 1},
+      {"MICROSECOND", 1000},
+      {"MILLISECOND", 1000000},
+      {"SECOND", 1000000000},
+      {"MINUTE", 60LL * 1000000000},
+      {"HOUR", 3600LL * 1000000000},
+      {"DAY", 86400LL * 1000000000},
+      {"WEEK", 7LL * 86400 * 1000000000},
+  };
+  return m;
+}
+
+const std::map<std::string, int64_t>& interval_month_units() {
+  static const std::map<std::string, int64_t> m = {
+      {"MONTH", 1}, {"QUARTER", 3}, {"YEAR", 12}};
+  return m;
+}
+
+// ---------------------------------------------------------------------------
+// flat AST reader (over the parser's serialized buffer)
+// ---------------------------------------------------------------------------
+// parser node kinds (keep in sync with parser.cpp)
+enum AstKind : int32_t {
+  K_STMT_LIST = 0, K_QUERY_STMT = 1, K_EXPLAIN_STMT = 2,
+  K_SELECT = 10, K_PROJ_ITEM = 11, K_FROM_CLAUSE = 12, K_WHERE_CLAUSE = 13,
+  K_GROUP_ITEM = 14, K_HAVING_CLAUSE = 15, K_ORDER_ITEM = 16,
+  K_LIMIT_CLAUSE = 17, K_OFFSET_CLAUSE = 18, K_CTE = 19, K_SETOP = 20,
+  K_DISTRIBUTE_ITEM = 21, K_VALUES_ROW = 22, K_NAMED_WINDOW = 23,
+  K_NAMED_TABLE = 30, K_DERIVED_TABLE = 31, K_TABLE_FUNC = 32, K_JOIN = 33,
+  K_PART = 34, K_ALIAS_COL = 35, K_USING_COL = 36,
+  K_IDENT = 40, K_WILDCARD = 41, K_LIT_NULL = 42, K_LIT_INT = 43,
+  K_LIT_FLOAT = 44, K_LIT_STR = 45, K_LIT_BOOL = 46, K_LIT_TYPED = 47,
+  K_INTERVAL = 48, K_UNARY = 49, K_BINARY = 50, K_CAST = 51, K_CASE = 52,
+  K_FUNCALL = 53, K_WINSPEC = 54, K_FRAME = 55, K_BETWEEN = 56,
+  K_INLIST = 57, K_INSUBQ = 58, K_EXISTS = 59, K_SCALARSUBQ = 60,
+  K_LIKE = 61, K_ISNULL = 62, K_ISBOOL = 63, K_ISDIST = 64, K_EXTRACT = 65,
+  K_SUBSTRING = 66, K_TRIM = 67, K_POSITION = 68, K_OVERLAY = 69,
+  K_CEILFLOORTO = 70, K_GROUPING_SETS = 71, K_SET_NODE = 72, K_ROLLUP = 73,
+  K_CUBE = 74,
+  K_QNAME = 79, K_CREATE_TABLE_WITH = 80, K_CREATE_TABLE_AS = 81,
+  K_DROP_TABLE = 82, K_CREATE_SCHEMA = 83, K_DROP_SCHEMA = 84,
+  K_USE_SCHEMA = 85, K_ALTER_SCHEMA = 86, K_ALTER_TABLE = 87,
+  K_SHOW_SCHEMAS = 88, K_SHOW_TABLES = 89, K_SHOW_COLUMNS = 90,
+  K_SHOW_MODELS = 91, K_ANALYZE_TABLE = 92, K_CREATE_MODEL = 93,
+  K_DROP_MODEL = 94, K_DESCRIBE_MODEL = 95, K_EXPORT_MODEL = 96,
+  K_CREATE_EXPERIMENT = 97, K_KWARGS = 98, K_KV = 99, K_KWLIST = 100,
+};
+
+struct AstNode {
+  int32_t kind, flags;
+  int64_t ival;
+  double dval;
+  int32_t s0, s1, child_off, nchild;
+};
+
+struct Ast {
+  std::vector<AstNode> nodes;
+  std::vector<int32_t> children;
+  std::vector<std::string> strings;
+  int32_t root = -1;
+
+  bool load(const uint8_t* buf, int64_t len) {
+    if (len < 28) return false;
+    int32_t hdr[7];
+    std::memcpy(hdr, buf, 28);
+    if (hdr[0] != AST_MAGIC) return false;
+    int32_t n_nodes = hdr[1], n_children = hdr[2], n_strings = hdr[3],
+            str_bytes = hdr[4];
+    root = hdr[5];
+    const uint8_t* p = buf + 28;
+    nodes.resize(n_nodes);
+    for (int i = 0; i < n_nodes; ++i) {
+      std::memcpy(&nodes[i].kind, p, 4); p += 4;
+      std::memcpy(&nodes[i].flags, p, 4); p += 4;
+      std::memcpy(&nodes[i].ival, p, 8); p += 8;
+      std::memcpy(&nodes[i].dval, p, 8); p += 8;
+      std::memcpy(&nodes[i].s0, p, 4); p += 4;
+      std::memcpy(&nodes[i].s1, p, 4); p += 4;
+      std::memcpy(&nodes[i].child_off, p, 4); p += 4;
+      std::memcpy(&nodes[i].nchild, p, 4); p += 4;
+    }
+    children.resize(n_children);
+    std::memcpy(children.data(), p, 4 * n_children);
+    p += 4 * n_children;
+    std::vector<int32_t> offs(n_strings + 1);
+    std::memcpy(offs.data(), p, 4 * (n_strings + 1));
+    p += 4 * (n_strings + 1);
+    strings.resize(n_strings);
+    for (int i = 0; i < n_strings; ++i)
+      strings[i].assign(reinterpret_cast<const char*>(p) + offs[i],
+                        offs[i + 1] - offs[i]);
+    (void)str_bytes;
+    return true;
+  }
+
+  const AstNode& n(int id) const { return nodes[id]; }
+  std::vector<int32_t> kids(int id) const {
+    const AstNode& nd = nodes[id];
+    return std::vector<int32_t>(children.begin() + nd.child_off,
+                                children.begin() + nd.child_off + nd.nchild);
+  }
+  std::string s(int32_t idx) const { return idx < 0 ? std::string() : strings[idx]; }
+  bool has_s(int32_t idx) const { return idx >= 0; }
+};
+
+// ---------------------------------------------------------------------------
+// catalog (decoded from the Python-serialized buffer)
+// ---------------------------------------------------------------------------
+struct CField {
+  std::string name;
+  int type;
+  bool nullable;
+};
+
+struct CTable {
+  std::string schema_name, name;
+  std::vector<CField> fields;
+};
+
+struct CFnOverload {
+  std::string name;  // registered spelling
+  std::vector<int> param_types;
+  int return_type;
+  bool aggregation;
+  bool row_udf;
+};
+
+struct Catalog {
+  bool case_sensitive = true;
+  std::string current_schema;
+  // schema -> table name -> table
+  std::map<std::string, std::map<std::string, CTable>> schemas;
+  // schema -> fn name -> overloads
+  std::map<std::string, std::map<std::string, std::vector<CFnOverload>>> functions;
+
+  bool load(const uint8_t* buf, int64_t len) {
+    const uint8_t* p = buf;
+    const uint8_t* end = buf + len;
+    auto r32 = [&]() -> int32_t {
+      if (p + 4 > end) throw Unsupported{};
+      int32_t v;
+      std::memcpy(&v, p, 4);
+      p += 4;
+      return v;
+    };
+    auto rstr = [&]() -> std::string {
+      int32_t n = r32();
+      if (p + n > end) throw Unsupported{};
+      std::string s(reinterpret_cast<const char*>(p), n);
+      p += n;
+      return s;
+    };
+    if (r32() != 0x44535143) return false;  // 'DSQC'
+    case_sensitive = r32() != 0;
+    current_schema = rstr();
+    int32_t n_schemas = r32();
+    for (int i = 0; i < n_schemas; ++i) {
+      std::string sname = rstr();
+      auto& tables = schemas[sname];
+      int32_t n_tables = r32();
+      for (int j = 0; j < n_tables; ++j) {
+        CTable t;
+        t.schema_name = sname;
+        t.name = rstr();
+        int32_t n_cols = r32();
+        for (int k = 0; k < n_cols; ++k) {
+          CField f;
+          f.name = rstr();
+          f.type = r32();
+          f.nullable = r32() != 0;
+          t.fields.push_back(std::move(f));
+        }
+        tables.emplace(t.name, std::move(t));
+      }
+      auto& fns = functions[sname];
+      int32_t n_fns = r32();
+      for (int j = 0; j < n_fns; ++j) {
+        std::string key = rstr();
+        int32_t n_ov = r32();
+        std::vector<CFnOverload> ovs;
+        for (int k = 0; k < n_ov; ++k) {
+          CFnOverload ov;
+          ov.name = rstr();
+          int32_t np = r32();
+          for (int q = 0; q < np; ++q) ov.param_types.push_back(r32());
+          ov.return_type = r32();
+          ov.aggregation = r32() != 0;
+          ov.row_udf = r32() != 0;
+          ovs.push_back(std::move(ov));
+        }
+        fns.emplace(key, std::move(ovs));
+      }
+    }
+    return true;
+  }
+
+  const CTable* resolve_table(const std::vector<std::string>& parts) const {
+    std::string schema_name, table_name;
+    if (parts.size() == 1) {
+      schema_name = current_schema;
+      table_name = parts[0];
+    } else {
+      schema_name = parts[parts.size() - 2];
+      table_name = parts.back();
+    }
+    auto sit = schemas.find(schema_name);
+    if (sit == schemas.end())
+      key_error("Schema '" + schema_name + "' not found");
+    auto tit = sit->second.find(table_name);
+    if (tit == sit->second.end() && !case_sensitive) {
+      std::string want = lower(table_name);
+      for (auto& kv : sit->second)
+        if (lower(kv.first) == want) return &kv.second;
+    }
+    if (tit == sit->second.end())
+      key_error("Table '" + table_name + "' not found in schema '" +
+                schema_name + "'");
+    return &tit->second;
+  }
+
+  const std::vector<CFnOverload>* resolve_function(const std::string& name) const {
+    auto sit = functions.find(current_schema);
+    if (sit == functions.end()) return nullptr;
+    auto fit = sit->second.find(name);
+    if (fit == sit->second.end()) {
+      // binder tries exact then lowercase spelling
+      fit = sit->second.find(lower(name));
+    }
+    if (fit == sit->second.end() && !case_sensitive) {
+      std::string want = lower(name);
+      for (auto& kv : sit->second)
+        if (lower(kv.first) == want) return &kv.second;
+    }
+    if (fit == sit->second.end()) return nullptr;
+    return &fit->second;
+  }
+};
+
+// ---------------------------------------------------------------------------
+// output (bound-plan) flat buffer
+// ---------------------------------------------------------------------------
+// plan node kinds
+enum PKind : int32_t {
+  P_TABLESCAN = 1, P_PROJECTION = 2, P_FILTER = 3, P_JOIN = 4, P_CROSSJOIN = 5,
+  P_AGGREGATE = 6, P_WINDOW = 7, P_SORT = 8, P_LIMIT = 9, P_UNION = 10,
+  P_INTERSECT = 11, P_EXCEPT = 12, P_DISTINCT = 13, P_VALUES = 14,
+  P_EMPTY = 15, P_SUBQUERY_ALIAS = 16, P_SAMPLE = 17, P_DISTRIBUTE_BY = 18,
+  P_EXPLAIN = 19,
+  P_CREATE_TABLE = 20, P_CREATE_MEMORY_TABLE = 21, P_DROP_TABLE = 22,
+  P_CREATE_SCHEMA = 23, P_DROP_SCHEMA = 24, P_USE_SCHEMA = 25,
+  P_ALTER_SCHEMA = 26, P_ALTER_TABLE = 27, P_SHOW_SCHEMAS = 28,
+  P_SHOW_TABLES = 29, P_SHOW_COLUMNS = 30, P_SHOW_MODELS = 31,
+  P_ANALYZE_TABLE = 32, P_CREATE_MODEL = 33, P_DROP_MODEL = 34,
+  P_DESCRIBE_MODEL = 35, P_EXPORT_MODEL = 36, P_CREATE_EXPERIMENT = 37,
+  P_PREDICT_MODEL = 38,
+  // aux
+  P_FIELD = 50, P_SORTKEY = 51, P_ON_PAIR = 52, P_VALUES_ROW = 53,
+  P_PART = 54, P_KWARGS = 55, P_KV = 56, P_KWLIST = 57, P_WINSPEC = 58,
+  P_FRAME_BOUND = 59,
+  P_KW_STR = 60, P_KW_INT = 61, P_KW_FLOAT = 62, P_KW_BOOL = 63, P_KW_NULL = 64,
+  // expressions
+  E_COLREF = 70, E_LITERAL = 71, E_SCALARFN = 72, E_AGG = 73, E_WINDOW = 74,
+  E_CAST = 75, E_CASE = 76, E_INLIST = 77, E_INSUBQ = 78, E_EXISTS = 79,
+  E_SCALARSUBQ = 80, E_UDF = 81, E_OUTERREF = 82, E_GROUPING = 83,
+};
+
+// literal tags (E_LITERAL flags low byte)
+enum { LT_NULL = 0, LT_BOOL = 1, LT_INT = 2, LT_FLOAT = 3, LT_STR = 4 };
+
+// E_* flag packing: bits 0..7 node-specific, bits 8+ sql_type id
+inline int32_t ty_flags(int ty, int32_t low = 0) { return (ty << 8) | low; }
+inline int ty_of_flags(int32_t flags) { return flags >> 8; }
+
+struct PNode {
+  int32_t kind, flags;
+  int64_t ival;
+  double dval;
+  int32_t s0, s1, child_off, nchild;
+};
+
+class PBuilder {
+ public:
+  std::vector<PNode> nodes;
+  std::vector<int32_t> children;
+  std::vector<std::string> strings;
+  std::map<std::string, int32_t> intern_map;
+
+  int32_t intern(const std::string& s) {
+    auto it = intern_map.find(s);
+    if (it != intern_map.end()) return it->second;
+    int32_t id = static_cast<int32_t>(strings.size());
+    strings.push_back(s);
+    intern_map.emplace(s, id);
+    return id;
+  }
+
+  int32_t add(int32_t kind, const std::vector<int32_t>& kids,
+              int32_t flags = 0, int64_t ival = 0, double dval = 0.0,
+              int32_t s0 = -1, int32_t s1 = -1) {
+    PNode n;
+    n.kind = kind;
+    n.flags = flags;
+    n.ival = ival;
+    n.dval = dval;
+    n.s0 = s0;
+    n.s1 = s1;
+    n.child_off = static_cast<int32_t>(children.size());
+    n.nchild = static_cast<int32_t>(kids.size());
+    children.insert(children.end(), kids.begin(), kids.end());
+    nodes.push_back(n);
+    return static_cast<int32_t>(nodes.size() - 1);
+  }
+
+  std::vector<int32_t> kids(int32_t id) const {
+    const PNode& n = nodes[id];
+    return std::vector<int32_t>(children.begin() + n.child_off,
+                                children.begin() + n.child_off + n.nchild);
+  }
+
+  // structural equality of two node trees (string ids are content-unique)
+  bool eq(int32_t a, int32_t b) const {
+    if (a == b) return true;
+    const PNode& x = nodes[a];
+    const PNode& y = nodes[b];
+    if (x.kind != y.kind || x.flags != y.flags || x.ival != y.ival ||
+        x.dval != y.dval || x.s0 != y.s0 || x.s1 != y.s1 ||
+        x.nchild != y.nchild)
+      return false;
+    for (int i = 0; i < x.nchild; ++i)
+      if (!eq(children[x.child_off + i], children[y.child_off + i]))
+        return false;
+    return true;
+  }
+
+  uint8_t* serialize(int32_t root, int64_t* out_len) const {
+    size_t str_bytes = 0;
+    for (auto& s : strings) str_bytes += s.size();
+    size_t total = 7 * 4 + nodes.size() * 40 + children.size() * 4 +
+                   (strings.size() + 1) * 4 + str_bytes;
+    uint8_t* buf = static_cast<uint8_t*>(std::malloc(total));
+    if (!buf) return nullptr;
+    uint8_t* p = buf;
+    auto w32 = [&p](int32_t v) { std::memcpy(p, &v, 4); p += 4; };
+    auto w64 = [&p](int64_t v) { std::memcpy(p, &v, 8); p += 8; };
+    auto wf64 = [&p](double v) { std::memcpy(p, &v, 8); p += 8; };
+    w32(PLAN_MAGIC);
+    w32(static_cast<int32_t>(nodes.size()));
+    w32(static_cast<int32_t>(children.size()));
+    w32(static_cast<int32_t>(strings.size()));
+    w32(static_cast<int32_t>(str_bytes));
+    w32(root);
+    w32(0);
+    for (auto& n : nodes) {
+      w32(n.kind); w32(n.flags); w64(n.ival); wf64(n.dval);
+      w32(n.s0); w32(n.s1); w32(n.child_off); w32(n.nchild);
+    }
+    for (auto c : children) w32(c);
+    int32_t off = 0;
+    for (auto& s : strings) { w32(off); off += static_cast<int32_t>(s.size()); }
+    w32(off);
+    for (auto& s : strings) { std::memcpy(p, s.data(), s.size()); p += s.size(); }
+    *out_len = static_cast<int64_t>(total);
+    return buf;
+  }
+};
+
+// ---------------------------------------------------------------------------
+// binder
+// ---------------------------------------------------------------------------
+struct BField {
+  std::string name;
+  int type;
+  bool nullable;
+};
+
+struct ScopeEntry {
+  bool has_qual;
+  std::string qual;
+  BField field;
+};
+
+struct Scope {
+  std::vector<ScopeEntry> entries;
+  const Scope* parent = nullptr;
+  bool case_sensitive = true;
+
+  bool match_name(const std::string& a, const std::string& b) const {
+    return case_sensitive ? a == b : lower(a) == lower(b);
+  }
+
+  // resolve -> (index, field) or nullopt; throws BindErr on ambiguity
+  std::optional<std::pair<int, BField>> resolve(
+      const std::vector<std::string>& parts) const {
+    std::string qualifier, name;
+    bool has_qual = false;
+    if (parts.size() == 1) {
+      name = parts[0];
+    } else {
+      qualifier = parts[parts.size() - 2];
+      name = parts.back();
+      has_qual = true;
+    }
+    std::vector<std::pair<int, BField>> matches;
+    for (size_t i = 0; i < entries.size(); ++i) {
+      const ScopeEntry& e = entries[i];
+      if (!match_name(e.field.name, name)) continue;
+      if (has_qual && (!e.has_qual || !match_name(e.qual, qualifier))) continue;
+      matches.emplace_back((int)i, e.field);
+    }
+    if (matches.empty()) return std::nullopt;
+    if (matches.size() > 1 && !has_qual) {
+      std::vector<std::pair<int, BField>> exact;
+      for (auto& m : matches)
+        if (m.second.name == name) exact.push_back(m);
+      if (exact.size() == 1) {
+        matches = exact;
+      } else {
+        std::string full;
+        for (size_t i = 0; i < parts.size(); ++i)
+          full += (i ? "." : "") + parts[i];
+        bind_error("Ambiguous column reference '" + full + "'");
+      }
+    }
+    return matches[0];
+  }
+};
+
+// nullability of a bound expr node (binder._nullable)
+bool expr_nullable(const PBuilder& b, int32_t e) {
+  const PNode& n = b.nodes[e];
+  if (n.kind == E_LITERAL) return (n.flags & 0xFF) == LT_NULL;
+  if (n.kind == E_COLREF || n.kind == E_OUTERREF) return (n.flags & 1) != 0;
+  return true;
+}
+
+int expr_type(const PBuilder& b, int32_t e) { return ty_of_flags(b.nodes[e].flags); }
+
+class Binder {
+ public:
+  Binder(const Ast& ast, const Catalog& cat, PBuilder& out)
+      : a(ast), cat(cat), b(out), case_sensitive(cat.case_sensitive) {}
+
+  const Ast& a;
+  const Catalog& cat;
+  PBuilder& b;
+  bool case_sensitive;
+  // CTE stack: frames of name -> bound plan node (+ its fields)
+  struct CtePlan {
+    int32_t plan;
+    std::vector<BField> fields;
+  };
+  std::vector<std::map<std::string, CtePlan>> cte_stack;
+  // per-SELECT state (saved/restored like the Python instance attrs)
+  std::map<std::string, int32_t> named_windows;          // name -> K_WINSPEC ast id
+  std::map<std::string, int32_t>* select_alias_asts = nullptr;  // folded alias -> ast id
+
+  std::string fold(const std::string& s) const {
+    return case_sensitive ? s : lower(s);
+  }
+
+  // ---------------- helpers over bound nodes ----------------
+  int32_t mk_field(const BField& f) {
+    return b.add(P_FIELD, {}, (f.type << 8) | (f.nullable ? 1 : 0), 0, 0.0,
+                 b.intern(f.name));
+  }
+  std::vector<int32_t> mk_fields(const std::vector<BField>& fs) {
+    std::vector<int32_t> out;
+    out.reserve(fs.size());
+    for (auto& f : fs) out.push_back(mk_field(f));
+    return out;
+  }
+  int32_t mk_colref(int idx, const std::string& name, int ty, bool nullable,
+                    bool outer = false) {
+    return b.add(outer ? E_OUTERREF : E_COLREF, {},
+                 ty_flags(ty, nullable ? 1 : 0), idx, 0.0, b.intern(name));
+  }
+  int32_t mk_lit_null() { return b.add(E_LITERAL, {}, ty_flags(TY_NULL, LT_NULL)); }
+  int32_t mk_lit_bool(bool v, int ty = TY_BOOLEAN) {
+    return b.add(E_LITERAL, {}, ty_flags(ty, LT_BOOL), v ? 1 : 0);
+  }
+  int32_t mk_lit_int(int64_t v, int ty) {
+    return b.add(E_LITERAL, {}, ty_flags(ty, LT_INT), v);
+  }
+  int32_t mk_lit_float(double v, int ty) {
+    return b.add(E_LITERAL, {}, ty_flags(ty, LT_FLOAT), 0, v);
+  }
+  int32_t mk_lit_str(const std::string& v, int ty) {
+    return b.add(E_LITERAL, {}, ty_flags(ty, LT_STR), 0, 0.0, b.intern(v));
+  }
+  int32_t mk_fn(const std::string& op, const std::vector<int32_t>& args, int ty) {
+    return b.add(E_SCALARFN, args, ty_flags(ty), 0, 0.0, b.intern(op));
+  }
+  int32_t mk_cast(int32_t arg, int ty, bool safe = false) {
+    return b.add(E_CAST, {arg}, ty_flags(ty, safe ? 1 : 0));
+  }
+  int32_t cast_to(int32_t e, int ty) {
+    return expr_type(b, e) == ty ? e : mk_cast(e, ty);
+  }
+  int32_t mk_sortkey(int32_t expr, bool asc, bool has_nf, bool nf) {
+    return b.add(P_SORTKEY, {expr},
+                 (asc ? 1 : 0) | (has_nf ? 2 : 0) | (nf ? 4 : 0));
+  }
+
+  // walk a bound expr tree collecting nodes of one kind (pre-order, like
+  // expressions.walk: node first, then children in children() order)
+  void collect_kind(int32_t e, int32_t kind, std::vector<int32_t>& out) {
+    if (b.nodes[e].kind == kind) out.push_back(e);
+    for (int32_t k : expr_children(e)) collect_kind(k, kind, out);
+  }
+
+  bool contains_kind(int32_t e, int32_t kind) {
+    if (b.nodes[e].kind == kind) return true;
+    for (int32_t k : expr_children(e))
+      if (contains_kind(k, kind)) return true;
+    return false;
+  }
+
+  // children() parity with expressions.py (traversal order matters for
+  // walk-based dedup): plan-valued kids (subqueries) are NOT expr children
+  std::vector<int32_t> expr_children(int32_t e) {
+    const PNode& n = b.nodes[e];
+    std::vector<int32_t> ks = b.kids(e);
+    switch (n.kind) {
+      case E_COLREF: case E_OUTERREF: case E_LITERAL:
+        return {};
+      case E_SCALARFN: case E_UDF: case E_GROUPING:
+        return ks;
+      case E_CAST:
+        return ks;
+      case E_CASE:
+        return ks;  // when/then pairs flattened + optional else
+      case E_INLIST:
+        return ks;  // arg + items
+      case E_INSUBQ:
+        return {ks[0]};  // arg only (plan kid excluded)
+      case E_EXISTS: case E_SCALARSUBQ:
+        return {};
+      case E_AGG: {
+        // args + optional filter — all kids are expr-valued
+        return ks;
+      }
+      case E_WINDOW: {
+        // args... + P_WINSPEC: children() = args + partition + order exprs
+        std::vector<int32_t> out(ks.begin(), ks.end() - 1);
+        int32_t spec = ks.back();
+        auto sk = b.kids(spec);
+        int npart = (int)b.nodes[spec].ival;
+        for (int i = 0; i < npart; ++i) out.push_back(sk[i]);
+        for (size_t i = npart; i < sk.size(); ++i)
+          if (b.nodes[sk[i]].kind == P_SORTKEY)
+            out.push_back(b.kids(sk[i])[0]);
+        return out;
+      }
+    }
+    return {};
+  }
+
+  // rebuild an expr with new children (with_children parity)
+  int32_t with_expr_children(int32_t e, const std::vector<int32_t>& ch) {
+    const PNode n = b.nodes[e];
+    switch (n.kind) {
+      case E_COLREF: case E_OUTERREF: case E_LITERAL:
+      case E_EXISTS: case E_SCALARSUBQ:
+        return e;
+      case E_SCALARFN: case E_UDF: case E_GROUPING: case E_CAST:
+      case E_CASE: case E_INLIST: case E_AGG:
+        return b.add(n.kind, ch, n.flags, n.ival, n.dval, n.s0, n.s1);
+      case E_INSUBQ: {
+        auto ks = b.kids(e);
+        return b.add(n.kind, {ch[0], ks[1]}, n.flags, n.ival, n.dval, n.s0, n.s1);
+      }
+      case E_WINDOW: {
+        auto ks = b.kids(e);
+        int32_t spec = ks.back();
+        const PNode sn = b.nodes[spec];
+        auto sk = b.kids(spec);
+        int npart = (int)sn.ival;
+        int nargs = (int)ks.size() - 1;
+        std::vector<int32_t> nsk;
+        size_t ci = nargs;  // children: args, then partition, then order exprs
+        for (int i = 0; i < npart; ++i) nsk.push_back(ch[ci++]);
+        for (size_t i = npart; i < sk.size(); ++i) {
+          if (b.nodes[sk[i]].kind == P_SORTKEY) {
+            const PNode kn = b.nodes[sk[i]];
+            nsk.push_back(b.add(P_SORTKEY, {ch[ci++]}, kn.flags));
+          } else {
+            nsk.push_back(sk[i]);  // frame bounds pass through
+          }
+        }
+        int32_t nspec = b.add(P_WINSPEC, nsk, sn.flags, sn.ival, sn.dval,
+                              sn.s0, sn.s1);
+        std::vector<int32_t> nks(ch.begin(), ch.begin() + nargs);
+        nks.push_back(nspec);
+        return b.add(n.kind, nks, n.flags, n.ival, n.dval, n.s0, n.s1);
+      }
+    }
+    return e;
+  }
+
+  // ---------------- literals ----------------
+  int32_t bind_literal(int32_t nid) {
+    const AstNode& n = a.n(nid);
+    switch (n.kind) {
+      case K_LIT_NULL: return mk_lit_null();
+      case K_LIT_BOOL: return mk_lit_bool(n.ival != 0);
+      case K_LIT_INT: {
+        int64_t v = n.ival;
+        int ty = (v >= -(1LL << 31) && v < (1LL << 31)) ? TY_INTEGER : TY_BIGINT;
+        return mk_lit_int(v, ty);
+      }
+      case K_LIT_FLOAT: return mk_lit_float(n.dval, TY_DOUBLE);
+      case K_LIT_STR: return mk_lit_str(a.s(n.s0), TY_VARCHAR);
+      case K_LIT_TYPED: {
+        std::string tn = upper(a.s(n.s1));
+        std::string v = a.s(n.s0);
+        if (tn == "DATE") {
+          int64_t ns = parse_datetime_ns(v);
+          ns = (ns / 86400000000000LL) * 86400000000000LL;
+          return mk_lit_int(ns, TY_DATE);
+        }
+        if (tn == "TIMESTAMP" || tn == "TIME")
+          return mk_lit_int(parse_datetime_ns(v), TY_TIMESTAMP);
+        // other typed literals: unreachable via this parser
+        throw Unsupported{};
+      }
+      case K_INTERVAL: return bind_interval(nid);
+    }
+    throw Unsupported{};
+  }
+
+  int32_t bind_interval(int32_t nid) {
+    const AstNode& n = a.n(nid);
+    std::string unit = upper(a.s(n.s1));
+    size_t to = unit.find(" TO ");
+    if (to != std::string::npos) unit = unit.substr(0, to);
+    std::string text = a.s(n.s0);
+    while (!text.empty() && text.front() == ' ') text.erase(text.begin());
+    while (!text.empty() && text.back() == ' ') text.pop_back();
+    auto& months = interval_month_units();
+    auto mit = months.find(unit);
+    auto all_digits = [](const std::string& s, size_t from) {
+      if (from >= s.size()) return false;
+      for (size_t i = from; i < s.size(); ++i)
+        if (s[i] < '0' || s[i] > '9') return false;
+      return true;
+    };
+    if (mit != months.end() &&
+        all_digits(text, text.size() && text[0] == '-' ? 1 : 0)) {
+      int64_t v = std::strtoll(text.c_str(), nullptr, 10);
+      return mk_lit_int(v * mit->second, TY_INTERVAL_YEAR_MONTH);
+    }
+    bool neg = !text.empty() && text[0] == '-';
+    std::string body = neg ? text.substr(1) : text;
+    int64_t total_ns = 0;
+    // plain number (optionally fractional)
+    bool plain = !body.empty();
+    bool seen_dot = false;
+    for (char c : body) {
+      if (c == '.' && !seen_dot) { seen_dot = true; continue; }
+      if (c < '0' || c > '9') { plain = false; break; }
+    }
+    if (plain) {
+      auto& nsu = interval_ns_units();
+      auto uit = nsu.find(unit);
+      int64_t scale = uit != nsu.end() ? uit->second : 1000000000LL;
+      total_ns = (int64_t)(std::strtod(body.c_str(), nullptr) * (double)scale);
+    } else {
+      // compound 'D HH:MM[:SS[.f]]'
+      const char* p = body.c_str();
+      auto read_num = [&](double* out) -> bool {
+        char* endp;
+        double v = std::strtod(p, &endp);
+        if (endp == p) return false;
+        p = endp;
+        *out = v;
+        return true;
+      };
+      double days = 0, h = 0, mi = 0, ss = 0;
+      double first;
+      if (!read_num(&first)) bind_error("Bad interval literal '" + a.s(n.s0) + "'");
+      if (*p == ' ') {
+        days = first;
+        while (*p == ' ') ++p;
+        if (!read_num(&h) || *p != ':') bind_error("Bad interval literal '" + a.s(n.s0) + "'");
+        ++p;
+      } else if (*p == ':') {
+        h = first;
+        ++p;
+      } else {
+        bind_error("Bad interval literal '" + a.s(n.s0) + "'");
+      }
+      if (!read_num(&mi)) bind_error("Bad interval literal '" + a.s(n.s0) + "'");
+      if (*p == ':') {
+        ++p;
+        if (!read_num(&ss)) bind_error("Bad interval literal '" + a.s(n.s0) + "'");
+      }
+      if (*p != '\0') bind_error("Bad interval literal '" + a.s(n.s0) + "'");
+      total_ns = (int64_t)((((days * 24 + h) * 3600) + mi * 60 + ss) * 1e9);
+    }
+    if (neg) total_ns = -total_ns;
+    return mk_lit_int(total_ns, TY_INTERVAL_DAY_TIME);
+  }
+
+  // string-literal cast for comparisons (binder._cast_literal)
+  int32_t cast_literal(int32_t lit, int target) {
+    const PNode n = b.nodes[lit];
+    int lt = ty_of_flags(n.flags);
+    int tag = n.flags & 0xFF;
+    if (is_datetime(target)) {
+      int64_t ns;
+      if (is_datetime(lt)) {
+        ns = n.ival;
+      } else if (tag == LT_STR) {
+        ns = parse_datetime_ns(a_str(n.s0));
+      } else if (tag == LT_INT) {
+        ns = n.ival;
+      } else if (tag == LT_FLOAT) {
+        ns = (int64_t)n.dval;
+      } else {
+        return lit;
+      }
+      if (target == TY_DATE) ns = (ns / 86400000000000LL) * 86400000000000LL;
+      return mk_lit_int(ns, target);
+    }
+    if (is_datetime(lt) || is_interval(lt)) {
+      if (is_integer(target)) return mk_lit_int(n.ival, target);
+      return lit;
+    }
+    if (is_integer(target)) {
+      if (tag == LT_INT || tag == LT_BOOL) return mk_lit_int(n.ival, target);
+      if (tag == LT_FLOAT) return mk_lit_int((int64_t)n.dval, target);
+      if (tag == LT_STR) {
+        // Python int(str) raises for non-numeric strings -> BindError-ish;
+        // match by parsing strictly
+        const std::string s = a_str(n.s0);
+        char* endp;
+        long long v = std::strtoll(s.c_str(), &endp, 10);
+        if (*endp != '\0') bind_error("Cannot bind literal '" + s + "'");
+        return mk_lit_int(v, target);
+      }
+      return lit;
+    }
+    if (target == TY_FLOAT || target == TY_DOUBLE || target == TY_DECIMAL ||
+        target == TY_REAL) {
+      if (tag == LT_INT || tag == LT_BOOL)
+        return mk_lit_float((double)n.ival, target);
+      if (tag == LT_FLOAT) return mk_lit_float(n.dval, target);
+      if (tag == LT_STR) {
+        const std::string s = a_str(n.s0);
+        char* endp;
+        double v = std::strtod(s.c_str(), &endp);
+        if (*endp != '\0') bind_error("Cannot bind literal '" + s + "'");
+        return mk_lit_float(v, target);
+      }
+      return lit;
+    }
+    if (target == TY_BOOLEAN) {
+      std::string sv;
+      if (tag == LT_STR) sv = a_str(n.s0);
+      else if (tag == LT_INT || tag == LT_BOOL) sv = std::to_string(n.ival);
+      else if (tag == LT_FLOAT) sv = std::to_string(n.dval);
+      std::string t = lower(sv);
+      while (!t.empty() && t.front() == ' ') t.erase(t.begin());
+      while (!t.empty() && t.back() == ' ') t.pop_back();
+      bool v = t == "true" || t == "t" || t == "1" || t == "yes";
+      return mk_lit_bool(v, TY_BOOLEAN);
+    }
+    return lit;
+  }
+
+  // string content of an interned id in the OUTPUT builder
+  std::string a_str(int32_t sid) { return sid < 0 ? std::string() : b.strings[sid]; }
+
+  // ---------------- coercion ----------------
+  int32_t coerce_bool(int32_t e) {
+    int t = expr_type(b, e);
+    if (t == TY_BOOLEAN) return e;
+    if (is_numeric(t) || t == TY_NULL) return mk_cast(e, TY_BOOLEAN);
+    bind_error(std::string("Expected boolean expression, got ") + TY_NAMES[t]);
+  }
+
+  std::pair<int32_t, int32_t> coerce_pair(int32_t l, int32_t r) {
+    int lt = expr_type(b, l), rt = expr_type(b, r);
+    if (lt == rt) return {l, r};
+    bool l_lit = b.nodes[l].kind == E_LITERAL;
+    bool r_lit = b.nodes[r].kind == E_LITERAL;
+    if (r_lit && is_string(rt) && !is_string(lt)) return {l, cast_literal(r, lt)};
+    if (l_lit && is_string(lt) && !is_string(rt)) return {cast_literal(l, rt), r};
+    int target = promote(lt, rt);  // BindErr on failure (message differs ok)
+    int32_t l2 = lt == target ? l : mk_cast(l, target);
+    int32_t r2 = rt == target ? r : mk_cast(r, target);
+    return {l2, r2};
+  }
+
+  // ---------------- expressions ----------------
+  // subst map: folded select alias -> AST id, consulted only when
+  // subst_active and scope resolution fails (binder._subst_select_aliases)
+  int32_t bind_expr(int32_t nid, const Scope& scope, bool subst_active = false) {
+    const AstNode& n = a.n(nid);
+    switch (n.kind) {
+      case K_LIT_NULL: case K_LIT_INT: case K_LIT_FLOAT: case K_LIT_STR:
+      case K_LIT_BOOL: case K_LIT_TYPED: case K_INTERVAL:
+        return bind_literal(nid);
+      case K_IDENT: {
+        std::vector<std::string> parts;
+        for (int32_t p : a.kids(nid)) parts.push_back(a.s(a.n(p).s0));
+        auto ref = scope.resolve(parts);
+        if (!ref) {
+          // select-alias substitution (HAVING / ORDER BY / GROUPING args)
+          if (subst_active && parts.size() == 1 && select_alias_asts) {
+            auto it = select_alias_asts->find(fold(parts[0]));
+            if (it != select_alias_asts->end())
+              return bind_expr(it->second, scope, false);
+          }
+          std::string up = upper(parts.back());
+          if (parts.size() == 1) {
+            auto& sf = scalar_functions();
+            auto it = sf.find(up);
+            if (it != sf.end() && it->second.lo == 0)
+              return mk_fn(it->second.op,
+                           {}, resolve_type(it->second.rule, {}));
+          }
+          if (scope.parent != nullptr) {
+            auto outer = scope.parent->resolve(parts);
+            if (outer) {
+              return mk_colref(outer->first, outer->second.name,
+                               outer->second.type, outer->second.nullable,
+                               /*outer=*/true);
+            }
+          }
+          std::string full;
+          for (size_t i = 0; i < parts.size(); ++i)
+            full += (i ? "." : "") + parts[i];
+          bind_error("Column '" + full + "' not found");
+        }
+        return mk_colref(ref->first, ref->second.name, ref->second.type,
+                         ref->second.nullable);
+      }
+      case K_UNARY: {
+        std::string op = upper(a.s(n.s0));
+        int32_t arg = bind_expr(a.kids(nid)[0], scope, subst_active);
+        if (op == "NOT")
+          return mk_fn("not", {coerce_bool(arg)}, TY_BOOLEAN);
+        if (op == "-")
+          return mk_fn("neg", {arg}, expr_type(b, arg));
+        return arg;
+      }
+      case K_BINARY: return bind_binary(nid, scope, subst_active);
+      case K_CAST: {
+        int32_t arg = bind_expr(a.kids(nid)[0], scope, subst_active);
+        return mk_cast(arg, parse_sql_type(a.s(n.s0)), (n.flags & 1) != 0);
+      }
+      case K_CASE: return bind_case(nid, scope, subst_active);
+      case K_FUNCALL: return bind_function(nid, scope, subst_active);
+      case K_BETWEEN: {
+        auto ks = a.kids(nid);
+        int32_t arg = bind_expr(ks[0], scope, subst_active);
+        int32_t low = bind_expr(ks[1], scope, subst_active);
+        int32_t high = bind_expr(ks[2], scope, subst_active);
+        bool negated = (n.flags & 1) != 0;
+        bool symmetric = (n.flags & 2) != 0;
+        if (symmetric) {
+          int t = promote(expr_type(b, low), expr_type(b, high));
+          int32_t lo2 = mk_fn("least", {low, high}, t);
+          int32_t hi2 = mk_fn("greatest", {low, high}, t);
+          low = lo2;
+          high = hi2;
+        }
+        auto [arg_l, low2] = coerce_pair(arg, low);
+        auto [arg_h, high2] = coerce_pair(arg, high);
+        int32_t cond = mk_fn(
+            "and",
+            {mk_fn("ge", {arg_l, low2}, TY_BOOLEAN),
+             mk_fn("le", {arg_h, high2}, TY_BOOLEAN)},
+            TY_BOOLEAN);
+        if (negated) return mk_fn("not", {cond}, TY_BOOLEAN);
+        return cond;
+      }
+      case K_INLIST: {
+        auto ks = a.kids(nid);
+        int32_t arg = bind_expr(ks[0], scope, subst_active);
+        std::vector<int32_t> items{arg};
+        for (size_t i = 1; i < ks.size(); ++i) {
+          int32_t it = bind_expr(ks[i], scope, subst_active);
+          auto [_, it2] = coerce_pair(arg, it);
+          items.push_back(it2);
+        }
+        return b.add(E_INLIST, items, ty_flags(TY_BOOLEAN, n.flags & 1));
+      }
+      case K_INSUBQ: {
+        auto ks = a.kids(nid);
+        int32_t arg = bind_expr(ks[0], scope, subst_active);
+        auto [plan, fields] = bind_query(ks[1], &scope);
+        if (fields.size() != 1)
+          bind_error("IN subquery must return exactly one column");
+        return b.add(E_INSUBQ, {arg, plan}, ty_flags(TY_BOOLEAN, n.flags & 1));
+      }
+      case K_EXISTS: {
+        auto [plan, fields] = bind_query(a.kids(nid)[0], &scope);
+        (void)fields;
+        return b.add(E_EXISTS, {plan}, ty_flags(TY_BOOLEAN, n.flags & 1));
+      }
+      case K_SCALARSUBQ: {
+        auto [plan, fields] = bind_query(a.kids(nid)[0], &scope);
+        if (fields.size() != 1)
+          bind_error("Scalar subquery must return exactly one column");
+        return b.add(E_SCALARSUBQ, {plan}, ty_flags(fields[0].type));
+      }
+      case K_LIKE: {
+        auto ks = a.kids(nid);
+        int32_t arg = bind_expr(ks[0], scope, subst_active);
+        int32_t pat = bind_expr(ks[1], scope, subst_active);
+        bool negated = (n.flags & 1) != 0;
+        bool ci = (n.flags & 2) != 0;
+        bool similar = (n.flags & 4) != 0;
+        std::string op = similar ? "similar" : (ci ? "ilike" : "like");
+        std::vector<int32_t> args{arg, pat};
+        if (n.flags & 8) args.push_back(mk_lit_str(a.s(n.s0), TY_VARCHAR));
+        int32_t out = mk_fn(op, args, TY_BOOLEAN);
+        if (negated) return mk_fn("not", {out}, TY_BOOLEAN);
+        return out;
+      }
+      case K_ISNULL: {
+        int32_t arg = bind_expr(a.kids(nid)[0], scope, subst_active);
+        return mk_fn((n.flags & 1) ? "is_not_null" : "is_null", {arg}, TY_BOOLEAN);
+      }
+      case K_ISBOOL: {
+        int32_t arg = coerce_bool(bind_expr(a.kids(nid)[0], scope, subst_active));
+        bool value = (n.flags & 2) != 0;
+        bool negated = (n.flags & 1) != 0;
+        const char* op = value ? (negated ? "is_not_true" : "is_true")
+                               : (negated ? "is_not_false" : "is_false");
+        return mk_fn(op, {arg}, TY_BOOLEAN);
+      }
+      case K_ISDIST: {
+        auto ks = a.kids(nid);
+        int32_t l = bind_expr(ks[0], scope, subst_active);
+        int32_t r = bind_expr(ks[1], scope, subst_active);
+        auto [l2, r2] = coerce_pair(l, r);
+        const char* op = (n.flags & 1) ? "is_not_distinct_from" : "is_distinct_from";
+        return mk_fn(op, {l2, r2}, TY_BOOLEAN);
+      }
+      case K_EXTRACT: {
+        int32_t arg = bind_expr(a.kids(nid)[0], scope, subst_active);
+        return mk_fn("extract_" + lower(a.s(n.s0)), {arg}, TY_BIGINT);
+      }
+      case K_SUBSTRING: {
+        auto ks = a.kids(nid);
+        int32_t arg = bind_expr(ks[0], scope, subst_active);
+        int32_t start = (n.flags & 1) ? bind_expr(ks[1], scope, subst_active)
+                                      : mk_lit_int(1, TY_BIGINT);
+        std::vector<int32_t> args{arg, start};
+        if (n.flags & 2) args.push_back(bind_expr(ks[2], scope, subst_active));
+        return mk_fn("substring", args, TY_VARCHAR);
+      }
+      case K_TRIM: {
+        auto ks = a.kids(nid);
+        int32_t arg = bind_expr(ks[0], scope, subst_active);
+        std::string where = upper(a.s(n.s0));
+        const char* op = where == "LEADING" ? "ltrim"
+                         : where == "TRAILING" ? "rtrim" : "btrim";
+        std::vector<int32_t> args{arg};
+        if (n.flags & 1) args.push_back(bind_expr(ks[1], scope, subst_active));
+        return mk_fn(op, args, TY_VARCHAR);
+      }
+      case K_POSITION: {
+        auto ks = a.kids(nid);
+        return mk_fn("position",
+                     {bind_expr(ks[0], scope, subst_active),
+                      bind_expr(ks[1], scope, subst_active)},
+                     TY_INTEGER);
+      }
+      case K_OVERLAY: {
+        auto ks = a.kids(nid);
+        std::vector<int32_t> args{bind_expr(ks[0], scope, subst_active),
+                                  bind_expr(ks[1], scope, subst_active),
+                                  bind_expr(ks[2], scope, subst_active)};
+        if (n.flags & 1) args.push_back(bind_expr(ks[3], scope, subst_active));
+        return mk_fn("overlay", args, TY_VARCHAR);
+      }
+      case K_CEILFLOORTO: {
+        int32_t arg = bind_expr(a.kids(nid)[0], scope, subst_active);
+        std::string fn = upper(a.s(n.s0));
+        const char* op = fn == "CEIL" ? "datetime_ceil" : "datetime_floor";
+        return mk_fn(op, {arg, mk_lit_str(a.s(n.s1), TY_VARCHAR)},
+                     expr_type(b, arg));
+      }
+      case K_WILDCARD:
+        bind_error("Wildcard not allowed here");
+    }
+    throw Unsupported{};
+  }
+
+  int32_t bind_binary(int32_t nid, const Scope& scope, bool subst_active) {
+    const AstNode& n = a.n(nid);
+    std::string op = upper(a.s(n.s0));
+    auto ks = a.kids(nid);
+    if (op == "AND" || op == "OR") {
+      int32_t l = coerce_bool(bind_expr(ks[0], scope, subst_active));
+      int32_t r = coerce_bool(bind_expr(ks[1], scope, subst_active));
+      return mk_fn(lower(op), {l, r}, TY_BOOLEAN);
+    }
+    int32_t l = bind_expr(ks[0], scope, subst_active);
+    int32_t r = bind_expr(ks[1], scope, subst_active);
+    if (op == "||") return mk_fn("concat", {l, r}, TY_VARCHAR);
+    static const std::map<std::string, const char*> cmp = {
+        {"=", "eq"}, {"<>", "ne"}, {"<", "lt"}, {"<=", "le"},
+        {">", "gt"}, {">=", "ge"}};
+    auto cit = cmp.find(op);
+    if (cit != cmp.end()) {
+      auto [l2, r2] = coerce_pair(l, r);
+      return mk_fn(cit->second, {l2, r2}, TY_BOOLEAN);
+    }
+    static const std::map<std::string, const char*> arith = {
+        {"+", "add"}, {"-", "sub"}, {"*", "mul"}, {"/", "div"}, {"%", "mod"}};
+    auto ait = arith.find(op);
+    if (ait != arith.end()) return bind_arith(op, ait->second, l, r);
+    bind_error("Unknown binary operator " + op);
+  }
+
+  int32_t bind_arith(const std::string& op, const char* canon, int32_t l,
+                     int32_t r) {
+    int lt = expr_type(b, l), rt = expr_type(b, r);
+    if (is_datetime(lt) || is_datetime(rt)) {
+      if (op == "-" && is_datetime(lt) && is_datetime(rt))
+        return mk_fn("datetime_sub", {l, r}, TY_INTERVAL_DAY_TIME);
+      if (is_datetime(lt) && is_interval(rt))
+        return mk_fn(op == "+" ? "datetime_add" : "datetime_sub_interval",
+                     {l, r}, lt);
+      if (is_datetime(rt) && is_interval(lt) && op == "+")
+        return mk_fn("datetime_add", {r, l}, rt);
+      if (is_datetime(lt) && is_integer(rt)) {
+        int32_t iv = mk_fn("int_to_interval_days", {r}, TY_INTERVAL_DAY_TIME);
+        return mk_fn(op == "+" ? "datetime_add" : "datetime_sub_interval",
+                     {l, iv}, lt);
+      }
+      if (is_datetime(rt) && is_integer(lt) && op == "+") {
+        int32_t iv = mk_fn("int_to_interval_days", {l}, TY_INTERVAL_DAY_TIME);
+        return mk_fn("datetime_add", {r, iv}, rt);
+      }
+    }
+    if (is_interval(lt) || is_interval(rt)) {
+      if ((op == "+" || op == "-") && is_interval(lt) && is_interval(rt))
+        return mk_fn(canon, {l, r}, lt);
+      if (op == "*")
+        return mk_fn("mul", {l, r}, is_interval(lt) ? lt : rt);
+    }
+    auto [l2, r2] = coerce_pair(l, r);
+    int result = promote(expr_type(b, l2), expr_type(b, r2));
+    if (op == "/") return mk_fn("div", {l2, r2}, result);
+    return mk_fn(canon, {l2, r2}, result);
+  }
+
+  int32_t bind_case(int32_t nid, const Scope& scope, bool subst_active) {
+    const AstNode& n = a.n(nid);
+    auto ks = a.kids(nid);
+    size_t i = 0;
+    std::vector<std::pair<int32_t, int32_t>> whens;
+    if (n.flags & 1) {  // CASE <operand> WHEN ...
+      int32_t operand = bind_expr(ks[0], scope, subst_active);
+      i = 1;
+      size_t n_when = (ks.size() - i - ((n.flags & 2) ? 1 : 0)) / 2;
+      for (size_t j = 0; j < n_when; ++j) {
+        int32_t c = bind_expr(ks[i + 2 * j], scope, subst_active);
+        auto [o2, c2] = coerce_pair(operand, c);
+        int32_t res = bind_expr(ks[i + 2 * j + 1], scope, subst_active);
+        whens.emplace_back(mk_fn("eq", {o2, c2}, TY_BOOLEAN), res);
+      }
+    } else {
+      size_t n_when = (ks.size() - ((n.flags & 2) ? 1 : 0)) / 2;
+      for (size_t j = 0; j < n_when; ++j) {
+        int32_t c = coerce_bool(bind_expr(ks[2 * j], scope, subst_active));
+        int32_t res = bind_expr(ks[2 * j + 1], scope, subst_active);
+        whens.emplace_back(c, res);
+      }
+    }
+    int32_t else_ = -1;
+    if (n.flags & 2) else_ = bind_expr(ks.back(), scope, subst_active);
+    int rt = expr_type(b, whens.empty() ? else_ : whens[0].second);
+    for (auto& w : whens) rt = promote(rt, expr_type(b, w.second));
+    if (else_ >= 0) rt = promote(rt, expr_type(b, else_));
+    std::vector<int32_t> kids;
+    for (auto& w : whens) {
+      kids.push_back(w.first);
+      kids.push_back(cast_to(w.second, rt));
+    }
+    if (else_ >= 0) kids.push_back(cast_to(else_, rt));
+    return b.add(E_CASE, kids, ty_flags(rt, (else_ >= 0) ? 1 : 0));
+  }
+
+  int32_t bind_filter_clause(int32_t funcall_nid, int32_t filter_kid,
+                             const Scope& scope, bool subst_active) {
+    if (filter_kid < 0) return -1;
+    return coerce_bool(bind_expr(filter_kid, scope, subst_active));
+  }
+
+  int32_t bind_function(int32_t nid, const Scope& scope, bool subst_active) {
+    const AstNode& n = a.n(nid);
+    std::string name = upper(a.s(n.s0));
+    auto ks = a.kids(nid);
+    int nargs = (int)n.ival;
+    bool distinct = (n.flags & 1) != 0;
+    bool ignore_nulls = (n.flags & 2) != 0;
+    bool has_filter = (n.flags & 4) != 0;
+    bool has_over_spec = (n.flags & 8) != 0;
+    bool has_over_name = (n.flags & 16) != 0;
+    int32_t filter_kid = has_filter ? ks[nargs] : -1;
+    int32_t over_spec_kid = has_over_spec ? ks[nargs + (has_filter ? 1 : 0)] : -1;
+
+    if (name == "GROUPING" && !has_over_spec && !has_over_name) {
+      if (nargs == 0) bind_error("GROUPING requires column arguments");
+      std::vector<int32_t> bound;
+      for (int i = 0; i < nargs; ++i) {
+        if (a.n(ks[i]).kind == K_WILDCARD)
+          bind_error("GROUPING requires column arguments");
+        // select aliases may serve as GROUPING args (bind with fallback)
+        try {
+          bound.push_back(bind_expr(ks[i], scope, false));
+        } catch (const BindErr&) {
+          const AstNode& an = a.n(ks[i]);
+          if (an.kind == K_IDENT && an.nchild == 1 && select_alias_asts) {
+            std::string part = a.s(a.n(a.kids(ks[i])[0]).s0);
+            auto it = select_alias_asts->find(fold(part));
+            if (it != select_alias_asts->end()) {
+              bound.push_back(bind_expr(it->second, scope, false));
+              continue;
+            }
+          }
+          throw;
+        }
+      }
+      return b.add(E_GROUPING, bound, ty_flags(TY_INTEGER));
+    }
+
+    // bind args; star (COUNT(*)) -> sentinel -1
+    std::vector<int32_t> args;
+    for (int i = 0; i < nargs; ++i) {
+      if (a.n(ks[i]).kind == K_WILDCARD)
+        args.push_back(-1);
+      else
+        args.push_back(bind_expr(ks[i], scope, subst_active));
+    }
+
+    if (has_over_spec || has_over_name) {
+      int32_t spec_nid = over_spec_kid;
+      if (has_over_name) {
+        std::string wname = a.s(n.s1);
+        auto it = named_windows.find(wname);
+        if (it == named_windows.end() && !case_sensitive) {
+          for (auto& kv : named_windows)
+            if (lower(kv.first) == lower(wname)) { it = named_windows.find(kv.first); break; }
+        }
+        if (it == named_windows.end())
+          bind_error("Unknown window name '" + wname + "'");
+        spec_nid = it->second;
+      }
+      return bind_window_call(name, args, spec_nid, filter_kid, ignore_nulls,
+                              distinct, scope, subst_active);
+    }
+
+    auto& aggs = aggregate_functions();
+    auto agg_it = aggs.find(name);
+    if (agg_it != aggs.end())
+      return make_agg(name, agg_it->second, args, distinct, filter_kid, nid,
+                      scope, subst_active);
+
+    // UDF / user aggregation
+    const std::vector<CFnOverload>* fns = cat.resolve_function(a.s(n.s0));
+    if (fns != nullptr && !fns->empty()) {
+      const CFnOverload& fd = pick_overload(*fns, args);
+      int32_t filt = bind_filter_clause(nid, filter_kid, scope, subst_active);
+      if (fd.aggregation) {
+        std::vector<int32_t> kids2 = args;
+        for (auto aid : kids2)
+          if (aid < 0) bind_error("* argument only allowed in COUNT");
+        int32_t fl = ty_flags(fd.return_type, (distinct ? 1 : 0) |
+                                               (filt >= 0 ? 2 : 0));
+        if (filt >= 0) kids2.push_back(filt);
+        return b.add(E_AGG, kids2, fl, 0, 0.0,
+                     b.intern("udaf:" + fd.name));
+      }
+      std::vector<int32_t> cast_args;
+      for (size_t i = 0; i < args.size(); ++i) {
+        int32_t arg = args[i];
+        if (arg < 0) bind_error("* argument only allowed in COUNT");
+        if (i < fd.param_types.size() &&
+            expr_type(b, arg) != fd.param_types[i])
+          arg = mk_cast(arg, fd.param_types[i]);
+        cast_args.push_back(arg);
+      }
+      return b.add(E_UDF, cast_args,
+                   ty_flags(fd.return_type, fd.row_udf ? 1 : 0), 0, 0.0,
+                   b.intern(fd.name));
+    }
+
+    auto& sf = scalar_functions();
+    auto sit = sf.find(name);
+    if (sit != sf.end()) {
+      const ScalarSig& sig = sit->second;
+      if ((int)args.size() < sig.lo || (int)args.size() > sig.hi)
+        bind_error(name + " expects " + std::to_string(sig.lo) + ".." +
+                   std::to_string(sig.hi) + " args, got " +
+                   std::to_string(args.size()));
+      std::vector<int> ats;
+      for (auto arg : args) {
+        if (arg < 0) bind_error("* argument only allowed in COUNT");
+        ats.push_back(expr_type(b, arg));
+      }
+      return mk_fn(sig.op, args, resolve_type(sig.rule, ats));
+    }
+    bind_error("Unknown function '" + a.s(n.s0) + "'");
+  }
+
+  const CFnOverload& pick_overload(const std::vector<CFnOverload>& fns,
+                                   const std::vector<int32_t>& args) {
+    size_t nargs = args.size();
+    std::vector<const CFnOverload*> exact;
+    for (auto& fd : fns)
+      if (fd.param_types.size() == nargs) exact.push_back(&fd);
+    if (!exact.empty()) {
+      for (auto* fd : exact) {
+        bool ok = true;
+        for (size_t i = 0; i < nargs; ++i) {
+          if (args[i] < 0 || !similar_type(expr_type(b, args[i]),
+                                           fd->param_types[i])) {
+            ok = false;
+            break;
+          }
+        }
+        if (ok) return *fd;
+      }
+      return *exact[0];
+    }
+    return fns[0];
+  }
+
+  int32_t make_agg(const std::string& name, const AggSig& sig,
+                   const std::vector<int32_t>& args, bool distinct,
+                   int32_t filter_kid, int32_t nid, const Scope& scope,
+                   bool subst_active) {
+    int32_t filt = bind_filter_clause(nid, filter_kid, scope, subst_active);
+    bool star = args.empty() || args[0] < 0;
+    if (name == "COUNT" && star) {
+      int32_t fl = ty_flags(TY_BIGINT, (distinct ? 1 : 0) | (filt >= 0 ? 2 : 0));
+      std::vector<int32_t> kids2;
+      if (filt >= 0) kids2.push_back(filt);
+      return b.add(E_AGG, kids2, fl, 0, 0.0, b.intern("count_star"));
+    }
+    for (auto arg : args)
+      if (arg < 0) bind_error("* argument only allowed in COUNT");
+    std::vector<int> ats;
+    for (auto arg : args) ats.push_back(expr_type(b, arg));
+    int rt = resolve_type(sig.rule, ats);
+    int32_t fl = ty_flags(rt, (distinct ? 1 : 0) | (filt >= 0 ? 2 : 0));
+    std::vector<int32_t> kids2 = args;
+    if (filt >= 0) kids2.push_back(filt);
+    return b.add(E_AGG, kids2, fl, 0, 0.0, b.intern(sig.op));
+  }
+
+  // frame bound ast node payload: parser K_FRAME — fival = start|end<<8,
+  // fflags 1/2 = offset exprs present (offsets are literal ast exprs)
+  int32_t mk_frame_bound(int kind, bool has_off, bool is_float, int64_t iv,
+                         double dv) {
+    return b.add(P_FRAME_BOUND, {},
+                 (kind << 4) | (has_off ? 1 : 0) | (is_float ? 2 : 0), iv, dv);
+  }
+
+  // evaluate a frame offset AST (literal int/float or interval)
+  void frame_offset(int32_t off_nid, const std::string& units, bool* has,
+                    bool* is_float, int64_t* iv, double* dv) {
+    *has = false;
+    *is_float = false;
+    *iv = 0;
+    *dv = 0;
+    if (off_nid < 0) return;
+    const AstNode& n = a.n(off_nid);
+    if (n.kind == K_INTERVAL) {
+      if (units != "RANGE")
+        bind_error("Interval frame offsets require RANGE frames");
+      int32_t lit = bind_interval(off_nid);
+      if (expr_type(b, lit) == TY_INTERVAL_YEAR_MONTH)
+        bind_error(
+            "Year-month intervals are not supported as RANGE offsets; use "
+            "day-time intervals (e.g. INTERVAL '30' DAY)");
+      *has = true;
+      *iv = b.nodes[lit].ival;
+      return;
+    }
+    if (n.kind == K_LIT_INT) {
+      *has = true;
+      *iv = n.ival;
+      return;
+    }
+    if (n.kind == K_LIT_FLOAT) {
+      if (units == "ROWS")
+        bind_error("ROWS frame offsets must be integer literals");
+      *has = true;
+      *is_float = true;
+      *dv = n.dval;
+      return;
+    }
+    bind_error("Window frame offsets must be numeric or interval literals");
+  }
+
+  int32_t bind_window_call(const std::string& name,
+                           const std::vector<int32_t>& args, int32_t spec_nid,
+                           int32_t filter_kid, bool ignore_nulls, bool distinct,
+                           const Scope& scope, bool subst_active) {
+    (void)filter_kid;
+    (void)distinct;
+    // decode the K_WINSPEC ast node
+    const AstNode& sn = a.n(spec_nid);
+    auto sk = a.kids(spec_nid);
+    bool has_frame = (sn.flags & 1) != 0;
+    int npart = (int)sn.ival;
+    int32_t frame_id = -1;
+    size_t n_items = sk.size();
+    if (has_frame) {
+      frame_id = sk.back();
+      n_items -= 1;
+    }
+    std::vector<int32_t> partition;
+    for (int i = 0; i < npart; ++i)
+      partition.push_back(bind_expr(sk[i], scope, subst_active));
+    std::vector<int32_t> order_keys;  // P_SORTKEY nodes
+    for (size_t i = npart; i < n_items; ++i) {
+      const AstNode& on = a.n(sk[i]);
+      int32_t e = bind_expr(a.kids(sk[i])[0], scope, subst_active);
+      bool asc = (on.flags & 1) != 0;
+      bool has_nf = (on.flags & 2) != 0;
+      bool nf = (on.flags & 4) != 0;
+      order_keys.push_back(mk_sortkey(e, asc, has_nf, nf));
+    }
+
+    int rt_rule = -1;
+    std::string func;
+    int sql_type;
+    std::vector<int32_t> out_args = args;
+    auto& wf = window_functions();
+    auto wit = wf.find(name);
+    std::vector<int> ats;
+    for (auto arg : args)
+      if (arg >= 0) ats.push_back(expr_type(b, arg));
+    if (wit != wf.end()) {
+      rt_rule = wit->second;
+      func = lower(name);
+      sql_type = resolve_type(rt_rule, ats);
+    } else {
+      auto& aggs = aggregate_functions();
+      auto ait = aggs.find(name);
+      if (ait == aggs.end()) bind_error("Unknown window function '" + name + "'");
+      bool star = args.empty() || args[0] < 0;
+      if (name == "COUNT" && star) {
+        func = "count_star";
+        sql_type = TY_BIGINT;
+        out_args.clear();
+      } else {
+        for (auto arg : args)
+          if (arg < 0) bind_error("* argument only allowed in COUNT");
+        func = ait->second.op;
+        sql_type = resolve_type(ait->second.rule, ats);
+      }
+    }
+
+    // frame: parser K_FRAME node -> bounds; defaults otherwise
+    std::string units = "ROWS";
+    int start_kind, end_kind;
+    bool s_has, s_f, e_has, e_f;
+    int64_t s_iv, e_iv;
+    double s_dv, e_dv;
+    bool explicit_frame;
+    if (frame_id >= 0) {
+      const AstNode& fn = a.n(frame_id);
+      units = upper(a.s(fn.s0));
+      start_kind = (int)(fn.ival & 0xFF);
+      end_kind = (int)((fn.ival >> 8) & 0xFF);
+      auto fk = a.kids(frame_id);
+      size_t fi = 0;
+      int32_t s_off = (fn.flags & 1) ? fk[fi++] : -1;
+      int32_t e_off = (fn.flags & 2) ? fk[fi++] : -1;
+      frame_offset(s_off, units, &s_has, &s_f, &s_iv, &s_dv);
+      frame_offset(e_off, units, &e_has, &e_f, &e_iv, &e_dv);
+      explicit_frame = true;
+    } else if (!order_keys.empty()) {
+      units = "RANGE";
+      start_kind = 0;  // UNBOUNDED_PRECEDING
+      end_kind = 2;    // CURRENT_ROW
+      s_has = s_f = e_has = e_f = false;
+      s_iv = e_iv = 0;
+      s_dv = e_dv = 0;
+      explicit_frame = false;
+    } else {
+      units = "ROWS";
+      start_kind = 0;
+      end_kind = 4;  // UNBOUNDED_FOLLOWING
+      s_has = s_f = e_has = e_f = false;
+      s_iv = e_iv = 0;
+      s_dv = e_dv = 0;
+      explicit_frame = false;
+    }
+    std::vector<int32_t> spec_kids = partition;
+    for (auto k : order_keys) spec_kids.push_back(k);
+    spec_kids.push_back(mk_frame_bound(start_kind, s_has, s_f, s_iv, s_dv));
+    spec_kids.push_back(mk_frame_bound(end_kind, e_has, e_f, e_iv, e_dv));
+    int32_t spec = b.add(P_WINSPEC, spec_kids, explicit_frame ? 1 : 0,
+                         npart, 0.0, b.intern(units));
+    std::vector<int32_t> kids2;
+    for (auto arg : out_args)
+      if (arg >= 0) kids2.push_back(arg);
+    kids2.push_back(spec);
+    return b.add(E_WINDOW, kids2,
+                 ty_flags(sql_type, ignore_nulls ? 1 : 0),
+                 (int64_t)(kids2.size() - 1), 0.0, b.intern(func));
+  }
+
+  // ---------------- plans ----------------
+  struct BPlan {
+    int32_t id;
+    std::vector<BField> fields;
+  };
+
+  // Python str() of a literal for derived projection names
+  static std::string py_float_repr(double v) {
+    if (std::isnan(v)) return "nan";
+    if (std::isinf(v)) return v > 0 ? "inf" : "-inf";
+    char buf[64];
+    for (int prec = 1; prec <= 17; ++prec) {
+      std::snprintf(buf, sizeof buf, "%.*g", prec, v);
+      if (std::strtod(buf, nullptr) == v) break;
+    }
+    std::string s(buf);
+    // Python always shows a fraction or exponent for floats
+    if (s.find('.') == std::string::npos && s.find('e') == std::string::npos &&
+        s.find("inf") == std::string::npos && s.find("nan") == std::string::npos)
+      s += ".0";
+    // Python exponent formatting: 1e+20 -> '1e+20' (matches %g)
+    return s;
+  }
+
+  std::string derive_name(int32_t nid) {
+    const AstNode& n = a.n(nid);
+    switch (n.kind) {
+      case K_IDENT: {
+        auto ks = a.kids(nid);
+        return a.s(a.n(ks.back()).s0);
+      }
+      case K_FUNCALL: return a.s(n.s0);
+      case K_CAST: return derive_name(a.kids(nid)[0]);
+      case K_LIT_NULL: return "None";
+      case K_LIT_BOOL: return n.ival ? "True" : "False";
+      case K_LIT_INT: return std::to_string(n.ival);
+      case K_LIT_FLOAT: return py_float_repr(n.dval);
+      case K_LIT_STR: case K_LIT_TYPED: return a.s(n.s0);
+      case K_EXTRACT: return "EXTRACT";
+      case K_CASE: return "CASE";
+    }
+    return "EXPR";
+  }
+
+  std::string derive_group_name(int32_t bound, int i) {
+    if (b.nodes[bound].kind == E_COLREF || b.nodes[bound].kind == E_OUTERREF)
+      return a_str(b.nodes[bound].s0);
+    return "__group" + std::to_string(i);
+  }
+
+  // ---------------- FROM refs ----------------
+  std::pair<BPlan, Scope> bind_table_ref(int32_t nid, const Scope* outer) {
+    const AstNode& n = a.n(nid);
+    if (n.kind == K_NAMED_TABLE) {
+      auto out = bind_named_table(nid, outer);
+      if (n.flags & 1) {  // TABLESAMPLE
+        std::string method = a.s(n.s1);
+        double frac = n.dval;
+        int64_t seed = n.ival;  // -1 = none
+        int32_t plan = b.add(
+            P_SAMPLE, concat({out.first.id}, mk_fields(out.first.fields)),
+            seed >= 0 ? 1 : 0, seed, frac, b.intern(method));
+        out.first.id = plan;
+      }
+      return out;
+    }
+    if (n.kind == K_DERIVED_TABLE) {
+      auto ks = a.kids(nid);
+      auto [sub, sub_fields] = bind_query(ks[0], outer);
+      std::string alias = a.s(n.s0);
+      std::vector<std::string> col_aliases;
+      for (size_t i = 1; i < ks.size(); ++i)
+        if (a.n(ks[i]).kind == K_ALIAS_COL)
+          col_aliases.push_back(a.s(a.n(ks[i]).s0));
+      std::vector<BField> fields = sub_fields;
+      for (size_t i = 0; i < fields.size() && i < col_aliases.size(); ++i)
+        fields[i].name = col_aliases[i];
+      int32_t plan = sub;
+      if (a.has_s(n.s0)) {
+        plan = b.add(P_SUBQUERY_ALIAS, concat({sub}, mk_fields(fields)), 0, 0,
+                     0.0, b.intern(alias));
+      }
+      Scope scope;
+      scope.parent = outer;
+      scope.case_sensitive = case_sensitive;
+      for (auto& f : fields)
+        scope.entries.push_back({a.has_s(n.s0), alias, f});
+      return {{plan, fields}, scope};
+    }
+    if (n.kind == K_TABLE_FUNC) {
+      // PREDICT(MODEL name, <query>) table function
+      auto ks = a.kids(nid);
+      std::vector<std::string> parts;
+      int32_t sel = -1;
+      for (int32_t k : ks) {
+        if (a.n(k).kind == K_PART) parts.push_back(a.s(a.n(k).s0));
+        else if (a.n(k).kind == K_SELECT) sel = k;
+      }
+      auto [sub, sub_fields] = bind_query(sel, outer);
+      std::vector<BField> fields = sub_fields;
+      fields.push_back({"target", TY_DOUBLE, true});
+      std::vector<int32_t> name_kids;
+      for (auto& pt : parts)
+        name_kids.push_back(b.add(P_PART, {}, 0, 0, 0.0, b.intern(pt)));
+      int32_t plan = b.add(
+          P_PREDICT_MODEL,
+          concat(concat({sub}, mk_fields(fields)), name_kids), 0,
+          (int64_t)fields.size());
+      std::string alias = a.s(n.s1);
+      Scope scope;
+      scope.parent = outer;
+      scope.case_sensitive = case_sensitive;
+      for (auto& f : fields)
+        scope.entries.push_back({a.has_s(n.s1), alias, f});
+      return {{plan, fields}, scope};
+    }
+    if (n.kind == K_JOIN) return bind_join(nid, outer);
+    throw Unsupported{};
+  }
+
+  static std::vector<int32_t> concat(std::vector<int32_t> x,
+                                     const std::vector<int32_t>& y) {
+    x.insert(x.end(), y.begin(), y.end());
+    return x;
+  }
+
+  std::pair<BPlan, Scope> bind_named_table(int32_t nid, const Scope* outer) {
+    const AstNode& n = a.n(nid);
+    std::string alias = a.s(n.s0);
+    bool has_alias = a.has_s(n.s0);
+    std::vector<std::string> parts, col_aliases;
+    for (int32_t k : a.kids(nid)) {
+      if (a.n(k).kind == K_PART) parts.push_back(a.s(a.n(k).s0));
+      else if (a.n(k).kind == K_ALIAS_COL)
+        col_aliases.push_back(a.s(a.n(k).s0));
+    }
+    // CTE lookup first (innermost wins)
+    if (parts.size() == 1) {
+      for (auto it = cte_stack.rbegin(); it != cte_stack.rend(); ++it) {
+        auto f = it->find(parts[0]);
+        if (f != it->end()) {
+          std::vector<BField> fields = f->second.fields;
+          for (size_t i = 0; i < fields.size() && i < col_aliases.size(); ++i)
+            fields[i].name = col_aliases[i];
+          std::string qname = has_alias ? alias : parts[0];
+          Scope scope;
+          scope.parent = outer;
+          scope.case_sensitive = case_sensitive;
+          for (auto& fl : fields) scope.entries.push_back({true, qname, fl});
+          return {{f->second.plan, fields}, scope};
+        }
+      }
+    }
+    const CTable* table = cat.resolve_table(parts);
+    std::vector<BField> fields;
+    for (auto& c : table->fields) fields.push_back({c.name, c.type, c.nullable});
+    int32_t scan = b.add(P_TABLESCAN, mk_fields(fields), 0, 0, 0.0,
+                         b.intern(table->schema_name), b.intern(table->name));
+    for (size_t i = 0; i < fields.size() && i < col_aliases.size(); ++i)
+      fields[i].name = col_aliases[i];
+    std::string qname = has_alias ? alias : table->name;
+    Scope scope;
+    scope.parent = outer;
+    scope.case_sensitive = case_sensitive;
+    for (auto& fl : fields) scope.entries.push_back({true, qname, fl});
+    return {{scan, fields}, scope};
+  }
+
+  std::pair<BPlan, Scope> bind_join(int32_t nid, const Scope* outer) {
+    const AstNode& n = a.n(nid);
+    auto ks = a.kids(nid);
+    auto [lp, lscope] = bind_table_ref(ks[0], outer);
+    auto [rp, rscope] = bind_table_ref(ks[1], outer);
+    int nleft = (int)lscope.entries.size();
+    std::string jt = upper(a.s(n.s0));
+    Scope scope;
+    scope.parent = outer;
+    scope.case_sensitive = case_sensitive;
+    scope.entries = lscope.entries;
+    scope.entries.insert(scope.entries.end(), rscope.entries.begin(),
+                         rscope.entries.end());
+    auto mk_out_fields = [&]() {
+      std::vector<BField> out;
+      for (size_t i = 0; i < scope.entries.size(); ++i) {
+        BField f = scope.entries[i].field;
+        if ((jt == "LEFT" || jt == "FULL") && (int)i >= nleft) f.nullable = true;
+        if ((jt == "RIGHT" || jt == "FULL") && (int)i < nleft) f.nullable = true;
+        out.push_back(f);
+      }
+      return out;
+    };
+    if (jt == "CROSS") {
+      auto fields = mk_out_fields();
+      int32_t plan = b.add(P_CROSSJOIN, concat({lp.id, rp.id}, mk_fields(fields)));
+      return {{plan, fields}, scope};
+    }
+    bool has_using = (n.flags & 2) != 0;
+    bool has_cond = (n.flags & 1) != 0;
+    std::vector<int32_t> rest(ks.begin() + 2, ks.end());
+    if (has_using) {
+      std::vector<std::string> using_cols;
+      for (int32_t k : rest)
+        if (a.n(k).kind == K_USING_COL) using_cols.push_back(a.s(a.n(k).s0));
+      if (using_cols.empty()) {
+        // NATURAL JOIN (parser encodes it as an empty USING list):
+        // shared names in right-entry order
+        std::set<std::string> lnames;
+        for (auto& e : lscope.entries) lnames.insert(e.field.name);
+        for (auto& e : rscope.entries)
+          if (lnames.count(e.field.name)) using_cols.push_back(e.field.name);
+      }
+      std::vector<int32_t> on_pairs;
+      for (auto& name : using_cols) {
+        auto lref = lscope.resolve({name});
+        auto rref = rscope.resolve({name});
+        if (!lref || !rref)
+          bind_error("USING column '" + name + "' not present on both sides");
+        int32_t le = mk_colref(lref->first, lref->second.name,
+                               lref->second.type, lref->second.nullable);
+        int32_t re = mk_colref(rref->first + nleft, rref->second.name,
+                               rref->second.type, rref->second.nullable);
+        on_pairs.push_back(b.add(P_ON_PAIR, {le, re}));
+      }
+      auto fields = mk_out_fields();
+      int32_t plan = b.add(
+          P_JOIN,
+          concat(concat({lp.id, rp.id}, mk_fields(fields)), on_pairs),
+          0, (int64_t)fields.size(), 0.0, b.intern(jt));
+      return {{plan, fields}, scope};
+    }
+    int32_t cond = has_cond ? bind_expr(rest[0], scope)
+                            : mk_lit_bool(true, TY_BOOLEAN);
+    auto [on_pairs, residual] = split_join_condition(cond, nleft);
+    auto fields = mk_out_fields();
+    Scope out_scope = scope;
+    if (jt == "LEFTSEMI" || jt == "LEFTANTI") {
+      fields.resize(nleft);
+      out_scope.entries.resize(nleft);
+    }
+    std::vector<int32_t> kids2 =
+        concat(concat({lp.id, rp.id}, mk_fields(fields)), on_pairs);
+    int32_t flags = 0;
+    if (residual >= 0) {
+      kids2.push_back(residual);
+      flags |= 1;
+    }
+    int32_t plan = b.add(P_JOIN, kids2, flags, (int64_t)fields.size(), 0.0,
+                         b.intern(jt));
+    return {{plan, fields}, out_scope};
+  }
+
+  void referenced_columns(int32_t e, std::set<int64_t>& out) {
+    const PNode& n = b.nodes[e];
+    if (n.kind == E_COLREF || n.kind == E_OUTERREF) out.insert(n.ival);
+    for (int32_t k : expr_children(e)) referenced_columns(k, out);
+  }
+
+  void flatten_and(int32_t e, std::vector<int32_t>& out) {
+    const PNode& n = b.nodes[e];
+    if (n.kind == E_SCALARFN && a_str(n.s0) == "and") {
+      for (int32_t k : b.kids(e)) flatten_and(k, out);
+      return;
+    }
+    out.push_back(e);
+  }
+
+  std::pair<std::vector<int32_t>, int32_t> split_join_condition(int32_t cond,
+                                                                int nleft) {
+    std::vector<int32_t> conjuncts;
+    flatten_and(cond, conjuncts);
+    std::vector<int32_t> on;
+    std::vector<int32_t> residual;
+    for (int32_t c : conjuncts) {
+      const PNode& n = b.nodes[c];
+      if (n.kind == E_LITERAL && (n.flags & 0xFF) == LT_BOOL && n.ival == 1)
+        continue;
+      if (n.kind == E_SCALARFN && a_str(n.s0) == "eq") {
+        auto ks = b.kids(c);
+        std::set<int64_t> lcols, rcols;
+        referenced_columns(ks[0], lcols);
+        referenced_columns(ks[1], rcols);
+        if (!lcols.empty() && !rcols.empty()) {
+          int64_t lmax = *lcols.rbegin(), lmin = *lcols.begin();
+          int64_t rmax = *rcols.rbegin(), rmin = *rcols.begin();
+          if (lmax < nleft && rmin >= nleft) {
+            on.push_back(b.add(P_ON_PAIR, {ks[0], ks[1]}));
+            continue;
+          }
+          if (rmax < nleft && lmin >= nleft) {
+            on.push_back(b.add(P_ON_PAIR, {ks[1], ks[0]}));
+            continue;
+          }
+        }
+      }
+      residual.push_back(c);
+    }
+    int32_t resid = -1;
+    if (!residual.empty()) {
+      resid = residual[0];
+      for (size_t i = 1; i < residual.size(); ++i)
+        resid = mk_fn("and", {resid, residual[i]}, TY_BOOLEAN);
+    }
+    return {on, resid};
+  }
+
+  // ---------------- query / set ops ----------------
+  std::pair<int32_t, std::vector<BField>> bind_query(int32_t sel_nid,
+                                                     const Scope* outer) {
+    // gather clause kids
+    std::vector<std::pair<std::string, int32_t>> ctes;
+    int32_t setop = -1;
+    std::vector<int32_t> order_items;
+    bool has_limit = false, has_offset = false;
+    int64_t limit = 0, offset = 0;
+    for (int32_t k : a.kids(sel_nid)) {
+      const AstNode& kn = a.n(k);
+      if (kn.kind == K_CTE) ctes.emplace_back(a.s(kn.s0), a.kids(k)[0]);
+      else if (kn.kind == K_SETOP) setop = k;
+      else if (kn.kind == K_ORDER_ITEM) order_items.push_back(k);
+      else if (kn.kind == K_LIMIT_CLAUSE) { has_limit = true; limit = kn.ival; }
+      else if (kn.kind == K_OFFSET_CLAUSE) { has_offset = true; offset = kn.ival; }
+    }
+    std::map<std::string, CtePlan> frame;
+    for (auto& [name, sub_nid] : ctes) {
+      cte_stack.push_back(frame);
+      std::pair<int32_t, std::vector<BField>> sub;
+      try {
+        sub = bind_query(sub_nid, outer);
+      } catch (...) {
+        cte_stack.pop_back();
+        throw;
+      }
+      cte_stack.pop_back();
+      // wrap in SubqueryAlias named after the CTE
+      std::vector<BField> fields = sub.second;
+      int32_t aliased = b.add(P_SUBQUERY_ALIAS,
+                              concat({sub.first}, mk_fields(fields)), 0, 0, 0.0,
+                              b.intern(name));
+      frame[name] = {aliased, fields};
+    }
+    cte_stack.push_back(frame);
+    std::pair<BPlan, Scope> out;
+    try {
+      bool has_values = false;
+      for (int32_t k : a.kids(sel_nid))
+        if (a.n(k).kind == K_VALUES_ROW) has_values = true;
+      if (setop < 0 && !has_values) {
+        out = bind_select_core(sel_nid, outer, &order_items);
+      } else {
+        out = bind_set_expr(sel_nid, outer);
+        if (!order_items.empty())
+          out.first = bind_order_by_output(out.first, order_items, out.second);
+      }
+      if (has_limit || has_offset) {
+        int32_t plan = b.add(
+            P_LIMIT, concat({out.first.id}, mk_fields(out.first.fields)),
+            has_limit ? 1 : 0, limit, 0.0,
+            b.intern(std::to_string(offset)));
+        out.first.id = plan;
+      }
+    } catch (...) {
+      cte_stack.pop_back();
+      throw;
+    }
+    cte_stack.pop_back();
+    return {out.first.id, out.first.fields};
+  }
+
+  std::pair<BPlan, Scope> bind_set_expr(int32_t sel_nid, const Scope* outer) {
+    auto left = bind_select_core(sel_nid, outer, nullptr);
+    int32_t setop = -1;
+    for (int32_t k : a.kids(sel_nid))
+      if (a.n(k).kind == K_SETOP) setop = k;
+    if (setop < 0) return left;
+    const AstNode& sn = a.n(setop);
+    std::string op = upper(a.s(sn.s0));
+    bool all = (sn.flags & 1) != 0;
+    int32_t rhs = a.kids(setop)[0];
+    // rhs with own CTEs / ORDER BY / LIMIT binds as a full query
+    bool rhs_full = false;
+    for (int32_t k : a.kids(rhs)) {
+      int kk = a.n(k).kind;
+      if (kk == K_CTE || kk == K_ORDER_ITEM || kk == K_LIMIT_CLAUSE)
+        rhs_full = true;
+    }
+    BPlan right;
+    if (rhs_full) {
+      auto [rp, rf] = bind_query(rhs, outer);
+      right = {rp, rf};
+    } else {
+      right = bind_set_expr(rhs, outer).first;
+    }
+    if (left.first.fields.size() != right.fields.size())
+      bind_error(op + " requires equal column counts (" +
+                 std::to_string(left.first.fields.size()) + " vs " +
+                 std::to_string(right.fields.size()) + ")");
+    std::vector<BField> fields;
+    for (size_t i = 0; i < left.first.fields.size(); ++i) {
+      const BField& lf = left.first.fields[i];
+      const BField& rf = right.fields[i];
+      fields.push_back({lf.name, promote(lf.type, rf.type),
+                        lf.nullable || rf.nullable});
+    }
+    int32_t plan;
+    if (op == "UNION") {
+      plan = b.add(P_UNION,
+                   concat(mk_fields(fields), {left.first.id, right.id}),
+                   all ? 1 : 0, (int64_t)fields.size());
+      if (!all)
+        plan = b.add(P_DISTINCT, concat({plan}, mk_fields(fields)));
+    } else if (op == "INTERSECT") {
+      plan = b.add(P_INTERSECT,
+                   concat({left.first.id, right.id}, mk_fields(fields)),
+                   all ? 1 : 0);
+    } else {
+      plan = b.add(P_EXCEPT,
+                   concat({left.first.id, right.id}, mk_fields(fields)),
+                   all ? 1 : 0);
+    }
+    Scope scope;
+    scope.parent = outer;
+    scope.case_sensitive = case_sensitive;
+    for (auto& f : fields) scope.entries.push_back({false, "", f});
+    return {{plan, fields}, scope};
+  }
+
+  BPlan bind_order_by_output(const BPlan& plan,
+                             const std::vector<int32_t>& order_items,
+                             const Scope& scope) {
+    std::vector<int32_t> keys;
+    for (int32_t item : order_items) {
+      const AstNode& on = a.n(item);
+      int32_t e_nid = a.kids(item)[0];
+      bool asc = (on.flags & 1) != 0;
+      bool has_nf = (on.flags & 2) != 0;
+      bool nf = (on.flags & 4) != 0;
+      const AstNode& en = a.n(e_nid);
+      if (en.kind == K_LIT_INT) {
+        int64_t idx = en.ival - 1;
+        if (idx < 0 || idx >= (int64_t)plan.fields.size())
+          bind_error("ORDER BY position " + std::to_string(en.ival) +
+                     " out of range");
+        const BField& f = plan.fields[idx];
+        keys.push_back(mk_sortkey(
+            mk_colref((int)idx, f.name, f.type, f.nullable), asc, has_nf, nf));
+        continue;
+      }
+      int32_t bound = bind_expr(e_nid, scope);
+      keys.push_back(mk_sortkey(bound, asc, has_nf, nf));
+    }
+    int32_t p = b.add(P_SORT, concat(concat({plan.id}, mk_fields(plan.fields)),
+                                     keys),
+                      0, (int64_t)plan.fields.size());
+    return {p, plan.fields};
+  }
+
+  // ---------------- select core ----------------
+  struct OrderSpec {
+    bool is_pos;
+    int pos;          // when is_pos
+    int32_t bound;    // when !is_pos (bound expr id)
+    bool asc, has_nf, nf;
+  };
+
+  std::pair<BPlan, Scope> bind_select_core(
+      int32_t sel_nid, const Scope* outer,
+      const std::vector<int32_t>* order_items_in) {
+    // named windows + select-alias maps are per-SELECT (saved/restored so
+    // nested subquery binds don't clobber the outer maps)
+    auto prev_windows = named_windows;
+    auto* prev_aliases = select_alias_asts;
+    std::map<std::string, int32_t> alias_map_storage;
+    try {
+      auto out = bind_select_core_inner(sel_nid, outer, order_items_in,
+                                        alias_map_storage);
+      named_windows = prev_windows;
+      select_alias_asts = prev_aliases;
+      return out;
+    } catch (...) {
+      named_windows = prev_windows;
+      select_alias_asts = prev_aliases;
+      throw;
+    }
+  }
+
+  std::pair<BPlan, Scope> bind_select_core_inner(
+      int32_t sel_nid, const Scope* outer,
+      const std::vector<int32_t>* order_items_in,
+      std::map<std::string, int32_t>& alias_map_storage) {
+    const AstNode& sn = a.n(sel_nid);
+    bool distinct = (sn.flags & 1) != 0;
+    int32_t from = -1, where = -1, having = -1;
+    std::vector<int32_t> proj_items, group_items, distribute_items, values_rows;
+    std::vector<std::pair<std::string, int32_t>> named_window_items;
+    for (int32_t k : a.kids(sel_nid)) {
+      const AstNode& kn = a.n(k);
+      switch (kn.kind) {
+        case K_PROJ_ITEM: proj_items.push_back(k); break;
+        case K_FROM_CLAUSE: from = a.kids(k)[0]; break;
+        case K_WHERE_CLAUSE: where = a.kids(k)[0]; break;
+        case K_GROUP_ITEM: group_items.push_back(a.kids(k)[0]); break;
+        case K_HAVING_CLAUSE: having = a.kids(k)[0]; break;
+        case K_DISTRIBUTE_ITEM: distribute_items.push_back(a.kids(k)[0]); break;
+        case K_VALUES_ROW: values_rows.push_back(k); break;
+        case K_NAMED_WINDOW:
+          named_window_items.emplace_back(a.s(kn.s0), a.kids(k)[0]);
+          break;
+        default: break;
+      }
+    }
+    if (!values_rows.empty()) return bind_values(values_rows, outer);
+
+    BPlan plan;
+    Scope scope;
+    scope.parent = outer;
+    scope.case_sensitive = case_sensitive;
+    if (from < 0) {
+      plan.id = b.add(P_EMPTY, {}, 1);  // produce_one_row
+    } else {
+      auto got = bind_table_ref(from, outer);
+      plan = got.first;
+      scope = got.second;
+    }
+    if (where >= 0) {
+      int32_t pred = coerce_bool(bind_expr(where, scope));
+      if (contains_kind(pred, E_GROUPING))
+        bind_error("GROUPING is not allowed in WHERE");
+      plan.id = b.add(P_FILTER,
+                      concat(concat({plan.id}, mk_fields(plan.fields)), {pred}),
+                      0, (int64_t)plan.fields.size());
+    }
+    named_windows.clear();
+    for (auto& [nm, spec] : named_window_items) named_windows[nm] = spec;
+    // select-alias AST map (folded), for GROUPING args / HAVING / ORDER BY
+    alias_map_storage.clear();
+    for (int32_t item : proj_items) {
+      const AstNode& in = a.n(item);
+      if (a.has_s(in.s0) && a.n(a.kids(item)[0]).kind != K_WILDCARD)
+        alias_map_storage.emplace(fold(a.s(in.s0)), a.kids(item)[0]);
+    }
+    select_alias_asts = &alias_map_storage;
+
+    // bind select items (wildcards expand against the scope)
+    std::vector<int32_t> proj_exprs;
+    std::vector<std::string> proj_names;
+    for (int32_t item : proj_items) {
+      const AstNode& in = a.n(item);
+      int32_t e_nid = a.kids(item)[0];
+      const AstNode& en = a.n(e_nid);
+      if (en.kind == K_WILDCARD) {
+        std::string qual;
+        bool has_qual = (en.flags & 1) != 0;
+        if (has_qual) {
+          auto qs = a.kids(e_nid);
+          qual = a.s(a.n(qs.back()).s0);
+        }
+        for (size_t i = 0; i < scope.entries.size(); ++i) {
+          const ScopeEntry& e = scope.entries[i];
+          if (has_qual && (!e.has_qual || e.qual != qual)) continue;
+          proj_exprs.push_back(mk_colref((int)i, e.field.name, e.field.type,
+                                         e.field.nullable));
+          proj_names.push_back(e.field.name);
+        }
+        continue;
+      }
+      int32_t bound = bind_expr(e_nid, scope);
+      proj_exprs.push_back(bound);
+      if (a.has_s(in.s0))
+        proj_names.push_back(a.s(in.s0));
+      else if (b.nodes[bound].kind == E_COLREF ||
+               b.nodes[bound].kind == E_OUTERREF)
+        proj_names.push_back(a_str(b.nodes[bound].s0));
+      else
+        proj_names.push_back(derive_name(e_nid));
+    }
+
+    // HAVING: select aliases substitute when they don't shadow a column
+    int32_t having_expr = -1;
+    if (having >= 0)
+      having_expr = bind_expr(having, scope, /*subst_active=*/true);
+
+    // ORDER BY specs
+    std::vector<OrderSpec> order_specs;
+    std::vector<int32_t> order_exprs;
+    if (order_items_in != nullptr) {
+      for (int32_t item : *order_items_in) {
+        const AstNode& on = a.n(item);
+        int32_t e_nid = a.kids(item)[0];
+        bool asc = (on.flags & 1) != 0;
+        bool has_nf = (on.flags & 2) != 0;
+        bool nf = (on.flags & 4) != 0;
+        const AstNode& en = a.n(e_nid);
+        if (en.kind == K_LIT_INT) {
+          int64_t idx = en.ival - 1;
+          if (idx < 0 || idx >= (int64_t)proj_exprs.size())
+            bind_error("ORDER BY position " + std::to_string(en.ival) +
+                       " out of range");
+          order_specs.push_back({true, (int)idx, -1, asc, has_nf, nf});
+          continue;
+        }
+        if (en.kind == K_IDENT && en.nchild == 1) {
+          std::string nm = a.s(a.n(a.kids(e_nid)[0]).s0);
+          std::vector<int> matches;
+          for (size_t i = 0; i < proj_names.size(); ++i)
+            if (fold(proj_names[i]) == fold(nm)) matches.push_back((int)i);
+          if (matches.size() == 1) {
+            order_specs.push_back({true, matches[0], -1, asc, has_nf, nf});
+            continue;
+          }
+        }
+        int32_t bound = bind_expr(e_nid, scope, /*subst_active=*/true);
+        order_specs.push_back({false, -1, bound, asc, has_nf, nf});
+        order_exprs.push_back(bound);
+      }
+    }
+    // GROUP BY alias matching mirrors Python's zip(q.projections,
+    // proj_exprs) positionally (including its wildcard misalignment)
+    std::vector<std::pair<int32_t, int32_t>> item_expr_zip;
+    for (size_t i = 0; i < proj_items.size() && i < proj_exprs.size(); ++i)
+      item_expr_zip.emplace_back(proj_items[i], proj_exprs[i]);
+
+    // aggregate context?
+    std::vector<int32_t> all_post = proj_exprs;
+    all_post.insert(all_post.end(), order_exprs.begin(), order_exprs.end());
+    bool any_agg = false;
+    for (int32_t e : all_post)
+      if (contains_kind(e, E_AGG)) any_agg = true;
+    if (having_expr >= 0 && contains_kind(having_expr, E_AGG)) any_agg = true;
+    std::vector<BField> post_fields;  // scope after aggregation
+    if (!group_items.empty() || any_agg) {
+      auto res = bind_aggregate(group_items, plan, scope, all_post,
+                                having_expr, proj_items, item_expr_zip);
+      plan = res.plan;
+      for (size_t i = 0; i < proj_exprs.size(); ++i)
+        proj_exprs[i] = res.rewritten[i];
+      for (size_t i = 0; i < order_exprs.size(); ++i)
+        order_exprs[i] = res.rewritten[proj_exprs.size() + i];
+      // re-point order_specs at the rewritten exprs
+      {
+        size_t oi = 0;
+        for (auto& spec : order_specs)
+          if (!spec.is_pos) spec.bound = order_exprs[oi++];
+      }
+      having_expr = res.having;
+      post_fields = res.post_fields;
+    } else {
+      for (int32_t e : all_post)
+        if (contains_kind(e, E_GROUPING))
+          bind_error("GROUPING requires a GROUP BY context");
+      if (having_expr >= 0 && contains_kind(having_expr, E_GROUPING))
+        bind_error("GROUPING requires a GROUP BY context");
+    }
+    if (having_expr >= 0) {
+      plan.id = b.add(
+          P_FILTER,
+          concat(concat({plan.id}, mk_fields(plan.fields)),
+                 {coerce_bool(having_expr)}),
+          0, (int64_t)plan.fields.size());
+      having_expr = -1;
+    }
+
+    // window functions (after grouping, SQL semantics)
+    std::vector<int32_t> all_exprs = proj_exprs;
+    all_exprs.insert(all_exprs.end(), order_exprs.begin(), order_exprs.end());
+    bool any_win = false;
+    for (int32_t e : all_exprs)
+      if (contains_kind(e, E_WINDOW)) any_win = true;
+    if (any_win) {
+      auto res = bind_window_plan(plan, all_exprs);
+      plan = res.first;
+      all_exprs = res.second;
+      for (size_t i = 0; i < proj_exprs.size(); ++i) proj_exprs[i] = all_exprs[i];
+      for (size_t i = 0; i < order_exprs.size(); ++i)
+        order_exprs[i] = all_exprs[proj_exprs.size() + i];
+      size_t oi = 0;
+      for (auto& spec : order_specs)
+        if (!spec.is_pos) spec.bound = order_exprs[oi++];
+    }
+
+    // final projection fields
+    std::vector<BField> fields;
+    for (size_t i = 0; i < proj_exprs.size(); ++i)
+      fields.push_back({proj_names[i], expr_type(b, proj_exprs[i]),
+                        expr_nullable(b, proj_exprs[i])});
+
+    // sort keys: reuse an output column when the order expr matches one
+    std::vector<int32_t> sort_keys;
+    std::vector<int32_t> extra_exprs;
+    for (auto& spec : order_specs) {
+      int idx;
+      if (spec.is_pos) {
+        idx = spec.pos;
+      } else {
+        idx = -1;
+        for (size_t i = 0; i < proj_exprs.size(); ++i)
+          if (b.eq(proj_exprs[i], spec.bound)) {
+            idx = (int)i;
+            break;
+          }
+        if (idx < 0) {
+          if (distinct)
+            bind_error(
+                "For SELECT DISTINCT, ORDER BY expressions must appear in the "
+                "select list");
+          idx = (int)(fields.size() + extra_exprs.size());
+          extra_exprs.push_back(spec.bound);
+        }
+      }
+      BField f;
+      if (idx < (int)fields.size()) {
+        f = fields[idx];
+      } else {
+        int32_t x = extra_exprs[idx - fields.size()];
+        f = {"__sort" + std::to_string(idx - fields.size()), expr_type(b, x),
+             expr_nullable(b, x)};
+      }
+      sort_keys.push_back(mk_sortkey(
+          mk_colref(idx, f.name, f.type, f.nullable), spec.asc, spec.has_nf,
+          spec.nf));
+    }
+
+    int32_t out_plan;
+    std::vector<BField> out_fields = fields;
+    if (!extra_exprs.empty()) {
+      std::vector<BField> ext_fields = fields;
+      for (size_t j = 0; j < extra_exprs.size(); ++j)
+        ext_fields.push_back({"__sort" + std::to_string(j),
+                              expr_type(b, extra_exprs[j]),
+                              expr_nullable(b, extra_exprs[j])});
+      std::vector<int32_t> all2 = proj_exprs;
+      all2.insert(all2.end(), extra_exprs.begin(), extra_exprs.end());
+      int32_t proj = b.add(
+          P_PROJECTION,
+          concat(concat({plan.id}, mk_fields(ext_fields)), all2), 0,
+          (int64_t)ext_fields.size());
+      int32_t sorted = b.add(
+          P_SORT,
+          concat(concat({proj}, mk_fields(ext_fields)), sort_keys), 0,
+          (int64_t)ext_fields.size());
+      std::vector<int32_t> final_refs;
+      for (size_t i = 0; i < fields.size(); ++i)
+        final_refs.push_back(mk_colref((int)i, fields[i].name, fields[i].type,
+                                       fields[i].nullable));
+      out_plan = b.add(
+          P_PROJECTION,
+          concat(concat({sorted}, mk_fields(fields)), final_refs), 0,
+          (int64_t)fields.size());
+    } else {
+      out_plan = b.add(
+          P_PROJECTION,
+          concat(concat({plan.id}, mk_fields(fields)), proj_exprs), 0,
+          (int64_t)fields.size());
+      if (distinct)
+        out_plan = b.add(P_DISTINCT, concat({out_plan}, mk_fields(fields)));
+      if (!sort_keys.empty())
+        out_plan = b.add(
+            P_SORT, concat(concat({out_plan}, mk_fields(fields)), sort_keys),
+            0, (int64_t)fields.size());
+    }
+    Scope scope_out;
+    scope_out.parent = outer;
+    scope_out.case_sensitive = case_sensitive;
+    for (auto& f : fields) scope_out.entries.push_back({false, "", f});
+    if (!distribute_items.empty()) {
+      std::vector<int32_t> keys;
+      for (int32_t d : distribute_items) keys.push_back(bind_expr(d, scope_out));
+      out_plan = b.add(
+          P_DISTRIBUTE_BY,
+          concat(concat({out_plan}, mk_fields(fields)), keys), 0,
+          (int64_t)fields.size());
+    }
+    return {{out_plan, fields}, scope_out};
+  }
+
+  std::pair<BPlan, Scope> bind_values(const std::vector<int32_t>& rows,
+                                      const Scope* outer) {
+    Scope empty;
+    empty.case_sensitive = case_sensitive;
+    std::vector<std::vector<int32_t>> bound;
+    for (int32_t row : rows) {
+      std::vector<int32_t> r;
+      for (int32_t e : a.kids(row)) r.push_back(bind_expr(e, empty));
+      bound.push_back(std::move(r));
+    }
+    size_t ncols = bound[0].size();
+    std::vector<BField> fields;
+    for (size_t i = 0; i < ncols; ++i) {
+      int t = expr_type(b, bound[0][i]);
+      for (size_t rr = 1; rr < bound.size(); ++rr)
+        t = promote(t, expr_type(b, bound[rr][i]));
+      fields.push_back({"column" + std::to_string(i + 1), t, true});
+    }
+    std::vector<int32_t> row_nodes;
+    for (auto& r : bound) {
+      std::vector<int32_t> cells;
+      for (size_t i = 0; i < ncols; ++i) cells.push_back(cast_to(r[i], fields[i].type));
+      row_nodes.push_back(b.add(P_VALUES_ROW, cells));
+    }
+    int32_t plan = b.add(P_VALUES, concat(mk_fields(fields), row_nodes), 0,
+                         (int64_t)fields.size());
+    Scope scope;
+    scope.case_sensitive = case_sensitive;
+    for (auto& f : fields) scope.entries.push_back({false, "", f});
+    (void)outer;
+    return {{plan, fields}, scope};
+  }
+
+  // ---------------- aggregate ----------------
+  struct AggResult {
+    BPlan plan;
+    std::vector<int32_t> rewritten;
+    int32_t having;
+    std::vector<BField> post_fields;
+  };
+
+  AggResult bind_aggregate(
+      const std::vector<int32_t>& group_items_in, const BPlan& input,
+      const Scope& scope, const std::vector<int32_t>& post_exprs_in,
+      int32_t having_expr, const std::vector<int32_t>& proj_items,
+      const std::vector<std::pair<int32_t, int32_t>>& item_expr_zip) {
+    // split GROUPING SETS / ROLLUP / CUBE from plain group items
+    std::vector<int32_t> plain_asts;
+    int32_t construct = -1;
+    for (int32_t ge : group_items_in) {
+      int k = a.n(ge).kind;
+      if (k == K_GROUPING_SETS || k == K_ROLLUP || k == K_CUBE)
+        construct = ge;
+      else
+        plain_asts.push_back(ge);
+    }
+    std::vector<int32_t> group_asts = plain_asts;
+    std::vector<std::vector<int>> sets;
+    bool has_sets = false;
+    if (construct >= 0) {
+      has_sets = true;
+      int n_plain = (int)plain_asts.size();
+      std::vector<int32_t> extra;
+      std::vector<std::vector<int>> raw_sets;
+      int ck = a.n(construct).kind;
+      if (ck == K_ROLLUP) {
+        for (int32_t e : a.kids(construct)) extra.push_back(e);
+        for (int k = (int)extra.size(); k >= 0; --k) {
+          std::vector<int> s;
+          for (int i = 0; i < k; ++i) s.push_back(i);
+          raw_sets.push_back(s);
+        }
+      } else if (ck == K_CUBE) {
+        for (int32_t e : a.kids(construct)) extra.push_back(e);
+        int m = (int)extra.size();
+        for (int mask = (1 << m) - 1; mask >= 0; --mask) {
+          std::vector<int> s;
+          for (int i = 0; i < m; ++i)
+            if (mask & (1 << i)) s.push_back(i);
+          raw_sets.push_back(s);
+        }
+      } else {  // GROUPING SETS: dedupe expressions structurally via binding
+        std::vector<int32_t> bound_cache;  // bound ids, parallel to extra
+        for (int32_t sn2 : a.kids(construct)) {
+          std::vector<int> idxs;
+          for (int32_t e : a.kids(sn2)) {
+            int32_t bnd = bind_expr(e, scope);
+            int found = -1;
+            for (size_t i = 0; i < bound_cache.size(); ++i)
+              if (b.eq(bound_cache[i], bnd)) {
+                found = (int)i;
+                break;
+              }
+            if (found < 0) {
+              found = (int)extra.size();
+              bound_cache.push_back(bnd);
+              extra.push_back(e);
+            }
+            idxs.push_back(found);
+          }
+          raw_sets.push_back(idxs);
+        }
+      }
+      group_asts = plain_asts;
+      group_asts.insert(group_asts.end(), extra.begin(), extra.end());
+      for (auto& s : raw_sets) {
+        std::vector<int> full;
+        for (int i = 0; i < n_plain; ++i) full.push_back(i);
+        for (int i : s) full.push_back(n_plain + i);
+        sets.push_back(full);
+      }
+    }
+
+    // bind group exprs (positions / select aliases / plain binds)
+    std::vector<int32_t> group_exprs;
+    for (int32_t ge : group_asts) {
+      const AstNode& gn = a.n(ge);
+      if (gn.kind == K_LIT_INT) {
+        int64_t idx = gn.ival - 1;
+        if (idx < 0 || idx >= (int64_t)post_exprs_in.size())
+          bind_error("GROUP BY position " + std::to_string(gn.ival) +
+                     " out of range");
+        group_exprs.push_back(post_exprs_in[idx]);
+        continue;
+      }
+      if (gn.kind == K_IDENT && gn.nchild == 1) {
+        std::string nm = a.s(a.n(a.kids(ge)[0]).s0);
+        bool resolved = scope.resolve({nm}).has_value();
+        if (!resolved) {
+          bool matched = false;
+          for (auto& [item, bound] : item_expr_zip) {
+            const AstNode& in = a.n(item);
+            if (a.has_s(in.s0) && a.s(in.s0) == nm) {
+              group_exprs.push_back(bound);
+              matched = true;
+              break;
+            }
+          }
+          if (matched) continue;
+        }
+      }
+      group_exprs.push_back(bind_expr(ge, scope));
+    }
+
+    // collect aggregates (dedup by equality, discovery order)
+    std::vector<int32_t> agg_calls;
+    auto collect = [&](int32_t e) {
+      std::vector<int32_t> found;
+      collect_kind(e, E_AGG, found);
+      for (int32_t x : found) {
+        bool seen = false;
+        for (int32_t y : agg_calls)
+          if (b.eq(x, y)) {
+            seen = true;
+            break;
+          }
+        if (!seen) agg_calls.push_back(x);
+      }
+    };
+    for (int32_t e : post_exprs_in) collect(e);
+    if (having_expr >= 0) collect(having_expr);
+
+    std::vector<BField> group_fields;
+    for (size_t i = 0; i < group_exprs.size(); ++i)
+      group_fields.push_back({derive_group_name(group_exprs[i], (int)i),
+                              expr_type(b, group_exprs[i]),
+                              expr_nullable(b, group_exprs[i])});
+    std::vector<BField> agg_fields;
+    for (size_t i = 0; i < agg_calls.size(); ++i)
+      agg_fields.push_back({"__agg" + std::to_string(i),
+                            expr_type(b, agg_calls[i]), true});
+
+    // GROUPING(...) markers
+    std::vector<int32_t> grouping_exprs;
+    auto collect_grouping = [&](int32_t e) {
+      std::vector<int32_t> found;
+      collect_kind(e, E_GROUPING, found);
+      for (int32_t x : found) {
+        bool seen = false;
+        for (int32_t y : grouping_exprs)
+          if (b.eq(x, y)) {
+            seen = true;
+            break;
+          }
+        if (!seen) grouping_exprs.push_back(x);
+      }
+    };
+    for (int32_t e : post_exprs_in) collect_grouping(e);
+    if (having_expr >= 0) collect_grouping(having_expr);
+    for (int32_t ac : agg_calls)
+      for (int32_t kid : b.kids(ac))
+        if (contains_kind(kid, E_GROUPING))
+          bind_error("GROUPING cannot appear inside an aggregate");
+    for (int32_t ge : group_exprs)
+      if (contains_kind(ge, E_GROUPING))
+        bind_error("GROUPING cannot appear in GROUP BY");
+
+    auto grouping_value = [&](int32_t g, const std::vector<int>& s) -> int64_t {
+      int64_t val = 0;
+      for (int32_t arg : b.kids(g)) {
+        int gi = -1;
+        for (size_t i = 0; i < group_exprs.size(); ++i)
+          if (b.eq(group_exprs[i], arg)) {
+            gi = (int)i;
+            break;
+          }
+        if (gi < 0)
+          bind_error("GROUPING argument must be a grouping expression");
+        bool in_set = std::find(s.begin(), s.end(), gi) != s.end();
+        val = (val << 1) | (in_set ? 0 : 1);
+      }
+      return val;
+    };
+
+    std::vector<BField> out_fields;
+    // grouping marker -> replacement expr id
+    std::vector<std::pair<int32_t, int32_t>> grouping_map;
+    int32_t agg_plan;
+    if (!has_sets) {
+      out_fields = group_fields;
+      out_fields.insert(out_fields.end(), agg_fields.begin(), agg_fields.end());
+      std::vector<int> all_set;
+      for (size_t i = 0; i < group_exprs.size(); ++i) all_set.push_back((int)i);
+      for (int32_t g : grouping_exprs) {
+        grouping_value(g, all_set);  // validate args
+        grouping_map.emplace_back(g, mk_lit_int(0, TY_INTEGER));
+      }
+      std::vector<int32_t> kids2 = {input.id};
+      for (auto fid : mk_fields(out_fields)) kids2.push_back(fid);
+      for (int32_t ge : group_exprs) kids2.push_back(ge);
+      for (int32_t ac : agg_calls) kids2.push_back(ac);
+      agg_plan = b.add(P_AGGREGATE, kids2, (int32_t)group_exprs.size(),
+                       (int64_t)out_fields.size());
+    } else {
+      // union of one aggregate per grouping set, NULL-padded
+      for (auto& f : group_fields)
+        out_fields.push_back({f.name, f.type, true});
+      out_fields.insert(out_fields.end(), agg_fields.begin(), agg_fields.end());
+      for (size_t j = 0; j < grouping_exprs.size(); ++j)
+        out_fields.push_back({"__grouping" + std::to_string(j), TY_INTEGER,
+                              false});
+      std::vector<int32_t> branches;
+      for (auto& s : sets) {
+        std::vector<int32_t> sub_groups;
+        std::vector<BField> sub_fields;
+        for (int gi : s) {
+          sub_groups.push_back(group_exprs[gi]);
+          sub_fields.push_back(group_fields[gi]);
+        }
+        sub_fields.insert(sub_fields.end(), agg_fields.begin(),
+                          agg_fields.end());
+        std::vector<int32_t> akids = {input.id};
+        for (auto fid : mk_fields(sub_fields)) akids.push_back(fid);
+        for (int32_t gexp : sub_groups) akids.push_back(gexp);
+        for (int32_t ac : agg_calls) akids.push_back(ac);
+        int32_t sub_agg = b.add(P_AGGREGATE, akids, (int32_t)sub_groups.size(),
+                                (int64_t)sub_fields.size());
+        std::vector<int32_t> proj;
+        for (size_t gi = 0; gi < group_fields.size(); ++gi) {
+          auto pos_it = std::find(s.begin(), s.end(), (int)gi);
+          if (pos_it != s.end()) {
+            int pos = (int)(pos_it - s.begin());
+            proj.push_back(mk_colref(pos, group_fields[gi].name,
+                                     group_fields[gi].type, true));
+          } else {
+            proj.push_back(mk_cast(mk_lit_null(), group_fields[gi].type));
+          }
+        }
+        for (size_t ai = 0; ai < agg_fields.size(); ++ai)
+          proj.push_back(mk_colref((int)(s.size() + ai), agg_fields[ai].name,
+                                   agg_fields[ai].type, true));
+        for (int32_t g : grouping_exprs)
+          proj.push_back(mk_lit_int(grouping_value(g, s), TY_INTEGER));
+        branches.push_back(b.add(
+            P_PROJECTION,
+            concat(concat({sub_agg}, mk_fields(out_fields)), proj), 0,
+            (int64_t)out_fields.size()));
+      }
+      agg_plan = b.add(P_UNION, concat(mk_fields(out_fields), branches), 1,
+                       (int64_t)out_fields.size());
+      int base = (int)(group_fields.size() + agg_fields.size());
+      for (size_t j = 0; j < grouping_exprs.size(); ++j)
+        grouping_map.emplace_back(
+            grouping_exprs[j],
+            mk_colref(base + (int)j, "__grouping" + std::to_string(j),
+                      TY_INTEGER, false));
+    }
+
+    // rewrite post-agg expressions: group/agg subtrees -> column refs
+    std::vector<std::pair<int32_t, int32_t>> mapping;
+    for (size_t i = 0; i < group_exprs.size(); ++i) {
+      bool dup = false;
+      for (auto& [k, v] : mapping)
+        if (b.eq(k, group_exprs[i])) {
+          dup = true;
+          break;
+        }
+      if (!dup)
+        mapping.emplace_back(
+            group_exprs[i],
+            mk_colref((int)i, group_fields[i].name,
+                      expr_type(b, group_exprs[i]),
+                      expr_nullable(b, group_exprs[i])));
+    }
+    for (size_t i = 0; i < agg_calls.size(); ++i) {
+      // agg mapping overrides any equal earlier entry (dict assignment)
+      bool replaced = false;
+      int32_t ref = mk_colref((int)(group_exprs.size() + i),
+                              agg_fields[i].name, expr_type(b, agg_calls[i]),
+                              true);
+      for (auto& kv : mapping)
+        if (b.eq(kv.first, agg_calls[i])) {
+          kv.second = ref;
+          replaced = true;
+          break;
+        }
+      if (!replaced) mapping.emplace_back(agg_calls[i], ref);
+    }
+
+    std::function<int32_t(int32_t)> rewrite = [&](int32_t e) -> int32_t {
+      if (b.nodes[e].kind == E_GROUPING) {
+        for (auto& [k, v] : grouping_map)
+          if (b.eq(k, e)) return v;
+        bind_error("GROUPING argument must be a grouping expression");
+      }
+      for (auto& [k, v] : mapping)
+        if (b.eq(k, e)) return v;
+      auto kids2 = expr_children(e);
+      if (kids2.empty()) {
+        if (b.nodes[e].kind == E_COLREF || b.nodes[e].kind == E_OUTERREF)
+          bind_error("Column '" + a_str(b.nodes[e].s0) +
+                     "' must appear in the GROUP BY clause or be used in an "
+                     "aggregate function");
+        return e;
+      }
+      std::vector<int32_t> nk;
+      for (int32_t k : kids2) nk.push_back(rewrite(k));
+      return with_expr_children(e, nk);
+    };
+
+    AggResult res;
+    res.plan = {agg_plan, out_fields};
+    for (int32_t e : post_exprs_in) res.rewritten.push_back(rewrite(e));
+    res.having = having_expr >= 0 ? rewrite(having_expr) : -1;
+    res.post_fields = out_fields;
+    (void)proj_items;
+    return res;
+  }
+
+  // ---------------- window plan ----------------
+  std::pair<BPlan, std::vector<int32_t>> bind_window_plan(
+      const BPlan& input, const std::vector<int32_t>& exprs) {
+    std::vector<int32_t> win_calls;
+    for (int32_t e : exprs) {
+      std::vector<int32_t> found;
+      collect_kind(e, E_WINDOW, found);
+      for (int32_t x : found) {
+        bool seen = false;
+        for (int32_t y : win_calls)
+          if (b.eq(x, y)) {
+            seen = true;
+            break;
+          }
+        if (!seen) win_calls.push_back(x);
+      }
+    }
+    int base = (int)input.fields.size();
+    std::vector<BField> fields = input.fields;
+    for (size_t i = 0; i < win_calls.size(); ++i)
+      fields.push_back({"__win" + std::to_string(i),
+                        expr_type(b, win_calls[i]), true});
+    std::vector<int32_t> kids2 = {input.id};
+    for (auto fid : mk_fields(fields)) kids2.push_back(fid);
+    for (int32_t w : win_calls) kids2.push_back(w);
+    int32_t win_plan = b.add(P_WINDOW, kids2, 0, (int64_t)fields.size());
+
+    std::function<int32_t(int32_t)> rewrite = [&](int32_t e) -> int32_t {
+      for (size_t i = 0; i < win_calls.size(); ++i)
+        if (b.eq(win_calls[i], e))
+          return mk_colref(base + (int)i, "__win" + std::to_string(i),
+                           expr_type(b, e), true);
+      auto kids3 = expr_children(e);
+      if (kids3.empty()) return e;
+      std::vector<int32_t> nk;
+      for (int32_t k : kids3) nk.push_back(rewrite(k));
+      return with_expr_children(e, nk);
+    };
+    std::vector<int32_t> out;
+    for (int32_t e : exprs) out.push_back(rewrite(e));
+    return {{win_plan, fields}, out};
+  }
+
+  // ---------------- statements ----------------
+  // copy an AST kwargs subtree (K_KWARGS/K_KV/K_LIT_*/K_KWLIST) into the
+  // plan buffer (P_KWARGS/P_KV/P_KW_*)
+  int32_t copy_kwargs(int32_t nid) {
+    const AstNode& n = a.n(nid);
+    switch (n.kind) {
+      case K_KWARGS: {
+        std::vector<int32_t> kvs;
+        for (int32_t kv : a.kids(nid)) {
+          const AstNode& kn = a.n(kv);
+          kvs.push_back(b.add(P_KV, {copy_kwargs(a.kids(kv)[0])}, 0, 0, 0.0,
+                              b.intern(a.s(kn.s0))));
+        }
+        return b.add(P_KWARGS, kvs);
+      }
+      case K_KWLIST: {
+        std::vector<int32_t> items;
+        for (int32_t k : a.kids(nid)) items.push_back(copy_kwargs(k));
+        return b.add(P_KWLIST, items);
+      }
+      case K_LIT_STR: return b.add(P_KW_STR, {}, 0, 0, 0.0, b.intern(a.s(n.s0)));
+      case K_LIT_INT: return b.add(P_KW_INT, {}, 0, n.ival);
+      case K_LIT_FLOAT: return b.add(P_KW_FLOAT, {}, 0, 0, n.dval);
+      case K_LIT_BOOL: return b.add(P_KW_BOOL, {}, 0, n.ival);
+      case K_LIT_NULL: return b.add(P_KW_NULL, {});
+    }
+    throw Unsupported{};
+  }
+
+  std::vector<int32_t> mk_qname_kids(int32_t nid) {
+    std::vector<int32_t> parts;
+    for (int32_t p : a.kids(nid))
+      parts.push_back(b.add(P_PART, {}, 0, 0, 0.0, b.intern(a.s(a.n(p).s0))));
+    return parts;
+  }
+
+  int32_t bind_statement(int32_t sid) {
+    const AstNode& n = a.n(sid);
+    auto ks = a.kids(sid);
+    bool ine = (n.flags & 1) != 0;
+    bool orr = (n.flags & 2) != 0;
+    int32_t st_flags = (ine ? 1 : 0) | (orr ? 2 : 0);
+    switch (n.kind) {
+      case K_QUERY_STMT: {
+        auto [plan, fields] = bind_query(ks[0], nullptr);
+        (void)fields;
+        return plan;
+      }
+      case K_EXPLAIN_STMT: {
+        auto [plan, fields] = bind_query(ks[0], nullptr);
+        (void)fields;
+        std::vector<BField> efields{{"PLAN", TY_VARCHAR, true}};
+        return b.add(P_EXPLAIN, concat({plan}, mk_fields(efields)),
+                     (n.flags & 1) ? 1 : 0, 1);
+      }
+      case K_CREATE_TABLE_WITH:
+        return b.add(P_CREATE_TABLE,
+                     concat(mk_qname_kids(ks[0]), {copy_kwargs(ks[1])}),
+                     st_flags);
+      case K_CREATE_TABLE_AS: {
+        auto [plan, fields] = bind_query(ks[1], nullptr);
+        (void)fields;
+        int32_t fl = st_flags | ((n.flags & 4) ? 4 : 0);
+        return b.add(P_CREATE_MEMORY_TABLE,
+                     concat(mk_qname_kids(ks[0]), {plan}), fl,
+                     (int64_t)a.n(ks[0]).nchild);
+      }
+      case K_DROP_TABLE:
+        return b.add(P_DROP_TABLE, mk_qname_kids(ks[0]), (n.flags & 1) ? 1 : 0);
+      case K_CREATE_SCHEMA:
+        return b.add(P_CREATE_SCHEMA, {}, st_flags, 0, 0.0,
+                     b.intern(a.s(n.s0)));
+      case K_DROP_SCHEMA:
+        return b.add(P_DROP_SCHEMA, {}, (n.flags & 1) ? 1 : 0, 0, 0.0,
+                     b.intern(a.s(n.s0)));
+      case K_USE_SCHEMA:
+        return b.add(P_USE_SCHEMA, {}, 0, 0, 0.0, b.intern(a.s(n.s0)));
+      case K_ALTER_SCHEMA:
+        return b.add(P_ALTER_SCHEMA, {}, 0, 0, 0.0, b.intern(a.s(n.s0)),
+                     b.intern(a.s(n.s1)));
+      case K_ALTER_TABLE:
+        return b.add(P_ALTER_TABLE, mk_qname_kids(ks[0]),
+                     (n.flags & 1) ? 1 : 0, 0, 0.0, b.intern(a.s(n.s0)));
+      case K_SHOW_SCHEMAS: {
+        std::vector<BField> f{{"Schema", TY_VARCHAR, true}};
+        return b.add(P_SHOW_SCHEMAS, mk_fields(f),
+                     a.has_s(n.s0) ? 1 : 0, 0, 0.0,
+                     a.has_s(n.s0) ? b.intern(a.s(n.s0)) : -1);
+      }
+      case K_SHOW_TABLES: {
+        std::vector<BField> f{{"Table", TY_VARCHAR, true}};
+        return b.add(P_SHOW_TABLES, mk_fields(f), a.has_s(n.s0) ? 1 : 0, 0,
+                     0.0, a.has_s(n.s0) ? b.intern(a.s(n.s0)) : -1);
+      }
+      case K_SHOW_COLUMNS: {
+        std::vector<BField> f{{"Column", TY_VARCHAR, true},
+                              {"Type", TY_VARCHAR, true},
+                              {"Extra", TY_VARCHAR, true},
+                              {"Comment", TY_VARCHAR, true}};
+        return b.add(P_SHOW_COLUMNS,
+                     concat(mk_fields(f), mk_qname_kids(ks[0])), 0, 4);
+      }
+      case K_SHOW_MODELS: {
+        std::vector<BField> f{{"Model", TY_VARCHAR, true}};
+        return b.add(P_SHOW_MODELS, mk_fields(f), a.has_s(n.s0) ? 1 : 0, 0,
+                     0.0, a.has_s(n.s0) ? b.intern(a.s(n.s0)) : -1);
+      }
+      case K_ANALYZE_TABLE: {
+        std::vector<int32_t> cols;
+        for (size_t i = 1; i < ks.size(); ++i)
+          cols.push_back(b.add(P_PART, {}, 1, 0, 0.0,
+                               b.intern(a.s(a.n(ks[i]).s0))));
+        // table parts have flags 0, column parts flags 1
+        return b.add(P_ANALYZE_TABLE, concat(mk_qname_kids(ks[0]), cols));
+      }
+      case K_CREATE_MODEL: {
+        auto [plan, fields] = bind_query(ks[2], nullptr);
+        (void)fields;
+        return b.add(P_CREATE_MODEL,
+                     concat(mk_qname_kids(ks[0]),
+                            {copy_kwargs(ks[1]), plan}),
+                     st_flags, (int64_t)a.n(ks[0]).nchild);
+      }
+      case K_DROP_MODEL:
+        return b.add(P_DROP_MODEL, mk_qname_kids(ks[0]), (n.flags & 1) ? 1 : 0);
+      case K_DESCRIBE_MODEL: {
+        std::vector<BField> f{{"Params", TY_VARCHAR, true},
+                              {"Value", TY_VARCHAR, true}};
+        return b.add(P_DESCRIBE_MODEL,
+                     concat(mk_fields(f), mk_qname_kids(ks[0])), 0, 2);
+      }
+      case K_EXPORT_MODEL:
+        return b.add(P_EXPORT_MODEL,
+                     concat(mk_qname_kids(ks[0]), {copy_kwargs(ks[1])}), 0,
+                     (int64_t)a.n(ks[0]).nchild);
+      case K_CREATE_EXPERIMENT: {
+        auto [plan, fields] = bind_query(ks[2], nullptr);
+        (void)fields;
+        return b.add(P_CREATE_EXPERIMENT,
+                     concat(mk_qname_kids(ks[0]),
+                            {copy_kwargs(ks[1]), plan}),
+                     st_flags, (int64_t)a.n(ks[0]).nchild);
+      }
+    }
+    throw Unsupported{};
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// rc: 0 = ok (*out = flat plan buffer); 1 = unsupported (Python fallback);
+// 2 = bind error (*out = utf-8 message); 3 = parse error (*out = int64 pos +
+// msg, same payload as dsql_parse rc 2).
+int32_t dsql_bind(const char* sql, int64_t n, const uint8_t* catalog_buf,
+                  int64_t catalog_len, uint8_t** out, int64_t* out_len) {
+  *out = nullptr;
+  *out_len = 0;
+  uint8_t* ast_buf = nullptr;
+  int64_t ast_len = 0;
+  int32_t prc = dsql_parse(sql, n, &ast_buf, &ast_len);
+  if (prc == 1) return 1;
+  if (prc == 2) {  // parse error: forward payload as rc 3
+    *out = ast_buf;
+    *out_len = ast_len;
+    return 3;
+  }
+  Ast ast;
+  bool ok = ast.load(ast_buf, ast_len);
+  dsql_buf_free(ast_buf);
+  if (!ok) return 1;
+  try {
+    Catalog cat;
+    if (!cat.load(catalog_buf, catalog_len)) return 1;
+    auto stmts = ast.kids(ast.root);
+    if (stmts.size() != 1) return 1;  // one statement per bind call
+    PBuilder pb;
+    Binder binder(ast, cat, pb);
+    int32_t root = binder.bind_statement(stmts[0]);
+    uint8_t* buf = pb.serialize(root, out_len);
+    if (!buf) return 1;
+    *out = buf;
+    return 0;
+  } catch (const BindErr& e) {
+    // payload: 1 error-class byte (0 BindError / 1 KeyError) + utf-8 message
+    uint8_t* buf = static_cast<uint8_t*>(std::malloc(1 + e.msg.size()));
+    if (!buf) return 1;
+    buf[0] = static_cast<uint8_t>(e.klass);
+    std::memcpy(buf + 1, e.msg.data(), e.msg.size());
+    *out = buf;
+    *out_len = static_cast<int64_t>(1 + e.msg.size());
+    return 2;
+  } catch (const Unsupported&) {
+    return 1;
+  } catch (...) {
+    return 1;
+  }
+}
+
+int32_t dsql_binder_abi_version() { return 1; }
+
+}  // extern "C"
