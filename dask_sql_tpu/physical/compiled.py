@@ -125,11 +125,15 @@ def fetch_packed(packed, domain: int) -> Tuple[np.ndarray, np.ndarray]:
 
     Returns (host_matrix[:, present], present) as numpy arrays; row 0 of the
     matrix is the group-present indicator."""
+    from ..utils import count_d2h
+
     if domain <= HOST_PULL_DOMAIN:
+        count_d2h()
         host = np.asarray(jax.device_get(packed))
         present = np.nonzero(host[0] != 0.0)[0]
         return host[:, present], present
     present_dev = jnp.nonzero(packed[0] != 0.0)[0]
+    count_d2h()
     host, present = (np.asarray(a) for a in jax.device_get(
         (packed[:, present_dev], present_dev)))
     return host, present

@@ -1004,17 +1004,21 @@ class _PlanDecoder:
         raise ValueError(f"bad plan kind {kind}")
 
 
-def native_bind(sql: str, catalog):
+def native_bind(sql: str, catalog, cat_buf: Optional[bytes] = None,
+                strict: bool = False):
     """Parse + bind via the C++ binder; returns a LogicalPlan, or None when
     the native path is unavailable / declines (Python binder fallback).
-    Raises BindError for genuine bind errors and ParsingException for syntax
-    errors — same exception surface as the Python binder."""
+    Raises BindError for genuine bind errors — same exception surface as the
+    Python binder.  A native-parser rejection (the Python parser already
+    accepted this text upstream) falls back unless `strict`, where it raises
+    ParsingException."""
     lib = _get_binder_lib()
     if lib is None:
         return None
     raw = sql.encode("utf-8")
     try:
-        cat_buf = encode_catalog(catalog)
+        if cat_buf is None:
+            cat_buf = encode_catalog(catalog)
     except KeyError:  # exotic type in a table/function signature
         return None
     out = ctypes.POINTER(ctypes.c_uint8)()
@@ -1036,6 +1040,8 @@ def native_bind(sql: str, catalog):
             raise KeyError(msg)
         raise BindError(msg)
     if rc == 3:
+        if not strict:
+            return None  # parser lockstep gap: Python binder handles it
         import struct
 
         from .parser import ParsingException

@@ -62,3 +62,17 @@ class LoggableDataFrame:
         return f"{type(df).__name__}"
 
     __repr__ = __str__
+
+
+# ---------------------------------------------------------------------------
+# device-transfer accounting (perf instrumentation, VERDICT r4 #1)
+# ---------------------------------------------------------------------------
+#: device->host transfers made through the engine's own seams (the packed
+#: result pull, host_read, table materialization).  On a tunneled TPU each
+#: transfer is a round trip, so the per-query delta is the number the Q1
+#: perf work drives toward 1.  Reset with `TRANSFER_STATS.clear()`.
+TRANSFER_STATS: Dict[str, int] = {"d2h": 0}
+
+
+def count_d2h(n: int = 1) -> None:
+    TRANSFER_STATS["d2h"] = TRANSFER_STATS.get("d2h", 0) + n

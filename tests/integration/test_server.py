@@ -98,14 +98,44 @@ def test_server_concurrent_queries(server):
         assert vals == [i * 1, i * 2, i * 3]
 
 
-def test_visualize_writes_plan(c, tmp_path):
-    path = str(tmp_path / "plan")
+def test_visualize_text_fallback_without_renderer(c, tmp_path, monkeypatch):
+    """Without matplotlib the named API still produces a plan artifact."""
+    import dask_sql_tpu.context as ctx_mod
+
+    def boom(plan, filename):
+        raise ImportError("no renderer")
+
+    monkeypatch.setattr(ctx_mod.Context, "_render_plan_png",
+                        staticmethod(boom))
+    path = str(tmp_path / "plan.png")
     c.visualize("SELECT a FROM df_simple WHERE a > 1", filename=path)
     import os
 
     assert os.path.exists(path + ".txt")
     with open(path + ".txt") as f:
         assert "TableScan" in f.read()
+
+
+def test_visualize_writes_plan_image(c, tmp_path):
+    pytest.importorskip("matplotlib", reason="plan rendering needs matplotlib")
+    path = str(tmp_path / "plan.png")
+    c.visualize("SELECT a FROM df_simple WHERE a > 1", filename=path)
+    import os
+
+    assert os.path.exists(path), "visualize must render an image file"
+    with open(path, "rb") as f:
+        assert f.read(8).startswith(b"\x89PNG"), "output must be a real png"
+
+
+def test_visualize_join_plan_image(c, tmp_path):
+    pytest.importorskip("matplotlib", reason="plan rendering needs matplotlib")
+    path = str(tmp_path / "join_plan.png")
+    c.visualize(
+        "SELECT lhs.user_id FROM user_table_1 lhs JOIN user_table_2 rhs "
+        "ON lhs.user_id = rhs.user_id WHERE lhs.b > 1", filename=path)
+    import os
+
+    assert os.path.exists(path) and os.path.getsize(path) > 1000
 
 
 def test_server_concurrent_queries_overlap(server):
