@@ -137,7 +137,9 @@ def save_state(context: "Context", location: str) -> dict:
                 continue
             rel = os.path.join("tables", _q(schema_name),
                                _q(tname) + ".parquet")
-            table = dc.assign()
+            # exact-length view: pad rows of a sharded table must not be
+            # persisted as data (the restore re-shards from logical rows)
+            table = dc.assign().depad()
             specs = _write_table(table, os.path.join(snap_dir, rel))
             entry["tables"][tname] = {"kind": "materialized", "file": rel,
                                       "columns": specs,

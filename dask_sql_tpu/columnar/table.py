@@ -18,15 +18,49 @@ from .dtypes import SqlType
 
 
 class Table:
-    __slots__ = ("columns", "_num_rows")
+    __slots__ = ("columns", "_num_rows", "row_valid")
 
-    def __init__(self, columns: Dict[str, Column], num_rows: Optional[int] = None):
+    def __init__(self, columns: Dict[str, Column], num_rows: Optional[int] = None,
+                 row_valid=None):
+        """`row_valid` marks a PADDED table: column buffers are a multiple of
+        the shard count (so NamedSharding row specs stay exact end-to-end on
+        non-divisible tables), `row_valid` is a same-length device mask of the
+        real rows, and `num_rows` stays the logical count.  Padded tables
+        exist only at rest (sharded base tables); padding-aware consumers
+        (the compiled pipelines) fold `row_valid` into their masks, everyone
+        else goes through `depad()`."""
         self.columns: Dict[str, Column] = dict(columns)
+        self.row_valid = row_valid
         if num_rows is None:
             num_rows = len(next(iter(self.columns.values()))) if self.columns else 0
         self._num_rows = num_rows
-        for name, col in self.columns.items():
-            assert len(col) == num_rows, f"column {name}: {len(col)} != {num_rows}"
+        if row_valid is not None:
+            padded = int(row_valid.shape[0])
+            assert padded >= num_rows, f"padded {padded} < logical {num_rows}"
+            for name, col in self.columns.items():
+                assert len(col) == padded, \
+                    f"column {name}: {len(col)} != padded {padded}"
+        else:
+            for name, col in self.columns.items():
+                assert len(col) == num_rows, f"column {name}: {len(col)} != {num_rows}"
+
+    @property
+    def is_padded(self) -> bool:
+        return self.row_valid is not None
+
+    @property
+    def padded_rows(self) -> int:
+        return int(self.row_valid.shape[0]) if self.row_valid is not None \
+            else self._num_rows
+
+    def depad(self) -> "Table":
+        """Exact-length view for consumers that index rows positionally.
+        The slice keeps a sharded (but no longer block-exact) layout —
+        today's pre-padding behavior, paid only on the eager paths."""
+        if self.row_valid is None:
+            return self
+        n = self._num_rows
+        return Table({name: c.slice(0, n) for name, c in self.columns.items()}, n)
 
     # -- construction -------------------------------------------------------
     @staticmethod
@@ -73,31 +107,37 @@ class Table:
 
     # -- transformations (all return new Tables; columns are immutable) -----
     def select(self, names: Sequence[str]) -> "Table":
-        return Table({n: self.columns[n] for n in names}, self._num_rows)
+        return Table({n: self.columns[n] for n in names}, self._num_rows,
+                     self.row_valid)
 
     def assign(self, **new_cols: Column) -> "Table":
         cols = dict(self.columns)
         cols.update(new_cols)
-        return Table(cols, self._num_rows)
+        return Table(cols, self._num_rows, self.row_valid)
 
     def rename(self, mapping: Dict[str, str]) -> "Table":
-        return Table({mapping.get(n, n): c for n, c in self.columns.items()}, self._num_rows)
+        return Table({mapping.get(n, n): c for n, c in self.columns.items()},
+                     self._num_rows, self.row_valid)
 
     def filter(self, mask) -> "Table":
         # one nonzero for the whole table, then integer gathers per column —
         # per-column boolean indexing pays the bool->index expansion N times
+        src = self.depad()
         indices = jnp.nonzero(jnp.asarray(mask))[0]
-        return Table({n: c.take(indices) for n, c in self.columns.items()},
+        return Table({n: c.take(indices) for n, c in src.columns.items()},
                      int(indices.shape[0]))
 
     def take(self, indices) -> "Table":
+        src = self.depad()
         indices = jnp.asarray(indices)
-        return Table({n: c.take(indices) for n, c in self.columns.items()}, int(indices.shape[0]))
+        return Table({n: c.take(indices) for n, c in src.columns.items()},
+                     int(indices.shape[0]))
 
     def slice(self, start: int, stop: int) -> "Table":
+        src = self.depad()
         stop = min(stop, self._num_rows)
         start = min(start, stop)
-        return Table({n: c.slice(start, stop) for n, c in self.columns.items()}, stop - start)
+        return Table({n: c.slice(start, stop) for n, c in src.columns.items()}, stop - start)
 
     def head(self, n: int) -> "Table":
         return self.slice(0, n)
@@ -125,6 +165,8 @@ class Table:
         transfer (per-column pulls each cost a dispatch round trip, which
         dominates on a tunneled chip); host-resident columns and the CPU
         backend use the plain per-column path."""
+        if self.row_valid is not None:
+            return self.depad()._host_columns()
         import os
 
         import jax
@@ -164,7 +206,7 @@ class Table:
     def to_arrow(self):
         from . import interop
 
-        return interop.table_to_arrow(self)
+        return interop.table_to_arrow(self.depad())
 
     def __repr__(self) -> str:
         cols = ", ".join(f"{n}:{c.sql_type.value}" for n, c in self.columns.items())

@@ -96,7 +96,8 @@ class DataContainer:
         for front in self.column_container.columns:
             back = self.column_container.get_backend_by_frontend_name(front)
             cols[front] = self.table.columns[back]
-        return Table(cols, self.table.num_rows)
+        return Table(cols, self.table.num_rows,
+                     getattr(self.table, "row_valid", None))
 
     def to_pandas(self):
         return self.assign().to_pandas()

@@ -788,10 +788,15 @@ class CompiledAggregate:
         n_rows = self.table.num_rows
         segsum_mode = self.segsum_mode
 
-        def fn(datas, valids):
+        def fn(datas, valids, row_valid):
             slots = {i: (datas[i], valids[i]) for i in range(n_cols)}
-            # selection mask (never compacts — static shapes end to end)
-            mask = None
+            nr = (datas[0].shape[0] if datas
+                  else row_valid.shape[0] if row_valid is not None
+                  else n_rows)
+            # selection mask (never compacts — static shapes end to end);
+            # a padded sharded table contributes its row mask here, so pad
+            # rows never count, never aggregate, never mark a group present
+            mask = row_valid
             for f in filters:
                 d, v = ev.eval(f, slots)
                 m = d if v is None else (d & v)
@@ -816,9 +821,9 @@ class CompiledAggregate:
                 gid = codes if first else gid * r + codes
                 first = False
             if first:
-                gid = jnp.zeros(n_rows, dtype=jnp.int32)
-            sel = mask if mask is not None else jnp.ones(n_rows, dtype=bool)
-            reducer = SegmentReducer(gid, domain, segsum_mode, n_rows)
+                gid = jnp.zeros(nr, dtype=jnp.int32)
+            sel = mask if mask is not None else jnp.ones(nr, dtype=bool)
+            reducer = SegmentReducer(gid, domain, segsum_mode, nr)
             hit_h = reducer.count(sel)
             outs = segment_agg_outputs(ev, slots, agg_exprs, sel, gid, domain,
                                        reducer)
@@ -834,7 +839,7 @@ class CompiledAggregate:
     def run(self) -> Table:
         datas = [self.table.columns[n].data for n in self.table.column_names]
         valids = [self.table.columns[n].validity for n in self.table.column_names]
-        packed = self._fn(tuple(datas), tuple(valids))
+        packed = self._fn(tuple(datas), tuple(valids), self.table.row_valid)
         tags = self._pack_tags
         host, present = fetch_packed(packed, self.domain)
         if not self.gcols and present.shape[0] == 0:
@@ -917,6 +922,7 @@ def try_compiled_aggregate(rel: p.Aggregate, executor) -> Optional[Table]:
             tuple(str(e) for e in group_exprs),
             tuple(str(a) for a in agg_exprs),
             table.num_rows,
+            table.padded_rows,
         )
         mode = str(executor.config.get("sql.compile.segsum", "auto"))
         key = key + (mode,)

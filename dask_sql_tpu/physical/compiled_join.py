@@ -345,12 +345,15 @@ class CompiledJoinAggregate:
         n_joins = len(self.ext.joins)
         rmins = [rmin for rmin, _ in self.luts]
 
-        def fn(probe_datas, probe_valids, luts, build_cols):
+        def fn(probe_datas, probe_valids, luts, build_cols, row_valid):
             # build_cols: {(k,col): (data, valid_or_None)} full build tables
             n_rows = probe_datas[0].shape[0] if probe_datas else 0
             slots: Dict[int, Tuple] = {
                 i: (probe_datas[i], probe_valids[i]) for i in range(n_probe)}
-            mask = jnp.ones(n_rows, dtype=bool)
+            # padded sharded probe: the row mask keeps pad rows out of every
+            # join match, filter, and reduction (exact-spec sharding)
+            mask = jnp.ones(n_rows, dtype=bool) if row_valid is None \
+                else row_valid
             ri_safe: List[jnp.ndarray] = []
             for k in range(n_joins):
                 kd, kv = ev.eval(lkeys[k], slots)
@@ -464,7 +467,8 @@ class CompiledJoinAggregate:
             bt = self.build_tables[k]
             c = bt.columns[bt.column_names[col]]
             build_cols[(k, col)] = (c.data, c.validity)
-        packed = self._fn(probe_datas, probe_valids, luts, build_cols)
+        packed = self._fn(probe_datas, probe_valids, luts, build_cols,
+                          pt.row_valid)
         from .compiled import fetch_packed, unpack_row
 
         tags = self._pack_tags
@@ -601,6 +605,7 @@ def try_compiled_join_aggregate(rel: p.Aggregate, executor) -> Optional[Table]:
         key = (
             tuple(uids), str(rel),
             probe_table.num_rows,
+            probe_table.padded_rows,
             tuple(bt.num_rows for bt in build_tables),
         )
         compiled = _cache.get(key)

@@ -54,6 +54,9 @@ class TableScanPlugin(BaseRelPlugin):
             table = executor.get_table(rel.schema_name, rel.table_name)
             if rel.projection is not None:
                 table = table.select(rel.projection)
+            # eager operators index rows positionally: exact-length view
+            # (padding-aware consumers bypass this plugin entirely)
+            table = table.depad()
         if rel.filters:
             # filters are bound against the *projected* schema
             mask = None

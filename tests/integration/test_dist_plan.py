@@ -112,7 +112,11 @@ def dist_ctx():
 
 
 @needs_mesh
-def test_sql_groupby_routes_through_agg_kernel(dist_ctx):
+def test_sql_groupby_routes_spmd_compiled(dist_ctx):
+    """Round 5: the no-join sharded groupby runs the whole-jit SPMD
+    aggregate (filter/masks deferred, GSPMD collectives) — the eager
+    partial->final kernel must NOT be needed for it, but still serves
+    compiled-ineligible shapes (DISTINCT aggregates)."""
     from dask_sql_tpu.parallel import dist_plan as dp
 
     c, df, _ = dist_ctx
@@ -121,12 +125,29 @@ def test_sql_groupby_routes_through_agg_kernel(dist_ctx):
         "SELECT g, h, COUNT(*) AS n, SUM(x) AS sx, AVG(y) AS ay, "
         "MIN(y) AS mny, MAX(x) AS mxx, STDDEV(y) AS sy "
         "FROM big GROUP BY g, h").compute()
-    assert dp.STATS["agg_kernel"] > before, "sharded groupby must use the kernel"
+    assert dp.STATS["agg_kernel"] == before, (
+        "plain sharded groupby must take the compiled SPMD aggregate")
     expected = (df.groupby(["g", "h"], dropna=False)
                 .agg(n=("x", "size"), sx=("x", "sum"), ay=("y", "mean"),
                      mny=("y", "min"), mxx=("x", "max"), sy=("y", "std"))
                 .reset_index())
     assert_eq(result, expected, check_dtype=False, sort_results=True)
+
+    # a float group key defeats the compiled path's radix plan: those
+    # shapes still route through the partial->final dist kernel
+    before = dp.STATS["agg_kernel"]
+    fk = c.sql("SELECT y, COUNT(*) AS n FROM big GROUP BY y").compute()
+    assert dp.STATS["agg_kernel"] > before, (
+        "float-key groupby still routes through the dist kernel")
+    exp_f = df.groupby("y", dropna=False).size().reset_index(name="n")
+    assert_eq(fk, exp_f, check_dtype=False, sort_results=True)
+
+    # DISTINCT aggregates decline both compiled and dist kernels and fall
+    # back to the single-program path — values must still be exact
+    distinct = c.sql("SELECT g, COUNT(DISTINCT k) AS n FROM big "
+                     "GROUP BY g").compute()
+    exp_d = df.groupby("g", dropna=False).k.nunique().reset_index(name="n")
+    assert_eq(distinct, exp_d, check_dtype=False, sort_results=True)
 
 
 @needs_mesh
