@@ -122,12 +122,22 @@ class Table:
     def filter(self, mask) -> "Table":
         # one nonzero for the whole table, then integer gathers per column —
         # per-column boolean indexing pays the bool->index expansion N times
+        mask = jnp.asarray(mask)
+        if self.row_valid is not None and \
+                int(mask.shape[0]) == self.padded_rows:
+            # padded-frame mask: pad rows must never pass, and the gather
+            # frame must match the mask frame
+            indices = jnp.nonzero(mask & self.row_valid)[0]
+            return Table({n: c.take(indices) for n, c in self.columns.items()},
+                         int(indices.shape[0]))
         src = self.depad()
-        indices = jnp.nonzero(jnp.asarray(mask))[0]
+        indices = jnp.nonzero(mask)[0]
         return Table({n: c.take(indices) for n, c in src.columns.items()},
                      int(indices.shape[0]))
 
     def take(self, indices) -> "Table":
+        # indices are LOGICAL row positions (< num_rows); a padded table
+        # gathers from its exact-length view
         src = self.depad()
         indices = jnp.asarray(indices)
         return Table({n: c.take(indices) for n, c in src.columns.items()},

@@ -37,7 +37,6 @@ from .planner.catalog import Catalog, CatalogSchema, CatalogTable
 from .planner.expressions import Field
 from .planner.parser import ParsingException, parse_sql
 from .planner import plan as plan_nodes
-from .planner.optimizer import optimize_plan
 
 logger = logging.getLogger(__name__)
 
@@ -486,19 +485,36 @@ class Context:
         case_sensitive = bool(self.config.get("sql.identifier.case_sensitive", True))
         catalog.case_sensitive = case_sensitive
         plan = None
+        core_optimized = False
         native_mode = str(self.config.get("sql.native.binder", "auto")).lower()
+        want_opt = bool(self.config.get("sql.optimize", True))
         if sql_text is not None and native_mode in ("auto", "on", "true"):
-            from .planner.native_bridge import native_bind
+            from .planner.native_bridge import native_bind, native_plan
 
-            plan = native_bind(sql_text, catalog,
-                               cat_buf=self._encoded_catalog(catalog),
-                               strict=native_mode != "auto")
+            cat_buf = self._encoded_catalog(catalog)
+            strict = native_mode != "auto"
+            if want_opt:
+                # one native call runs parse+bind+the structural rule loop
+                # (the reference's compiled DataFusion pipeline analogue)
+                plan = native_plan(
+                    sql_text, catalog, cat_buf=cat_buf,
+                    predicate_pushdown=bool(
+                        self.config.get("sql.predicate_pushdown", True)),
+                    strict=strict)
+                core_optimized = plan is not None
+            if plan is None:
+                plan = native_bind(sql_text, catalog, cat_buf=cat_buf,
+                                   strict=strict)
         if plan is None:
             binder = Binder(catalog, case_sensitive=case_sensitive)
             plan = binder.bind_statement(stmt)
-        if self.config.get("sql.optimize", True):
+        if want_opt:
+            from .planner.optimizer.driver import optimize_core, optimize_post
+
             try:
-                plan = optimize_plan(plan, self.config, catalog, context=self)
+                if not core_optimized:
+                    plan = optimize_core(plan, self.config, catalog)
+                plan = optimize_post(plan, self.config, catalog, context=self)
             except Exception:
                 # parity: optimizer failure falls back to the unoptimized plan
                 # (context.py:857-864)

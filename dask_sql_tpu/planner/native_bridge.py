@@ -854,6 +854,18 @@ class _PlanDecoder:
         kids = list(self.f.kids(nid))
         F = self.f
         if kind == _P_TABLESCAN:
+            # optimizer-extended scans: ival = nf when flags bit0 (projection
+            # pushed) or bit1 (filters pushed); P_PART kids = projection
+            # column names; remaining kids = pushed filter exprs
+            if flags & 3:
+                nf = ival
+                fields = self.fields(kids[:nf])
+                rest = kids[nf:]
+                parts = [k for k in rest if F.nodes[k][0] == _P_PART]
+                fexprs = [k for k in rest if F.nodes[k][0] != _P_PART]
+                projection = self.parts(parts) if flags & 1 else None
+                return p.TableScan(F.s(s0), F.s(s1), fields, projection,
+                                   [self.expr(k) for k in fexprs])
             return p.TableScan(F.s(s0), F.s(s1), self.fields(kids))
         if kind == _P_PROJECTION:
             nf = ival
@@ -874,7 +886,7 @@ class _PlanDecoder:
             on = [(self.expr(F.kids(pi)[0]), self.expr(F.kids(pi)[1]))
                   for pi in pairs_ids]
             return p.Join(self.plan(kids[0]), self.plan(kids[1]), F.s(s0),
-                          on, resid, fields)
+                          on, resid, fields, null_aware=bool(flags & 2))
         if kind == _P_CROSSJOIN:
             return p.CrossJoin(self.plan(kids[0]), self.plan(kids[1]),
                                self.fields(kids[2:]))
@@ -893,9 +905,10 @@ class _PlanDecoder:
                             self.fields(kids[1:1 + nf]))
         if kind == _P_SORT:
             nf = ival
+            fetch = int(dval) if flags & 1 else None
             return p.Sort(self.plan(kids[0]),
                           [self.sortkey(k) for k in kids[1 + nf:]],
-                          self.fields(kids[1:1 + nf]))
+                          self.fields(kids[1:1 + nf]), fetch)
         if kind == _P_LIMIT:
             fetch = ival if flags & 1 else None
             skip = int(F.s(s0))
@@ -1042,6 +1055,91 @@ def native_bind(sql: str, catalog, cat_buf: Optional[bytes] = None,
     if rc == 3:
         if not strict:
             return None  # parser lockstep gap: Python binder handles it
+        import struct
+
+        from .parser import ParsingException
+
+        pos = struct.unpack_from("<q", buf, 0)[0]
+        msg = buf[8:].decode("utf-8", "replace")
+        ctx = sql[max(0, pos - 30): pos + 30]
+        raise ParsingException(f"{msg} at position {pos} (near {ctx!r})")
+    try:
+        f = _FlatPlan(buf)
+        return _PlanDecoder(f).plan(f.root)
+    except Exception:  # noqa: BLE001 - corrupt buffer -> Python fallback
+        logger.debug("native plan decode failed", exc_info=True)
+        return None
+
+
+# ---------------------------------------------------------------------------
+# native planner: parse + bind + structural-optimize in one call
+# ---------------------------------------------------------------------------
+_planner_checked = False
+_planner_ok = False
+
+
+def _get_planner_lib():
+    global _planner_checked, _planner_ok
+    lib = _get_binder_lib()
+    if lib is None:
+        return None
+    if not _planner_checked:
+        _planner_checked = True
+        try:
+            lib.dsql_plan.restype = ctypes.c_int32
+            lib.dsql_plan.argtypes = [
+                ctypes.c_char_p, ctypes.c_int64,
+                ctypes.c_char_p, ctypes.c_int64, ctypes.c_int32,
+                ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+                ctypes.POINTER(ctypes.c_int64),
+            ]
+            lib.dsql_optimizer_abi_version.restype = ctypes.c_int32
+            _planner_ok = lib.dsql_optimizer_abi_version() == 1
+        except AttributeError:
+            _planner_ok = False
+    return lib if _planner_ok else None
+
+
+def native_plan(sql: str, catalog, cat_buf: Optional[bytes] = None,
+                predicate_pushdown: bool = True, strict: bool = False):
+    """Parse + bind + run the core optimizer rule pipeline natively
+    (native/binder.cpp Optimizer — the analogue of the reference's compiled
+    DataFusion rule loop, optimizer.rs:53-98).  Returns the optimized
+    LogicalPlan or None for Python fallback; join reordering / DPP /
+    embedded-subquery passes run in Python on the decoded plan."""
+    lib = _get_planner_lib()
+    if lib is None:
+        return None
+    raw = sql.encode("utf-8")
+    try:
+        if cat_buf is None:
+            cat_buf = encode_catalog(catalog)
+    except KeyError:
+        return None
+    if cat_buf is None:
+        return None
+    out = ctypes.POINTER(ctypes.c_uint8)()
+    out_len = ctypes.c_int64()
+    rc = lib.dsql_plan(raw, len(raw), cat_buf, len(cat_buf),
+                       1 if predicate_pushdown else 0,
+                       ctypes.byref(out), ctypes.byref(out_len))
+    if rc == 1:
+        return None
+    try:
+        buf = ctypes.string_at(out, out_len.value) if out_len.value else b""
+    finally:
+        if out:
+            lib.dsql_buf_free(out)
+    if rc == 2:
+        from .binder import BindError
+
+        msg = buf[1:].decode("utf-8", "replace")
+        if buf[:1] == b"\x01":
+            raise KeyError(msg)
+        raise BindError(msg)
+    if rc == 3:
+        if not strict:
+            return None
         import struct
 
         from .parser import ParsingException

@@ -35,7 +35,10 @@ def _load_rules():
     return _RULES
 
 
-def optimize_plan(plan, config, catalog, context=None):
+def optimize_core(plan, config, catalog):
+    """The structural rule loop (2 x 15 slots).  The native planner
+    (native/binder.cpp Optimizer) runs this same loop in C++; this Python
+    twin is the fallback and the differential-test reference."""
     rules = _load_rules()
     verbose = bool(config.get("sql.optimizer.verbose", False))
     # two passes: pushdowns expose new opportunities (e.g. cross-join
@@ -48,6 +51,13 @@ def optimize_plan(plan, config, catalog, context=None):
                 if verbose and new_plan is not plan:
                     logger.info("After %s:\n%s", type(rule).__name__, new_plan.explain())
                 plan = new_plan
+    return plan
+
+
+def optimize_post(plan, config, catalog, context=None):
+    """Statistics/data-driven passes after the structural loop: join
+    reordering (needs row counts), dynamic partition pruning (reads data at
+    plan time), and the embedded-subquery pipeline."""
     from . import join_reorder, rules
 
     plan = join_reorder.maybe_reorder(plan, config, catalog)
@@ -59,6 +69,11 @@ def optimize_plan(plan, config, catalog, context=None):
     plan = rules.PushDownProjection().apply(plan, config, catalog)
     plan = _optimize_embedded_subqueries(plan, config, catalog, context)
     return plan
+
+
+def optimize_plan(plan, config, catalog, context=None):
+    plan = optimize_core(plan, config, catalog)
+    return optimize_post(plan, config, catalog, context)
 
 
 def _optimize_embedded_subqueries(plan, config, catalog, context):

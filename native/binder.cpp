@@ -686,7 +686,18 @@ class PBuilder {
     return id;
   }
 
+  int32_t intern_mut(const std::string& s) const {
+    return const_cast<PBuilder*>(this)->intern(s);
+  }
+
   int32_t add(int32_t kind, const std::vector<int32_t>& kids,
+              int32_t flags = 0, int64_t ival = 0, double dval = 0.0,
+              int32_t s0 = -1, int32_t s1 = -1) const {
+    return const_cast<PBuilder*>(this)->add_impl(kind, kids, flags, ival,
+                                                 dval, s0, s1);
+  }
+
+  int32_t add_impl(int32_t kind, const std::vector<int32_t>& kids,
               int32_t flags = 0, int64_t ival = 0, double dval = 0.0,
               int32_t s0 = -1, int32_t s1 = -1) {
     PNode n;
@@ -704,7 +715,7 @@ class PBuilder {
   }
 
   std::vector<int32_t> kids(int32_t id) const {
-    const PNode& n = nodes[id];
+    const PNode n = nodes[id];
     return std::vector<int32_t>(children.begin() + n.child_off,
                                 children.begin() + n.child_off + n.nchild);
   }
@@ -712,8 +723,8 @@ class PBuilder {
   // structural equality of two node trees (string ids are content-unique)
   bool eq(int32_t a, int32_t b) const {
     if (a == b) return true;
-    const PNode& x = nodes[a];
-    const PNode& y = nodes[b];
+    const PNode x = nodes[a];
+    const PNode y = nodes[b];
     if (x.kind != y.kind || x.flags != y.flags || x.ival != y.ival ||
         x.dval != y.dval || x.s0 != y.s0 || x.s1 != y.s1 ||
         x.nchild != y.nchild)
@@ -755,6 +766,95 @@ class PBuilder {
     return buf;
   }
 };
+
+// shared literal-cast over plan-buffer nodes (binder._cast_literal parity);
+// throws BindErr on unparseable strings — ONE implementation for bind-time
+// coercion and optimizer-time folding so the semantics cannot drift
+int32_t cast_literal_node(const PBuilder& b, int32_t lit, int target);
+
+
+// literal constructors over an arbitrary PBuilder (shared by the binder's
+// coercion and the optimizer's constant folding)
+int32_t mk_lit_int_b(const PBuilder& b, int64_t v, int ty) {
+  return b.add(E_LITERAL, {}, ty_flags(ty, LT_INT), v);
+}
+int32_t mk_lit_float_b(const PBuilder& b, double v, int ty) {
+  return b.add(E_LITERAL, {}, ty_flags(ty, LT_FLOAT), 0, v);
+}
+int32_t mk_lit_bool_b(const PBuilder& b, bool v, int ty) {
+  return b.add(E_LITERAL, {}, ty_flags(ty, LT_BOOL), v ? 1 : 0);
+}
+
+// string-literal cast for comparisons and constant folding
+// (binder._cast_literal parity; known divergences from Python are the
+// int->datetime raw-ns and bool->datetime no-op corners, where Python's
+// np.datetime64(str(v)) semantics are not replicated)
+int32_t cast_literal_node(const PBuilder& b, int32_t lit, int target) {
+  const PNode n = b.nodes[lit];
+  int lt = ty_of_flags(n.flags);
+  int tag = n.flags & 0xFF;
+  if (is_datetime(target)) {
+    int64_t ns;
+    if (is_datetime(lt)) {
+      ns = n.ival;
+    } else if (tag == LT_STR) {
+      ns = parse_datetime_ns((n.s0 < 0 ? std::string() : b.strings[n.s0]));
+    } else if (tag == LT_INT) {
+      ns = n.ival;
+    } else if (tag == LT_FLOAT) {
+      ns = (int64_t)n.dval;
+    } else {
+      return lit;
+    }
+    if (target == TY_DATE) ns = (ns / 86400000000000LL) * 86400000000000LL;
+    return mk_lit_int_b(b, ns, target);
+  }
+  if (is_datetime(lt) || is_interval(lt)) {
+    if (is_integer(target)) return mk_lit_int_b(b, n.ival, target);
+    return lit;
+  }
+  if (is_integer(target)) {
+    if (tag == LT_INT || tag == LT_BOOL) return mk_lit_int_b(b, n.ival, target);
+    if (tag == LT_FLOAT) return mk_lit_int_b(b, (int64_t)n.dval, target);
+    if (tag == LT_STR) {
+      // Python int(str) raises for non-numeric strings -> BindError-ish;
+      // match by parsing strictly
+      const std::string s = (n.s0 < 0 ? std::string() : b.strings[n.s0]);
+      char* endp;
+      long long v = std::strtoll(s.c_str(), &endp, 10);
+      if (*endp != '\0') bind_error("Cannot bind literal '" + s + "'");
+      return mk_lit_int_b(b, v, target);
+    }
+    return lit;
+  }
+  if (target == TY_FLOAT || target == TY_DOUBLE || target == TY_DECIMAL ||
+      target == TY_REAL) {
+    if (tag == LT_INT || tag == LT_BOOL)
+      return mk_lit_float_b(b, (double)n.ival, target);
+    if (tag == LT_FLOAT) return mk_lit_float_b(b, n.dval, target);
+    if (tag == LT_STR) {
+      const std::string s = (n.s0 < 0 ? std::string() : b.strings[n.s0]);
+      char* endp;
+      double v = std::strtod(s.c_str(), &endp);
+      if (*endp != '\0') bind_error("Cannot bind literal '" + s + "'");
+      return mk_lit_float_b(b, v, target);
+    }
+    return lit;
+  }
+  if (target == TY_BOOLEAN) {
+    std::string sv;
+    if (tag == LT_STR) sv = (n.s0 < 0 ? std::string() : b.strings[n.s0]);
+    else if (tag == LT_INT || tag == LT_BOOL) sv = std::to_string(n.ival);
+    else if (tag == LT_FLOAT) sv = std::to_string(n.dval);
+    std::string t = lower(sv);
+    while (!t.empty() && t.front() == ' ') t.erase(t.begin());
+    while (!t.empty() && t.back() == ' ') t.pop_back();
+    bool v = t == "true" || t == "t" || t == "1" || t == "yes";
+    return mk_lit_bool_b(b, v, TY_BOOLEAN);
+  }
+  return lit;
+}
+
 
 // ---------------------------------------------------------------------------
 // binder
@@ -819,7 +919,7 @@ struct Scope {
 
 // nullability of a bound expr node (binder._nullable)
 bool expr_nullable(const PBuilder& b, int32_t e) {
-  const PNode& n = b.nodes[e];
+  const PNode n = b.nodes[e];
   if (n.kind == E_LITERAL) return (n.flags & 0xFF) == LT_NULL;
   if (n.kind == E_COLREF || n.kind == E_OUTERREF) return (n.flags & 1) != 0;
   return true;
@@ -910,7 +1010,7 @@ class Binder {
   // children() parity with expressions.py (traversal order matters for
   // walk-based dedup): plan-valued kids (subqueries) are NOT expr children
   std::vector<int32_t> expr_children(int32_t e) {
-    const PNode& n = b.nodes[e];
+    const PNode n = b.nodes[e];
     std::vector<int32_t> ks = b.kids(e);
     switch (n.kind) {
       case E_COLREF: case E_OUTERREF: case E_LITERAL:
@@ -1093,71 +1193,10 @@ class Binder {
     return mk_lit_int(total_ns, TY_INTERVAL_DAY_TIME);
   }
 
-  // string-literal cast for comparisons (binder._cast_literal)
+  // string-literal cast for comparisons — one shared implementation with
+  // the optimizer's constant folding (cast_literal_node)
   int32_t cast_literal(int32_t lit, int target) {
-    const PNode n = b.nodes[lit];
-    int lt = ty_of_flags(n.flags);
-    int tag = n.flags & 0xFF;
-    if (is_datetime(target)) {
-      int64_t ns;
-      if (is_datetime(lt)) {
-        ns = n.ival;
-      } else if (tag == LT_STR) {
-        ns = parse_datetime_ns(a_str(n.s0));
-      } else if (tag == LT_INT) {
-        ns = n.ival;
-      } else if (tag == LT_FLOAT) {
-        ns = (int64_t)n.dval;
-      } else {
-        return lit;
-      }
-      if (target == TY_DATE) ns = (ns / 86400000000000LL) * 86400000000000LL;
-      return mk_lit_int(ns, target);
-    }
-    if (is_datetime(lt) || is_interval(lt)) {
-      if (is_integer(target)) return mk_lit_int(n.ival, target);
-      return lit;
-    }
-    if (is_integer(target)) {
-      if (tag == LT_INT || tag == LT_BOOL) return mk_lit_int(n.ival, target);
-      if (tag == LT_FLOAT) return mk_lit_int((int64_t)n.dval, target);
-      if (tag == LT_STR) {
-        // Python int(str) raises for non-numeric strings -> BindError-ish;
-        // match by parsing strictly
-        const std::string s = a_str(n.s0);
-        char* endp;
-        long long v = std::strtoll(s.c_str(), &endp, 10);
-        if (*endp != '\0') bind_error("Cannot bind literal '" + s + "'");
-        return mk_lit_int(v, target);
-      }
-      return lit;
-    }
-    if (target == TY_FLOAT || target == TY_DOUBLE || target == TY_DECIMAL ||
-        target == TY_REAL) {
-      if (tag == LT_INT || tag == LT_BOOL)
-        return mk_lit_float((double)n.ival, target);
-      if (tag == LT_FLOAT) return mk_lit_float(n.dval, target);
-      if (tag == LT_STR) {
-        const std::string s = a_str(n.s0);
-        char* endp;
-        double v = std::strtod(s.c_str(), &endp);
-        if (*endp != '\0') bind_error("Cannot bind literal '" + s + "'");
-        return mk_lit_float(v, target);
-      }
-      return lit;
-    }
-    if (target == TY_BOOLEAN) {
-      std::string sv;
-      if (tag == LT_STR) sv = a_str(n.s0);
-      else if (tag == LT_INT || tag == LT_BOOL) sv = std::to_string(n.ival);
-      else if (tag == LT_FLOAT) sv = std::to_string(n.dval);
-      std::string t = lower(sv);
-      while (!t.empty() && t.front() == ' ') t.erase(t.begin());
-      while (!t.empty() && t.back() == ' ') t.pop_back();
-      bool v = t == "true" || t == "t" || t == "1" || t == "yes";
-      return mk_lit_bool(v, TY_BOOLEAN);
-    }
-    return lit;
+    return cast_literal_node(b, lit, target);
   }
 
   // string content of an interned id in the OUTPUT builder
@@ -2057,13 +2096,13 @@ class Binder {
   }
 
   void referenced_columns(int32_t e, std::set<int64_t>& out) {
-    const PNode& n = b.nodes[e];
+    const PNode n = b.nodes[e];
     if (n.kind == E_COLREF || n.kind == E_OUTERREF) out.insert(n.ival);
     for (int32_t k : expr_children(e)) referenced_columns(k, out);
   }
 
   void flatten_and(int32_t e, std::vector<int32_t>& out) {
-    const PNode& n = b.nodes[e];
+    const PNode n = b.nodes[e];
     if (n.kind == E_SCALARFN && a_str(n.s0) == "and") {
       for (int32_t k : b.kids(e)) flatten_and(k, out);
       return;
@@ -2078,7 +2117,7 @@ class Binder {
     std::vector<int32_t> on;
     std::vector<int32_t> residual;
     for (int32_t c : conjuncts) {
-      const PNode& n = b.nodes[c];
+      const PNode n = b.nodes[c];
       if (n.kind == E_LITERAL && (n.flags & 0xFF) == LT_BOOL && n.ival == 1)
         continue;
       if (n.kind == E_SCALARFN && a_str(n.s0) == "eq") {
@@ -3119,6 +3158,2029 @@ class Binder {
   }
 };
 
+
+// ===========================================================================
+// Native optimizer: the structural rule pipeline in C++ (parity:
+// src/sql/optimizer.rs:53-98 — the reference's rules run compiled in
+// DataFusion; this ports dask_sql_tpu/planner/optimizer/rules.py's core
+// 15-slot loop.  Join reordering, dynamic partition pruning and
+// embedded-subquery passes stay in Python (they read statistics/data).
+// ===========================================================================
+
+class Optimizer {
+ public:
+  explicit Optimizer(PBuilder& b, bool predicate_pushdown)
+      : b(b), predicate_pushdown(predicate_pushdown) {}
+
+  PBuilder& b;
+  bool predicate_pushdown;
+
+  std::string str_of(int32_t sid) const {
+    return sid < 0 ? std::string() : b.strings[sid];
+  }
+
+  bool is_plan_kind(int32_t k) const {
+    return (k >= P_TABLESCAN && k <= P_PREDICT_MODEL);
+  }
+
+  // ---------------- node accessors ----------------
+  std::vector<int32_t> inputs_of(int32_t id) const {
+    const PNode n = b.nodes[id];
+    auto ks = b.kids(id);
+    switch (n.kind) {
+      case P_PROJECTION: case P_FILTER: case P_AGGREGATE: case P_WINDOW:
+      case P_SORT: case P_LIMIT: case P_DISTINCT: case P_SUBQUERY_ALIAS:
+      case P_SAMPLE: case P_DISTRIBUTE_BY: case P_EXPLAIN:
+        return {ks[0]};
+      case P_JOIN: case P_CROSSJOIN: case P_INTERSECT: case P_EXCEPT:
+        return {ks[0], ks[1]};
+      case P_UNION: {
+        std::vector<int32_t> out;
+        for (size_t i = n.ival; i < ks.size(); ++i) out.push_back(ks[i]);
+        return out;
+      }
+      case P_CREATE_MEMORY_TABLE: case P_CREATE_MODEL:
+      case P_CREATE_EXPERIMENT: {
+        // input plan is a kid but these are handled by the default
+        // child-rewrite only; find the plan-kind kid
+        std::vector<int32_t> out;
+        for (int32_t k : ks)
+          if (is_plan_kind(b.nodes[k].kind)) out.push_back(k);
+        return out;
+      }
+      case P_PREDICT_MODEL:
+        return {ks[0]};
+      default:
+        return {};
+    }
+  }
+
+  // schema field node ids of a plan node
+  std::vector<int32_t> schema_of(int32_t id) const {
+    const PNode n = b.nodes[id];
+    auto ks = b.kids(id);
+    std::vector<int32_t> out;
+    auto take_fields = [&](size_t from, size_t count) {
+      for (size_t i = from; i < from + count && i < ks.size(); ++i)
+        out.push_back(ks[i]);
+    };
+    switch (n.kind) {
+      case P_TABLESCAN:
+        if (n.flags & 3) take_fields(0, (size_t)n.ival);
+        else for (int32_t k : ks) out.push_back(k);
+        break;
+      case P_PROJECTION: case P_FILTER: case P_AGGREGATE: case P_WINDOW:
+      case P_SORT: case P_DISTRIBUTE_BY: case P_EXPLAIN:
+      case P_PREDICT_MODEL:
+        take_fields(1, (size_t)n.ival);
+        break;
+      case P_JOIN:
+        take_fields(2, (size_t)n.ival);
+        break;
+      case P_CROSSJOIN: case P_INTERSECT: case P_EXCEPT:
+        for (size_t i = 2; i < ks.size(); ++i) out.push_back(ks[i]);
+        break;
+      case P_LIMIT: case P_DISTINCT: case P_SUBQUERY_ALIAS: case P_SAMPLE:
+        for (size_t i = 1; i < ks.size(); ++i) out.push_back(ks[i]);
+        break;
+      case P_UNION:
+        take_fields(0, (size_t)n.ival);
+        break;
+      case P_VALUES:
+        take_fields(0, (size_t)n.ival);
+        break;
+      case P_EMPTY:
+        for (int32_t k : ks) out.push_back(k);
+        break;
+      default:
+        break;
+    }
+    return out;
+  }
+
+  int schema_width(int32_t id) const { return (int)schema_of(id).size(); }
+
+  // rebuild a node with new inputs (payload preserved)
+  int32_t with_inputs(int32_t id, const std::vector<int32_t>& ni) const {
+    const PNode n = b.nodes[id];
+    auto ks = b.kids(id);
+    std::vector<int32_t> nk = ks;
+    switch (n.kind) {
+      case P_PROJECTION: case P_FILTER: case P_AGGREGATE: case P_WINDOW:
+      case P_SORT: case P_LIMIT: case P_DISTINCT: case P_SUBQUERY_ALIAS:
+      case P_SAMPLE: case P_DISTRIBUTE_BY: case P_EXPLAIN:
+      case P_PREDICT_MODEL:
+        nk[0] = ni[0];
+        break;
+      case P_JOIN: case P_CROSSJOIN: case P_INTERSECT: case P_EXCEPT:
+        nk[0] = ni[0];
+        nk[1] = ni[1];
+        break;
+      case P_UNION: {
+        for (size_t i = 0; i < ni.size(); ++i) nk[n.ival + i] = ni[i];
+        break;
+      }
+      case P_CREATE_MEMORY_TABLE: case P_CREATE_MODEL:
+      case P_CREATE_EXPERIMENT: {
+        size_t j = 0;
+        for (size_t i = 0; i < nk.size(); ++i)
+          if (is_plan_kind(b.nodes[nk[i]].kind)) nk[i] = ni[j++];
+        break;
+      }
+      default:
+        return id;
+    }
+    return b.add(n.kind, nk, n.flags, n.ival, n.dval, n.s0, n.s1);
+  }
+
+  // ---------------- expr helpers (PBuilder-side twins of the binder's) ----
+  std::vector<int32_t> expr_children(int32_t e) const {
+    const PNode n = b.nodes[e];
+    std::vector<int32_t> ks = b.kids(e);
+    switch (n.kind) {
+      case E_COLREF: case E_OUTERREF: case E_LITERAL:
+      case E_EXISTS: case E_SCALARSUBQ:
+        return {};
+      case E_SCALARFN: case E_UDF: case E_GROUPING: case E_CAST:
+      case E_CASE: case E_INLIST: case E_AGG:
+        return ks;
+      case E_INSUBQ:
+        return {ks[0]};
+      case E_WINDOW: {
+        std::vector<int32_t> out(ks.begin(), ks.end() - 1);
+        int32_t spec = ks.back();
+        auto sk = b.kids(spec);
+        int npart = (int)b.nodes[spec].ival;
+        for (int i = 0; i < npart; ++i) out.push_back(sk[i]);
+        for (size_t i = npart; i < sk.size(); ++i)
+          if (b.nodes[sk[i]].kind == P_SORTKEY)
+            out.push_back(b.kids(sk[i])[0]);
+        return out;
+      }
+    }
+    return {};
+  }
+
+  int32_t with_expr_children(int32_t e, const std::vector<int32_t>& ch) const {
+    const PNode n = b.nodes[e];
+    switch (n.kind) {
+      case E_COLREF: case E_OUTERREF: case E_LITERAL:
+      case E_EXISTS: case E_SCALARSUBQ:
+        return e;
+      case E_SCALARFN: case E_UDF: case E_GROUPING: case E_CAST:
+      case E_CASE: case E_INLIST: case E_AGG:
+        return b.add(n.kind, ch, n.flags, n.ival, n.dval, n.s0, n.s1);
+      case E_INSUBQ: {
+        auto ks = b.kids(e);
+        return b.add(n.kind, {ch[0], ks[1]}, n.flags, n.ival, n.dval, n.s0,
+                     n.s1);
+      }
+      case E_WINDOW: {
+        auto ks = b.kids(e);
+        int32_t spec = ks.back();
+        const PNode sn = b.nodes[spec];
+        auto sk = b.kids(spec);
+        int npart = (int)sn.ival;
+        int nargs = (int)ks.size() - 1;
+        std::vector<int32_t> nsk;
+        size_t ci = nargs;
+        for (int i = 0; i < npart; ++i) nsk.push_back(ch[ci++]);
+        for (size_t i = npart; i < sk.size(); ++i) {
+          if (b.nodes[sk[i]].kind == P_SORTKEY) {
+            const PNode kn = b.nodes[sk[i]];
+            nsk.push_back(b.add(P_SORTKEY, {ch[ci++]}, kn.flags));
+          } else {
+            nsk.push_back(sk[i]);
+          }
+        }
+        int32_t nspec = b.add(P_WINSPEC, nsk, sn.flags, sn.ival, sn.dval,
+                              sn.s0, sn.s1);
+        std::vector<int32_t> nks(ch.begin(), ch.begin() + nargs);
+        nks.push_back(nspec);
+        return b.add(n.kind, nks, n.flags, n.ival, n.dval, n.s0, n.s1);
+      }
+    }
+    return e;
+  }
+
+  int32_t transform_expr(int32_t e,
+                         const std::function<int32_t(int32_t)>& fn) const {
+    auto ks = expr_children(e);
+    if (!ks.empty()) {
+      std::vector<int32_t> nk;
+      bool changed = false;
+      for (int32_t k : ks) {
+        int32_t t = transform_expr(k, fn);
+        changed |= (t != k);
+        nk.push_back(t);
+      }
+      if (changed) e = with_expr_children(e, nk);
+    }
+    return fn(e);
+  }
+
+  void walk_expr(int32_t e, const std::function<void(int32_t)>& fn) const {
+    fn(e);
+    for (int32_t k : expr_children(e)) walk_expr(k, fn);
+  }
+
+  bool expr_contains(int32_t e, const std::function<bool(const PNode&)>& pred) const {
+    bool found = false;
+    walk_expr(e, [&](int32_t x) { found = found || pred(b.nodes[x]); });
+    return found;
+  }
+
+  void referenced_cols(int32_t e, std::set<int64_t>& out) const {
+    walk_expr(e, [&](int32_t x) {
+      const PNode n = b.nodes[x];
+      if (n.kind == E_COLREF || n.kind == E_OUTERREF) out.insert(n.ival);
+    });
+  }
+
+  int32_t remap_cols(int32_t e, const std::map<int64_t, int64_t>& m) const {
+    return transform_expr(e, [&](int32_t x) -> int32_t {
+      const PNode n = b.nodes[x];
+      if (n.kind == E_COLREF || n.kind == E_OUTERREF) {
+        auto it = m.find(n.ival);
+        int64_t ni = it == m.end() ? n.ival : it->second;
+        if (ni == n.ival) return x;
+        return b.add(n.kind, {}, n.flags, ni, n.dval, n.s0, n.s1);
+      }
+      return x;
+    });
+  }
+
+  int32_t shift_cols(int32_t e, int64_t delta) const {
+    if (delta == 0) return e;
+    return transform_expr(e, [&](int32_t x) -> int32_t {
+      const PNode n = b.nodes[x];
+      if (n.kind == E_COLREF || n.kind == E_OUTERREF)
+        return b.add(n.kind, {}, n.flags, n.ival + delta, n.dval, n.s0, n.s1);
+      return x;
+    });
+  }
+
+  void conjuncts_of(int32_t e, std::vector<int32_t>& out) const {
+    const PNode n = b.nodes[e];
+    if (n.kind == E_SCALARFN && str_of(n.s0) == "and") {
+      for (int32_t k : b.kids(e)) conjuncts_of(k, out);
+      return;
+    }
+    out.push_back(e);
+  }
+
+  int32_t conjoin(const std::vector<int32_t>& parts) const {
+    if (parts.empty()) return -1;
+    int32_t out = parts[0];
+    for (size_t i = 1; i < parts.size(); ++i)
+      out = b.add(E_SCALARFN, {out, parts[i]}, ty_flags(TY_BOOLEAN), 0, 0.0,
+                  b.intern_mut("and"));
+    return out;
+  }
+
+  void disjuncts_of(int32_t e, std::vector<int32_t>& out) const {
+    const PNode n = b.nodes[e];
+    if (n.kind == E_SCALARFN && str_of(n.s0) == "or") {
+      for (int32_t k : b.kids(e)) disjuncts_of(k, out);
+      return;
+    }
+    out.push_back(e);
+  }
+
+  int32_t disjoin(const std::vector<int32_t>& parts) const {
+    int32_t out = parts[0];
+    for (size_t i = 1; i < parts.size(); ++i)
+      out = b.add(E_SCALARFN, {out, parts[i]}, ty_flags(TY_BOOLEAN), 0, 0.0,
+                  b.intern_mut("or"));
+    return out;
+  }
+
+  bool is_fn(int32_t e, const char* op) const {
+    const PNode n = b.nodes[e];
+    return n.kind == E_SCALARFN && str_of(n.s0) == op;
+  }
+
+  bool is_volatile(int32_t e) const {
+    return expr_contains(e, [&](const PNode n) {
+      if (n.kind != E_SCALARFN) return false;
+      std::string op = str_of(n.s0);
+      return op == "rand" || op == "rand_integer";
+    });
+  }
+
+  bool has_subquery(int32_t e) const {
+    return expr_contains(e, [](const PNode n) {
+      return n.kind == E_SCALARSUBQ || n.kind == E_INSUBQ || n.kind == E_EXISTS;
+    });
+  }
+
+  bool is_bool_lit(int32_t e, bool v) const {
+    const PNode n = b.nodes[e];
+    return n.kind == E_LITERAL && (n.flags & 0xFF) == LT_BOOL &&
+           (n.ival != 0) == v;
+  }
+
+
+  // ---------------- literal utilities ----------------
+  bool lit_num(int32_t e, bool* is_float, int64_t* iv, double* dv) const {
+    const PNode n = b.nodes[e];
+    if (n.kind != E_LITERAL) return false;
+    int tag = n.flags & 0xFF;
+    if (tag == LT_INT || tag == LT_BOOL) {
+      *is_float = false;
+      *iv = n.ival;
+      *dv = (double)n.ival;
+      return true;
+    }
+    if (tag == LT_FLOAT) {
+      *is_float = true;
+      *iv = (int64_t)n.dval;
+      *dv = n.dval;
+      return true;
+    }
+    return false;
+  }
+
+  int32_t mk_bool(bool v) const {
+    return b.add(E_LITERAL, {}, ty_flags(TY_BOOLEAN, LT_BOOL), v ? 1 : 0);
+  }
+
+  // optimizer-side literal cast: shares cast_literal_node with the binder;
+  // -1 = cannot fold (NULL literal or unparseable string)
+  int32_t cast_lit_node(int32_t lit, int target) const {
+    if ((b.nodes[lit].flags & 0xFF) == LT_NULL) return -1;
+    try {
+      return cast_literal_node(b, lit, target);
+    } catch (const BindErr&) {
+      return -1;
+    }
+  }
+
+  // ---------------- SimplifyExpressions ----------------
+  int32_t simplify_expr(int32_t e) const {
+    return transform_expr(e, [&](int32_t x) -> int32_t {
+      const PNode n = b.nodes[x];
+      if (n.kind == E_SCALARFN) {
+        auto args = b.kids(x);
+        std::string op = str_of(n.s0);
+        if ((op == "and" || op == "or") && args.size() == 2) {
+          const PNode a = b.nodes[args[0]];
+          const PNode bb = b.nodes[args[1]];
+          if (a.kind == E_LITERAL && (a.flags & 0xFF) == LT_BOOL) {
+            bool av = a.ival != 0;
+            if (op == "and") return av ? args[1] : mk_bool(false);
+            return av ? mk_bool(true) : args[1];
+          }
+          if (bb.kind == E_LITERAL && (bb.flags & 0xFF) == LT_BOOL) {
+            bool bv = bb.ival != 0;
+            if (op == "and") return bv ? args[0] : mk_bool(false);
+            return bv ? mk_bool(true) : args[0];
+          }
+        }
+        if (op == "not" && !args.empty()) {
+          const PNode a = b.nodes[args[0]];
+          if (a.kind == E_LITERAL && (a.flags & 0xFF) == LT_BOOL)
+            return mk_bool(a.ival == 0);
+          if (is_fn(args[0], "not")) return b.kids(args[0])[0];
+        }
+        static const std::set<std::string> foldable = {
+            "add", "sub", "mul", "eq", "ne", "lt", "le", "gt", "ge"};
+        if (foldable.count(op) && args.size() == 2) {
+          bool f1, f2;
+          int64_t i1, i2;
+          double d1, d2;
+          if (lit_num(args[0], &f1, &i1, &d1) &&
+              lit_num(args[1], &f2, &i2, &d2)) {
+            int ty = ty_of_flags(n.flags);
+            if (op == "add" || op == "sub" || op == "mul") {
+              if (f1 || f2) {
+                double v = op == "add" ? d1 + d2
+                           : op == "sub" ? d1 - d2 : d1 * d2;
+                return b.add(E_LITERAL, {}, ty_flags(ty, LT_FLOAT), 0, v);
+              }
+              int64_t v = op == "add" ? i1 + i2
+                          : op == "sub" ? i1 - i2 : i1 * i2;
+              if (ty == TY_BOOLEAN)
+                return b.add(E_LITERAL, {}, ty_flags(ty, LT_BOOL),
+                             v != 0 ? 1 : 0);
+              return b.add(E_LITERAL, {}, ty_flags(ty, LT_INT), v);
+            }
+            bool v;
+            if (!f1 && !f2) {
+              v = op == "eq" ? i1 == i2 : op == "ne" ? i1 != i2
+                  : op == "lt" ? i1 < i2 : op == "le" ? i1 <= i2
+                  : op == "gt" ? i1 > i2 : i1 >= i2;
+            } else {
+              double l = f1 ? d1 : (double)i1;
+              double r = f2 ? d2 : (double)i2;
+              v = op == "eq" ? l == r : op == "ne" ? l != r
+                  : op == "lt" ? l < r : op == "le" ? l <= r
+                  : op == "gt" ? l > r : l >= r;
+            }
+            int ty2 = ty_of_flags(n.flags);
+            return b.add(E_LITERAL, {}, ty_flags(ty2, LT_BOOL), v ? 1 : 0);
+          }
+        }
+      }
+      if (n.kind == E_CAST) {
+        int32_t arg = b.kids(x)[0];
+        const PNode an = b.nodes[arg];
+        int ty = ty_of_flags(n.flags);
+        if (an.kind == E_LITERAL) {
+          if ((an.flags & 0xFF) == LT_NULL)
+            return b.add(E_LITERAL, {}, ty_flags(ty, LT_NULL));
+          int32_t lit = cast_lit_node(arg, ty);
+          if (lit >= 0) {
+            const PNode ln = b.nodes[lit];
+            return b.add(E_LITERAL, {}, ty_flags(ty, ln.flags & 0xFF),
+                         ln.ival, ln.dval, ln.s0);
+          }
+          return x;
+        }
+        if (ty_of_flags(an.flags) == ty) return arg;
+      }
+      return x;
+    });
+  }
+
+  int32_t map_node_exprs(int32_t id,
+                         const std::function<int32_t(int32_t)>& fn) const {
+    const PNode n = b.nodes[id];
+    auto ks = b.kids(id);
+    std::vector<int32_t> nk = ks;
+    bool changed = false;
+    auto apply_range = [&](size_t from, size_t to) {
+      for (size_t i = from; i < to && i < nk.size(); ++i) {
+        int32_t t = fn(nk[i]);
+        changed |= t != nk[i];
+        nk[i] = t;
+      }
+    };
+    switch (n.kind) {
+      case P_PROJECTION:
+        apply_range(1 + n.ival, nk.size());
+        break;
+      case P_FILTER:
+        apply_range(nk.size() - 1, nk.size());
+        break;
+      case P_JOIN: {
+        size_t start = 2 + n.ival;
+        for (size_t i = start; i < nk.size(); ++i) {
+          if (b.nodes[nk[i]].kind == P_ON_PAIR) {
+            auto pk = b.kids(nk[i]);
+            int32_t l = fn(pk[0]);
+            int32_t r = fn(pk[1]);
+            if (l != pk[0] || r != pk[1]) {
+              nk[i] = b.add(P_ON_PAIR, {l, r});
+              changed = true;
+            }
+          } else {
+            int32_t t = fn(nk[i]);
+            changed |= t != nk[i];
+            nk[i] = t;
+          }
+        }
+        break;
+      }
+      case P_AGGREGATE:
+        apply_range(1 + n.ival, nk.size());
+        break;
+      case P_SORT: {
+        for (size_t i = 1 + n.ival; i < nk.size(); ++i) {
+          const PNode kn = b.nodes[nk[i]];
+          auto kk = b.kids(nk[i]);
+          int32_t t = fn(kk[0]);
+          if (t != kk[0]) {
+            nk[i] = b.add(P_SORTKEY, {t}, kn.flags);
+            changed = true;
+          }
+        }
+        break;
+      }
+      case P_WINDOW:
+        apply_range(1 + n.ival, nk.size());
+        break;
+      case P_TABLESCAN: {
+        if (!(n.flags & 2)) return id;
+        for (size_t i = 0; i < nk.size(); ++i) {
+          int k = b.nodes[nk[i]].kind;
+          if (k != P_FIELD && k != P_PART) {
+            int32_t t = fn(nk[i]);
+            changed |= t != nk[i];
+            nk[i] = t;
+          }
+        }
+        break;
+      }
+      default:
+        return id;
+    }
+    if (!changed) return id;
+    return b.add(n.kind, nk, n.flags, n.ival, n.dval, n.s0, n.s1);
+  }
+
+  int32_t rewrite_plan(int32_t id,
+                       const std::function<int32_t(int32_t)>& fn) const {
+    auto ins = inputs_of(id);
+    if (!ins.empty()) {
+      std::vector<int32_t> ni;
+      bool changed = false;
+      for (int32_t k : ins) {
+        int32_t t = rewrite_plan(k, fn);
+        changed |= t != k;
+        ni.push_back(t);
+      }
+      if (changed) id = with_inputs(id, ni);
+    }
+    return fn(id);
+  }
+
+  int32_t rule_simplify(int32_t plan) const {
+    return rewrite_plan(plan, [&](int32_t node) {
+      return map_node_exprs(node,
+                            [&](int32_t e) { return simplify_expr(e); });
+    });
+  }
+
+  // ---------------- UnwrapCastInComparison ----------------
+  bool cast_injective_monotone(int src, int dst) const {
+    auto int_rank = [](int t) -> int {
+      switch (t) {
+        case TY_TINYINT: return 8;
+        case TY_SMALLINT: return 16;
+        case TY_INTEGER: return 32;
+        case TY_BIGINT: return 64;
+      }
+      return -1;
+    };
+    int rs = int_rank(src), rd = int_rank(dst);
+    if (rs > 0 && rd > 0) return rs <= rd;
+    if (rs > 0 && dst == TY_DOUBLE) return rs <= 32;
+    if (rs > 0 && dst == TY_FLOAT) return rs <= 16;
+    if (src == TY_FLOAT && dst == TY_DOUBLE) return true;
+    if (src == TY_DATE && dst == TY_TIMESTAMP) return true;
+    return false;
+  }
+
+  bool lit_equal_value(int32_t a, int32_t c) const {
+    const PNode x = b.nodes[a];
+    const PNode y = b.nodes[c];
+    int tx = x.flags & 0xFF, ty = y.flags & 0xFF;
+    if (tx == LT_STR || ty == LT_STR) return tx == ty && x.s0 == y.s0;
+    if (tx == LT_NULL || ty == LT_NULL) return tx == ty;
+    bool f1, f2;
+    int64_t i1, i2;
+    double d1, d2;
+    if (!lit_num(a, &f1, &i1, &d1) || !lit_num(c, &f2, &i2, &d2)) return false;
+    if (!f1 && !f2) return i1 == i2;
+    return d1 == d2;
+  }
+
+  int32_t try_unwrap_cast(const std::string& op, int32_t cast_e,
+                          int32_t lit_e) const {
+    const PNode cn = b.nodes[cast_e];
+    const PNode ln = b.nodes[lit_e];
+    if ((ln.flags & 0xFF) == LT_NULL) return -1;
+    int32_t arg = b.kids(cast_e)[0];
+    int src = ty_of_flags(b.nodes[arg].flags);
+    int dst = ty_of_flags(cn.flags);
+    if (!cast_injective_monotone(src, dst)) return -1;
+    int32_t down = cast_lit_node(lit_e, src);
+    if (down < 0) return -1;
+    int32_t back = cast_lit_node(down, ty_of_flags(ln.flags));
+    if (back < 0) return -1;
+    if (!lit_equal_value(back, lit_e)) return -1;
+    auto int_range = [](int t, int64_t* lo, int64_t* hi) -> bool {
+      switch (t) {
+        case TY_TINYINT: *lo = -(1LL << 7); *hi = (1LL << 7) - 1; return true;
+        case TY_SMALLINT: *lo = -(1LL << 15); *hi = (1LL << 15) - 1; return true;
+        case TY_INTEGER: *lo = -(1LL << 31); *hi = (1LL << 31) - 1; return true;
+        case TY_BIGINT: *lo = INT64_MIN; *hi = INT64_MAX; return true;
+      }
+      return false;
+    };
+    int64_t lo, hi;
+    if (int_range(src, &lo, &hi)) {
+      bool f;
+      int64_t iv;
+      double dv;
+      if (!lit_num(down, &f, &iv, &dv)) return -1;
+      int64_t v = f ? (int64_t)dv : iv;
+      if (!(lo <= v && v <= hi)) return -1;
+    }
+    return b.add(E_SCALARFN, {arg, down}, ty_flags(TY_BOOLEAN), 0, 0.0,
+                 b.intern_mut(op));
+  }
+
+  int32_t unwrap_cast_expr(int32_t e) const {
+    static const std::map<std::string, std::string> flip = {
+        {"lt", "gt"}, {"le", "ge"}, {"gt", "lt"}, {"ge", "le"},
+        {"eq", "eq"}, {"ne", "ne"}};
+    return transform_expr(e, [&](int32_t x) -> int32_t {
+      const PNode n = b.nodes[x];
+      if (n.kind != E_SCALARFN) return x;
+      std::string op = str_of(n.s0);
+      if (!flip.count(op)) return x;
+      auto args = b.kids(x);
+      if (args.size() != 2) return x;
+      const PNode a = b.nodes[args[0]];
+      const PNode bb = b.nodes[args[1]];
+      if (a.kind == E_CAST && bb.kind == E_LITERAL) {
+        int32_t out = try_unwrap_cast(op, args[0], args[1]);
+        if (out >= 0) return out;
+      }
+      if (bb.kind == E_CAST && a.kind == E_LITERAL) {
+        int32_t out = try_unwrap_cast(flip.at(op), args[1], args[0]);
+        if (out >= 0) return out;
+      }
+      return x;
+    });
+  }
+
+  int32_t rule_unwrap_cast(int32_t plan) const {
+    return rewrite_plan(plan, [&](int32_t node) {
+      return map_node_exprs(node,
+                            [&](int32_t e) { return unwrap_cast_expr(e); });
+    });
+  }
+
+  // ---------------- RewriteDisjunctivePredicate ----------------
+  int32_t rewrite_disjunction(int32_t e) const {
+    return transform_expr(e, [&](int32_t x) -> int32_t {
+      if (!is_fn(x, "or")) return x;
+      std::vector<int32_t> djs;
+      disjuncts_of(x, djs);
+      if (djs.size() < 2) return x;
+      std::vector<std::vector<int32_t>> branches;
+      for (int32_t d : djs) {
+        std::vector<int32_t> cs;
+        conjuncts_of(d, cs);
+        branches.push_back(cs);
+      }
+      std::vector<int32_t> common;
+      for (int32_t c : branches[0]) {
+        bool in_all = true;
+        for (size_t i = 1; i < branches.size(); ++i) {
+          bool found = false;
+          for (int32_t c2 : branches[i])
+            if (b.eq(c, c2)) { found = true; break; }
+          if (!found) { in_all = false; break; }
+        }
+        if (in_all) common.push_back(c);
+      }
+      if (common.empty()) return x;
+      std::vector<std::vector<int32_t>> residuals;
+      for (auto& br : branches) {
+        std::vector<int32_t> rem;
+        for (int32_t c : br) {
+          bool is_common = false;
+          for (int32_t cm : common)
+            if (b.eq(c, cm)) { is_common = true; break; }
+          if (!is_common) rem.push_back(c);
+        }
+        residuals.push_back(rem);
+      }
+      for (auto& rem : residuals)
+        if (rem.empty()) return conjoin(common);
+      std::vector<int32_t> parts = common;
+      std::vector<int32_t> djparts;
+      for (auto& rem : residuals) djparts.push_back(conjoin(rem));
+      parts.push_back(disjoin(djparts));
+      return conjoin(parts);
+    });
+  }
+
+  int32_t rule_disjunctive(int32_t plan) const {
+    return rewrite_plan(plan, [&](int32_t node) -> int32_t {
+      const PNode n = b.nodes[node];
+      if (n.kind != P_FILTER) return node;
+      auto ks = b.kids(node);
+      int32_t pred = ks.back();
+      int32_t np = rewrite_disjunction(pred);
+      if (np == pred) return node;
+      std::vector<int32_t> nk = ks;
+      nk.back() = np;
+      return b.add(n.kind, nk, n.flags, n.ival, n.dval, n.s0, n.s1);
+    });
+  }
+
+
+  // ---------------- node constructors ----------------
+  int32_t mk_filter(int32_t input, int32_t pred) const {
+    auto fields = schema_of(input);
+    std::vector<int32_t> nk{input};
+    nk.insert(nk.end(), fields.begin(), fields.end());
+    nk.push_back(pred);
+    return b.add(P_FILTER, nk, 0, (int64_t)fields.size());
+  }
+
+  int32_t mk_filter_with_fields(int32_t input, int32_t pred,
+                                const std::vector<int32_t>& fields) const {
+    std::vector<int32_t> nk{input};
+    nk.insert(nk.end(), fields.begin(), fields.end());
+    nk.push_back(pred);
+    return b.add(P_FILTER, nk, 0, (int64_t)fields.size());
+  }
+
+  int32_t mk_limit(int32_t input, int64_t skip, bool has_fetch, int64_t fetch,
+                   const std::vector<int32_t>& fields) const {
+    std::vector<int32_t> nk{input};
+    nk.insert(nk.end(), fields.begin(), fields.end());
+    return b.add(P_LIMIT, nk, has_fetch ? 1 : 0, fetch, 0.0,
+                 b.intern_mut(std::to_string(skip)));
+  }
+
+  // decode P_LIMIT payload
+  void limit_parts(int32_t id, int64_t* skip, bool* has_fetch,
+                   int64_t* fetch) const {
+    const PNode n = b.nodes[id];
+    *skip = std::strtoll(str_of(n.s0).c_str(), nullptr, 10);
+    *has_fetch = (n.flags & 1) != 0;
+    *fetch = n.ival;
+  }
+
+  struct JoinParts {
+    int32_t left, right;
+    std::vector<int32_t> fields;
+    std::vector<int32_t> on;  // P_ON_PAIR ids
+    int32_t residual;         // -1 none
+    std::string jt;
+    bool null_aware;
+  };
+
+  JoinParts join_parts(int32_t id) const {
+    const PNode n = b.nodes[id];
+    auto ks = b.kids(id);
+    JoinParts jp;
+    jp.left = ks[0];
+    jp.right = ks[1];
+    int nf = (int)n.ival;
+    for (int i = 0; i < nf; ++i) jp.fields.push_back(ks[2 + i]);
+    size_t i = 2 + nf;
+    bool has_resid = (n.flags & 1) != 0;
+    size_t end = ks.size() - (has_resid ? 1 : 0);
+    for (; i < end; ++i) jp.on.push_back(ks[i]);
+    jp.residual = has_resid ? ks.back() : -1;
+    jp.jt = str_of(n.s0);
+    jp.null_aware = (n.flags & 2) != 0;
+    return jp;
+  }
+
+  int32_t mk_join(const JoinParts& jp) const {
+    std::vector<int32_t> nk{jp.left, jp.right};
+    nk.insert(nk.end(), jp.fields.begin(), jp.fields.end());
+    nk.insert(nk.end(), jp.on.begin(), jp.on.end());
+    int32_t flags = jp.null_aware ? 2 : 0;
+    if (jp.residual >= 0) {
+      nk.push_back(jp.residual);
+      flags |= 1;
+    }
+    return b.add(P_JOIN, nk, flags, (int64_t)jp.fields.size(), 0.0,
+                 b.intern_mut(jp.jt));
+  }
+
+  // split_join_condition twin (binder.split_join_condition parity)
+  std::pair<std::vector<int32_t>, int32_t> split_cond(int32_t cond,
+                                                      int nleft) const {
+    std::vector<int32_t> cjs;
+    conjuncts_of(cond, cjs);
+    std::vector<int32_t> on, residual;
+    for (int32_t c : cjs) {
+      const PNode n = b.nodes[c];
+      if (n.kind == E_LITERAL && (n.flags & 0xFF) == LT_BOOL && n.ival == 1)
+        continue;
+      if (is_fn(c, "eq")) {
+        auto ks = b.kids(c);
+        std::set<int64_t> lcols, rcols;
+        referenced_cols(ks[0], lcols);
+        referenced_cols(ks[1], rcols);
+        if (!lcols.empty() && !rcols.empty()) {
+          int64_t lmax = *lcols.rbegin(), lmin = *lcols.begin();
+          int64_t rmax = *rcols.rbegin(), rmin = *rcols.begin();
+          if (lmax < nleft && rmin >= nleft) {
+            on.push_back(b.add(P_ON_PAIR, {ks[0], ks[1]}));
+            continue;
+          }
+          if (rmax < nleft && lmin >= nleft) {
+            on.push_back(b.add(P_ON_PAIR, {ks[1], ks[0]}));
+            continue;
+          }
+        }
+      }
+      residual.push_back(c);
+    }
+    int32_t resid = -1;
+    if (!residual.empty()) resid = conjoin(residual);
+    return {on, resid};
+  }
+
+  // ---------------- EliminateCrossJoin ----------------
+  int32_t rule_elim_cross_join(int32_t plan) const {
+    return rewrite_plan(plan, [&](int32_t node) -> int32_t {
+      const PNode n = b.nodes[node];
+      if (n.kind != P_FILTER) return node;
+      auto ks = b.kids(node);
+      int32_t child = ks[0];
+      int32_t pred = ks.back();
+      const PNode cn = b.nodes[child];
+      if (cn.kind == P_CROSSJOIN) {
+        auto ck = b.kids(child);
+        int nleft = schema_width(ck[0]);
+        auto [on, residual] = split_cond(pred, nleft);
+        if (!on.empty()) {
+          std::vector<int32_t> cj_fields(ck.begin() + 2, ck.end());
+          JoinParts jp{ck[0], ck[1], cj_fields, on, -1, "INNER", false};
+          int32_t join = mk_join(jp);
+          if (residual >= 0)
+            return mk_filter_with_fields(join, residual, cj_fields);
+          return join;
+        }
+      }
+      if (cn.kind == P_JOIN) {
+        JoinParts jp = join_parts(child);
+        if (jp.jt == "INNER") {
+          int nleft = schema_width(jp.left);
+          auto [on, residual] = split_cond(pred, nleft);
+          if (!on.empty()) {
+            jp.on.insert(jp.on.end(), on.begin(), on.end());
+            int32_t join = mk_join(jp);
+            if (residual >= 0)
+              return mk_filter_with_fields(join, residual, jp.fields);
+            return join;
+          }
+        }
+      }
+      return node;
+    });
+  }
+
+  // ---------------- EliminateLimit ----------------
+  int32_t rule_elim_limit(int32_t plan) const {
+    return rewrite_plan(plan, [&](int32_t node) -> int32_t {
+      const PNode n = b.nodes[node];
+      if (n.kind != P_LIMIT) return node;
+      auto ks = b.kids(node);
+      int64_t skip, fetch;
+      bool has_fetch;
+      limit_parts(node, &skip, &has_fetch, &fetch);
+      if (!has_fetch && skip == 0) return ks[0];
+      const PNode cn = b.nodes[ks[0]];
+      if (cn.kind == P_LIMIT) {
+        int64_t iskip, ifetch;
+        bool ihas;
+        limit_parts(ks[0], &iskip, &ihas, &ifetch);
+        int64_t nskip = iskip + skip;
+        bool nhas = false;
+        int64_t nfetch = 0;
+        if (ihas) {
+          nhas = true;
+          nfetch = ifetch - skip > 0 ? ifetch - skip : 0;
+        }
+        if (has_fetch) {
+          nfetch = nhas ? std::min(nfetch, fetch) : fetch;
+          nhas = true;
+        }
+        auto inner_ks = b.kids(ks[0]);
+        std::vector<int32_t> fields(ks.begin() + 1, ks.end());
+        return mk_limit(inner_ks[0], nskip, nhas, nfetch, fields);
+      }
+      return node;
+    });
+  }
+
+  // ---------------- PushDownLimit ----------------
+  int32_t rule_pushdown_limit(int32_t plan) const {
+    return rewrite_plan(plan, [&](int32_t node) -> int32_t {
+      const PNode n = b.nodes[node];
+      if (n.kind != P_LIMIT) return node;
+      int64_t skip, fetch;
+      bool has_fetch;
+      limit_parts(node, &skip, &has_fetch, &fetch);
+      if (!has_fetch) return node;
+      int64_t want = skip + fetch;
+      auto ks = b.kids(node);
+      int32_t child = ks[0];
+      std::vector<int32_t> lim_fields(ks.begin() + 1, ks.end());
+      const PNode cn = b.nodes[child];
+      if (cn.kind == P_SORT) {
+        bool s_has = (cn.flags & 1) != 0;
+        int64_t s_fetch = (int64_t)cn.dval;
+        if (!s_has || s_fetch > want) {
+          auto cks = b.kids(child);
+          std::vector<int32_t> nk = cks;
+          int32_t sorted = b.add(P_SORT, nk, cn.flags | 1, cn.ival,
+                                 (double)want, cn.s0, cn.s1);
+          return mk_limit(sorted, skip, true, fetch, lim_fields);
+        }
+      }
+      if (cn.kind == P_PROJECTION) {
+        auto cks = b.kids(child);
+        auto inner_fields = schema_of(cks[0]);
+        int32_t pushed = mk_limit(cks[0], 0, true, want, inner_fields);
+        std::vector<int32_t> pk = cks;
+        pk[0] = pushed;
+        int32_t proj = b.add(P_PROJECTION, pk, cn.flags, cn.ival, cn.dval,
+                             cn.s0, cn.s1);
+        return mk_limit(proj, skip, true, fetch, lim_fields);
+      }
+      if (cn.kind == P_UNION && (cn.flags & 1)) {
+        auto cks = b.kids(child);
+        int nf = (int)cn.ival;
+        std::vector<int32_t> nk(cks.begin(), cks.begin() + nf);
+        for (size_t i = nf; i < cks.size(); ++i) {
+          auto kid_fields = schema_of(cks[i]);
+          nk.push_back(mk_limit(cks[i], 0, true, want, kid_fields));
+        }
+        int32_t u = b.add(P_UNION, nk, cn.flags, cn.ival, cn.dval, cn.s0,
+                          cn.s1);
+        return mk_limit(u, skip, true, fetch, lim_fields);
+      }
+      return node;
+    });
+  }
+
+  // ---------------- EliminateOuterJoin ----------------
+  bool strong(int32_t e) const {
+    static const std::set<std::string> null_prop = {
+        "eq", "ne", "lt", "le", "gt", "ge", "add", "sub", "mul", "div",
+        "mod", "neg", "not", "like", "ilike", "similar", "between"};
+    const PNode n = b.nodes[e];
+    if (n.kind == E_COLREF || n.kind == E_OUTERREF || n.kind == E_LITERAL)
+      return true;
+    if (n.kind == E_CAST) return strong(b.kids(e)[0]);
+    if (n.kind == E_SCALARFN && null_prop.count(str_of(n.s0))) {
+      for (int32_t k : b.kids(e))
+        if (!strong(k)) return false;
+      return true;
+    }
+    return false;
+  }
+
+  bool refs_in_range(int32_t e, int64_t lo, int64_t hi) const {
+    bool found = false;
+    walk_expr(e, [&](int32_t x) {
+      const PNode n = b.nodes[x];
+      if ((n.kind == E_COLREF || n.kind == E_OUTERREF) && lo <= n.ival &&
+          n.ival < hi)
+        found = true;
+    });
+    return found;
+  }
+
+  bool rejects_nulls(int32_t e, int64_t lo, int64_t hi) const {
+    static const std::set<std::string> null_prop = {
+        "eq", "ne", "lt", "le", "gt", "ge", "add", "sub", "mul", "div",
+        "mod", "neg", "not", "like", "ilike", "similar", "between"};
+    const PNode n = b.nodes[e];
+    if (n.kind != E_SCALARFN) return false;
+    std::string op = str_of(n.s0);
+    auto ks = b.kids(e);
+    if (op == "and") {
+      for (int32_t k : ks)
+        if (rejects_nulls(k, lo, hi)) return true;
+      return false;
+    }
+    if (op == "or") {
+      for (int32_t k : ks)
+        if (!rejects_nulls(k, lo, hi)) return false;
+      return true;
+    }
+    if (op == "is_not_null" || op == "isnotnull")
+      return strong(ks[0]) && refs_in_range(ks[0], lo, hi);
+    if (null_prop.count(op)) {
+      for (int32_t k : ks)
+        if (!strong(k)) return false;
+      return refs_in_range(e, lo, hi);
+    }
+    return false;
+  }
+
+  int32_t rule_elim_outer_join(int32_t plan) const {
+    return rewrite_plan(plan, [&](int32_t node) -> int32_t {
+      const PNode n = b.nodes[node];
+      if (n.kind != P_FILTER) return node;
+      auto ks = b.kids(node);
+      if (b.nodes[ks[0]].kind != P_JOIN) return node;
+      JoinParts jp = join_parts(ks[0]);
+      if (jp.jt != "LEFT" && jp.jt != "RIGHT" && jp.jt != "FULL") return node;
+      int nleft = schema_width(jp.left);
+      int total = (int)jp.fields.size();
+      bool rej_left = false, rej_right = false;
+      std::vector<int32_t> cjs;
+      conjuncts_of(ks.back(), cjs);
+      for (int32_t c : cjs) {
+        rej_left = rej_left || rejects_nulls(c, 0, nleft);
+        rej_right = rej_right || rejects_nulls(c, nleft, total);
+      }
+      std::string new_jt;
+      if (jp.jt == "LEFT" && rej_right) new_jt = "INNER";
+      else if (jp.jt == "RIGHT" && rej_left) new_jt = "INNER";
+      else if (jp.jt == "FULL") {
+        if (rej_left && rej_right) new_jt = "INNER";
+        else if (rej_left) new_jt = "LEFT";
+        else if (rej_right) new_jt = "RIGHT";
+      }
+      if (new_jt.empty()) return node;
+      jp.jt = new_jt;
+      int32_t join = mk_join(jp);
+      std::vector<int32_t> nk = ks;
+      nk[0] = join;
+      return b.add(P_FILTER, nk, n.flags, n.ival, n.dval, n.s0, n.s1);
+    });
+  }
+
+
+  // ---------------- PushDownFilter ----------------
+  int32_t rule_pushdown_filter(int32_t plan) const {
+    std::function<int32_t(int32_t)> go = [&](int32_t node0) -> int32_t {
+      // bottom-up first
+      int32_t node = node0;
+      auto ins = inputs_of(node);
+      if (!ins.empty()) {
+        std::vector<int32_t> ni;
+        bool changed = false;
+        for (int32_t k : ins) {
+          int32_t t = go(k);
+          changed |= t != k;
+          ni.push_back(t);
+        }
+        if (changed) node = with_inputs(node, ni);
+      }
+      const PNode n = b.nodes[node];
+      if (n.kind != P_FILTER) return node;
+      auto ks = b.kids(node);
+      int32_t child = ks[0];
+      int32_t pred = ks.back();
+      std::vector<int32_t> parts;
+      conjuncts_of(pred, parts);
+      const PNode cn = b.nodes[child];
+
+      if (cn.kind == P_FILTER) {
+        auto cks = b.kids(child);
+        std::vector<int32_t> all = parts;
+        conjuncts_of(cks.back(), all);
+        return go(mk_filter_with_fields(
+            cks[0], conjoin(all),
+            std::vector<int32_t>(cks.begin() + 1, cks.end() - 1)));
+      }
+
+      if (cn.kind == P_PROJECTION) {
+        auto cks = b.kids(child);
+        int nf = (int)cn.ival;
+        std::vector<int32_t> proj_exprs(cks.begin() + 1 + nf, cks.end());
+        std::vector<int32_t> pushable, kept;
+        for (int32_t c : parts) {
+          if (is_volatile(c) || has_subquery(c)) {
+            kept.push_back(c);
+            continue;
+          }
+          std::set<int64_t> cols;
+          referenced_cols(c, cols);
+          bool ok = true;
+          for (int64_t i : cols) {
+            if (i < 0 || i >= (int64_t)proj_exprs.size()) { ok = false; break; }
+            int k = b.nodes[proj_exprs[i]].kind;
+            // Python: expr must be ColumnRef/Literal/Cast/ScalarFunc/Case
+            // and contain no Agg/Window anywhere
+            if (!(k == E_COLREF || k == E_LITERAL || k == E_CAST ||
+                  k == E_SCALARFN || k == E_CASE)) {
+              ok = false;
+              break;
+            }
+            if (expr_contains(proj_exprs[i], [](const PNode m) {
+                  return m.kind == E_AGG || m.kind == E_WINDOW;
+                })) {
+              ok = false;
+              break;
+            }
+          }
+          if (ok) pushable.push_back(c);
+          else kept.push_back(c);
+        }
+        if (!pushable.empty()) {
+          std::vector<int32_t> substed;
+          for (int32_t c : pushable) {
+            substed.push_back(transform_expr(c, [&](int32_t x) -> int32_t {
+              const PNode m = b.nodes[x];
+              if (m.kind == E_COLREF) return proj_exprs[m.ival];
+              return x;
+            }));
+          }
+          int32_t new_input = go(mk_filter(cks[0], conjoin(substed)));
+          std::vector<int32_t> pk = cks;
+          pk[0] = new_input;
+          int32_t proj = b.add(P_PROJECTION, pk, cn.flags, cn.ival, cn.dval,
+                               cn.s0, cn.s1);
+          if (!kept.empty())
+            return mk_filter_with_fields(
+                proj, conjoin(kept),
+                std::vector<int32_t>(cks.begin() + 1, cks.begin() + 1 + nf));
+          return proj;
+        }
+        return node;
+      }
+
+      if (cn.kind == P_SUBQUERY_ALIAS) {
+        auto cks = b.kids(child);
+        int32_t inner = go(mk_filter(cks[0], pred));
+        std::vector<int32_t> nk = cks;
+        nk[0] = inner;
+        return b.add(P_SUBQUERY_ALIAS, nk, cn.flags, cn.ival, cn.dval, cn.s0,
+                     cn.s1);
+      }
+
+      if (cn.kind == P_SORT) {
+        auto cks = b.kids(child);
+        int32_t inner = go(mk_filter(cks[0], pred));
+        std::vector<int32_t> nk = cks;
+        nk[0] = inner;
+        return b.add(P_SORT, nk, cn.flags, cn.ival, cn.dval, cn.s0, cn.s1);
+      }
+
+      if (cn.kind == P_JOIN || cn.kind == P_CROSSJOIN) {
+        auto cins = inputs_of(child);
+        int nleft = schema_width(cins[0]);
+        std::string jt = cn.kind == P_JOIN ? str_of(cn.s0) : "CROSS";
+        std::vector<int32_t> left_parts, right_parts, kept;
+        for (int32_t c : parts) {
+          if (is_volatile(c) || has_subquery(c)) {
+            kept.push_back(c);
+            continue;
+          }
+          std::set<int64_t> cols;
+          referenced_cols(c, cols);
+          bool to_left = !cols.empty() && *cols.rbegin() < nleft &&
+                         (jt == "INNER" || jt == "LEFT" || jt == "CROSS" ||
+                          jt == "LEFTSEMI" || jt == "LEFTANTI");
+          bool to_right = !cols.empty() && *cols.begin() >= nleft &&
+                          (jt == "INNER" || jt == "RIGHT" || jt == "CROSS");
+          if (to_left) left_parts.push_back(c);
+          else if (to_right) right_parts.push_back(shift_cols(c, -nleft));
+          else kept.push_back(c);
+        }
+        if (!left_parts.empty() || !right_parts.empty()) {
+          int32_t l = cins[0], r = cins[1];
+          if (!left_parts.empty()) l = go(mk_filter(l, conjoin(left_parts)));
+          if (!right_parts.empty()) r = go(mk_filter(r, conjoin(right_parts)));
+          int32_t new_child = with_inputs(child, {l, r});
+          if (!kept.empty())
+            return mk_filter_with_fields(
+                new_child, conjoin(kept),
+                std::vector<int32_t>(ks.begin() + 1, ks.end() - 1));
+          return new_child;
+        }
+        return node;
+      }
+
+      if (cn.kind == P_UNION) {
+        auto cks = b.kids(child);
+        int nf = (int)cn.ival;
+        std::vector<int32_t> nk(cks.begin(), cks.begin() + nf);
+        for (size_t i = nf; i < cks.size(); ++i)
+          nk.push_back(go(mk_filter(cks[i], pred)));
+        return b.add(P_UNION, nk, cn.flags, cn.ival, cn.dval, cn.s0, cn.s1);
+      }
+
+      if (cn.kind == P_AGGREGATE) {
+        auto cks = b.kids(child);
+        int nf = (int)cn.ival;
+        int ngroups = cn.flags;
+        std::vector<int32_t> group_exprs(cks.begin() + 1 + nf,
+                                         cks.begin() + 1 + nf + ngroups);
+        std::vector<int32_t> pushable, kept;
+        for (int32_t c : parts) {
+          std::set<int64_t> cols;
+          referenced_cols(c, cols);
+          if (!cols.empty() && *cols.rbegin() < ngroups && !is_volatile(c) &&
+              !has_subquery(c))
+            pushable.push_back(c);
+          else
+            kept.push_back(c);
+        }
+        if (!pushable.empty()) {
+          std::vector<int32_t> substed;
+          for (int32_t c : pushable)
+            substed.push_back(transform_expr(c, [&](int32_t x) -> int32_t {
+              const PNode m = b.nodes[x];
+              if (m.kind == E_COLREF) return group_exprs[m.ival];
+              return x;
+            }));
+          int32_t inner = go(mk_filter(cks[0], conjoin(substed)));
+          std::vector<int32_t> nk = cks;
+          nk[0] = inner;
+          int32_t agg = b.add(P_AGGREGATE, nk, cn.flags, cn.ival, cn.dval,
+                              cn.s0, cn.s1);
+          if (!kept.empty())
+            return mk_filter_with_fields(
+                agg, conjoin(kept),
+                std::vector<int32_t>(cks.begin() + 1, cks.begin() + 1 + nf));
+          return agg;
+        }
+        return node;
+      }
+
+      if (cn.kind == P_TABLESCAN && predicate_pushdown) {
+        std::vector<int32_t> ok, kept;
+        for (int32_t c : parts) {
+          if (is_volatile(c) || has_subquery(c)) kept.push_back(c);
+          else ok.push_back(c);
+        }
+        if (!ok.empty()) {
+          // extend the scan: fields + parts + existing filters + new ones
+          auto fields = schema_of(child);
+          auto cks = b.kids(child);
+          std::vector<int32_t> pparts, old_filters;
+          if (cn.flags & 3) {
+            for (size_t i = fields.size(); i < cks.size(); ++i) {
+              if (b.nodes[cks[i]].kind == P_PART) pparts.push_back(cks[i]);
+              else old_filters.push_back(cks[i]);
+            }
+          }
+          std::vector<int32_t> nk = fields;
+          nk.insert(nk.end(), pparts.begin(), pparts.end());
+          nk.insert(nk.end(), old_filters.begin(), old_filters.end());
+          nk.insert(nk.end(), ok.begin(), ok.end());
+          int32_t flags = (cn.flags & 1) | 2;
+          int32_t scan = b.add(P_TABLESCAN, nk, flags,
+                               (int64_t)fields.size(), 0.0, cn.s0, cn.s1);
+          if (!kept.empty())
+            return mk_filter_with_fields(scan, conjoin(kept), fields);
+          return scan;
+        }
+        return node;
+      }
+      return node;
+    };
+    return go(plan);
+  }
+
+
+  // ---------------- PushDownProjection (_prune) ----------------
+  // exprs held by a node (rules._node_exprs)
+  std::vector<int32_t> node_exprs(int32_t id) const {
+    const PNode n = b.nodes[id];
+    auto ks = b.kids(id);
+    switch (n.kind) {
+      case P_PROJECTION:
+        return std::vector<int32_t>(ks.begin() + 1 + n.ival, ks.end());
+      case P_FILTER:
+        return {ks.back()};
+      case P_SORT: {
+        std::vector<int32_t> out;
+        for (size_t i = 1 + n.ival; i < ks.size(); ++i)
+          out.push_back(b.kids(ks[i])[0]);
+        return out;
+      }
+      case P_AGGREGATE:
+        return std::vector<int32_t>(ks.begin() + 1 + n.ival, ks.end());
+      case P_WINDOW:
+        return std::vector<int32_t>(ks.begin() + 1 + n.ival, ks.end());
+      case P_DISTRIBUTE_BY:
+        return std::vector<int32_t>(ks.begin() + 1 + n.ival, ks.end());
+      default:
+        return {};
+    }
+  }
+
+  struct Pruned {
+    int32_t plan;
+    std::map<int64_t, int64_t> mapping;
+  };
+
+  Pruned prune(int32_t id, const std::set<int64_t>& required) const {
+    const PNode n = b.nodes[id];
+    auto ks = b.kids(id);
+    std::map<int64_t, int64_t> ident;
+    int width = schema_width(id);
+    for (int i = 0; i < width; ++i) ident[i] = i;
+
+    if (n.kind == P_TABLESCAN) {
+      auto fields = schema_of(id);
+      std::vector<int32_t> pparts, filters;
+      if (n.flags & 3) {
+        for (size_t i = fields.size(); i < ks.size(); ++i) {
+          if (b.nodes[ks[i]].kind == P_PART) pparts.push_back(ks[i]);
+          else filters.push_back(ks[i]);
+        }
+      }
+      std::set<int64_t> keep_set = required;
+      for (int32_t f : filters) referenced_cols(f, keep_set);
+      std::vector<int64_t> keep(keep_set.begin(), keep_set.end());
+      bool has_proj = (n.flags & 1) != 0;
+      if ((int)keep.size() == (int)fields.size() && !has_proj)
+        return {id, ident};
+      std::map<int64_t, int64_t> mapping;
+      for (size_t i = 0; i < keep.size(); ++i) mapping[keep[i]] = (int64_t)i;
+      std::vector<int32_t> nfields, nparts, nfilters;
+      for (int64_t i : keep) {
+        nfields.push_back(fields[i]);
+        // projection names = kept field names
+        nparts.push_back(b.add(P_PART, {}, 0, 0, 0.0, b.nodes[fields[i]].s0));
+      }
+      for (int32_t f : filters) nfilters.push_back(remap_cols(f, mapping));
+      std::vector<int32_t> nk = nfields;
+      nk.insert(nk.end(), nparts.begin(), nparts.end());
+      nk.insert(nk.end(), nfilters.begin(), nfilters.end());
+      int32_t scan = b.add(P_TABLESCAN, nk,
+                           1 | (nfilters.empty() ? 0 : 2),
+                           (int64_t)nfields.size(), 0.0, n.s0, n.s1);
+      return {scan, mapping};
+    }
+
+    if (n.kind == P_PROJECTION) {
+      int nf = (int)n.ival;
+      std::vector<int32_t> exprs(ks.begin() + 1 + nf, ks.end());
+      std::vector<int64_t> keep(required.begin(), required.end());
+      std::set<int64_t> child_req;
+      for (int64_t i : keep) referenced_cols(exprs[i], child_req);
+      Pruned c = prune(ks[0], child_req);
+      std::map<int64_t, int64_t> mapping;
+      for (size_t i = 0; i < keep.size(); ++i) mapping[keep[i]] = (int64_t)i;
+      std::vector<int32_t> nfields, nexprs;
+      for (int64_t i : keep) {
+        nfields.push_back(ks[1 + i]);
+        nexprs.push_back(remap_cols(exprs[i], c.mapping));
+      }
+      std::vector<int32_t> nk{c.plan};
+      nk.insert(nk.end(), nfields.begin(), nfields.end());
+      nk.insert(nk.end(), nexprs.begin(), nexprs.end());
+      return {b.add(P_PROJECTION, nk, 0, (int64_t)nfields.size()), mapping};
+    }
+
+    if (n.kind == P_FILTER) {
+      int32_t pred = ks.back();
+      std::set<int64_t> child_req = required;
+      referenced_cols(pred, child_req);
+      Pruned c = prune(ks[0], child_req);
+      int32_t npred = remap_cols(pred, c.mapping);
+      std::map<int64_t, int64_t> mapping;
+      for (int64_t old : child_req) mapping[old] = c.mapping.at(old);
+      auto nfields = schema_of(c.plan);
+      return {mk_filter_with_fields(c.plan, npred, nfields), mapping};
+    }
+
+    if (n.kind == P_JOIN) {
+      JoinParts jp = join_parts(id);
+      int nleft = schema_width(jp.left);
+      std::set<int64_t> need = required;
+      for (int32_t pr : jp.on) {
+        auto pk = b.kids(pr);
+        referenced_cols(pk[0], need);
+        referenced_cols(pk[1], need);
+      }
+      if (jp.residual >= 0) referenced_cols(jp.residual, need);
+      std::set<int64_t> lreq, rreq;
+      for (int64_t i : need) {
+        if (i < nleft) lreq.insert(i);
+        else rreq.insert(i - nleft);
+      }
+      Pruned lc = prune(jp.left, lreq);
+      Pruned rc = prune(jp.right, rreq);
+      int new_nleft = schema_width(lc.plan);
+      std::map<int64_t, int64_t> cmap;
+      for (int64_t old : lreq) cmap[old] = lc.mapping.at(old);
+      for (int64_t old : rreq)
+        cmap[old + nleft] = rc.mapping.at(old) + new_nleft;
+      std::vector<int32_t> non;
+      for (int32_t pr : jp.on) {
+        auto pk = b.kids(pr);
+        non.push_back(b.add(P_ON_PAIR, {remap_cols(pk[0], cmap),
+                                        remap_cols(pk[1], cmap)}));
+      }
+      int32_t nresid = jp.residual >= 0 ? remap_cols(jp.residual, cmap) : -1;
+      std::vector<int32_t> nfields;
+      std::map<int64_t, int64_t> mapping;
+      if (jp.jt == "LEFTSEMI" || jp.jt == "LEFTANTI") {
+        nfields = schema_of(lc.plan);
+        for (int64_t old : required) mapping[old] = lc.mapping.at(old);
+      } else {
+        auto lf = schema_of(lc.plan);
+        auto rf = schema_of(rc.plan);
+        nfields = lf;
+        nfields.insert(nfields.end(), rf.begin(), rf.end());
+        for (int64_t old : required) mapping[old] = cmap.at(old);
+      }
+      JoinParts njp{lc.plan, rc.plan, nfields, non, nresid, jp.jt,
+                    jp.null_aware};
+      return {mk_join(njp), mapping};
+    }
+
+    if (n.kind == P_CROSSJOIN) {
+      int nleft = schema_width(ks[0]);
+      std::set<int64_t> lreq, rreq;
+      for (int64_t i : required) {
+        if (i < nleft) lreq.insert(i);
+        else rreq.insert(i - nleft);
+      }
+      Pruned lc = prune(ks[0], lreq);
+      Pruned rc = prune(ks[1], rreq);
+      int new_nleft = schema_width(lc.plan);
+      std::map<int64_t, int64_t> mapping;
+      for (int64_t old : lreq) mapping[old] = lc.mapping.at(old);
+      for (int64_t old : rreq)
+        mapping[old + nleft] = rc.mapping.at(old) + new_nleft;
+      auto lf = schema_of(lc.plan);
+      auto rf = schema_of(rc.plan);
+      std::vector<int32_t> nk{lc.plan, rc.plan};
+      nk.insert(nk.end(), lf.begin(), lf.end());
+      nk.insert(nk.end(), rf.begin(), rf.end());
+      std::map<int64_t, int64_t> out;
+      for (int64_t old : required) out[old] = mapping.at(old);
+      return {b.add(P_CROSSJOIN, nk), out};
+    }
+
+    if (n.kind == P_AGGREGATE) {
+      int nf = (int)n.ival;
+      int ngroups = n.flags;
+      std::vector<int32_t> groups(ks.begin() + 1 + nf,
+                                  ks.begin() + 1 + nf + ngroups);
+      std::vector<int32_t> aggs(ks.begin() + 1 + nf + ngroups, ks.end());
+      std::set<int64_t> keep_agg_set;
+      for (int64_t i : required)
+        if (i >= ngroups) keep_agg_set.insert(i - ngroups);
+      std::vector<int64_t> keep_aggs(keep_agg_set.begin(), keep_agg_set.end());
+      std::set<int64_t> child_req;
+      for (int32_t g : groups) referenced_cols(g, child_req);
+      for (int64_t i : keep_aggs) referenced_cols(aggs[i], child_req);
+      Pruned c = prune(ks[0], child_req);
+      std::vector<int32_t> ngroups_v, naggs_v, nfields;
+      for (int32_t g : groups) ngroups_v.push_back(remap_cols(g, c.mapping));
+      for (int64_t i : keep_aggs)
+        naggs_v.push_back(remap_cols(aggs[i], c.mapping));
+      for (int i = 0; i < ngroups; ++i) nfields.push_back(ks[1 + i]);
+      for (int64_t i : keep_aggs) nfields.push_back(ks[1 + ngroups + i]);
+      std::map<int64_t, int64_t> mapping;
+      for (int64_t i : required) {
+        if (i < ngroups) mapping[i] = i;
+        else {
+          auto it = std::find(keep_aggs.begin(), keep_aggs.end(), i - ngroups);
+          mapping[i] = ngroups + (it - keep_aggs.begin());
+        }
+      }
+      std::vector<int32_t> nk{c.plan};
+      nk.insert(nk.end(), nfields.begin(), nfields.end());
+      nk.insert(nk.end(), ngroups_v.begin(), ngroups_v.end());
+      nk.insert(nk.end(), naggs_v.begin(), naggs_v.end());
+      return {b.add(P_AGGREGATE, nk, ngroups, (int64_t)nfields.size()),
+              mapping};
+    }
+
+    if (n.kind == P_SORT || n.kind == P_DISTRIBUTE_BY) {
+      auto exprs = node_exprs(id);
+      std::set<int64_t> child_req = required;
+      for (int32_t e : exprs) referenced_cols(e, child_req);
+      Pruned c = prune(ks[0], child_req);
+      std::map<int64_t, int64_t> mapping;
+      for (int64_t old : required) mapping[old] = c.mapping.at(old);
+      auto nfields = schema_of(c.plan);
+      if (n.kind == P_SORT) {
+        std::vector<int32_t> nk{c.plan};
+        nk.insert(nk.end(), nfields.begin(), nfields.end());
+        for (size_t i = 1 + n.ival; i < ks.size(); ++i) {
+          const PNode kn = b.nodes[ks[i]];
+          nk.push_back(b.add(P_SORTKEY,
+                             {remap_cols(b.kids(ks[i])[0], c.mapping)},
+                             kn.flags));
+        }
+        return {b.add(P_SORT, nk, n.flags, (int64_t)nfields.size(), n.dval),
+                mapping};
+      }
+      std::vector<int32_t> nk{c.plan};
+      nk.insert(nk.end(), nfields.begin(), nfields.end());
+      for (int32_t e : exprs) nk.push_back(remap_cols(e, c.mapping));
+      return {b.add(P_DISTRIBUTE_BY, nk, 0, (int64_t)nfields.size()), mapping};
+    }
+
+    if (n.kind == P_LIMIT) {
+      Pruned c = prune(ks[0], required);
+      std::map<int64_t, int64_t> mapping;
+      for (int64_t old : required) mapping[old] = c.mapping.at(old);
+      auto nfields = schema_of(c.plan);
+      int64_t skip, fetch;
+      bool has_fetch;
+      limit_parts(id, &skip, &has_fetch, &fetch);
+      return {mk_limit(c.plan, skip, has_fetch, fetch, nfields), mapping};
+    }
+
+    if (n.kind == P_SUBQUERY_ALIAS) {
+      Pruned c = prune(ks[0], required);
+      std::map<int64_t, int64_t> mapping;
+      for (int64_t old : required) mapping[old] = c.mapping.at(old);
+      // alias fields keep alias-schema entries for surviving columns
+      std::map<int64_t, int64_t> inv;
+      for (auto& [k2, v] : c.mapping) inv[v] = k2;
+      auto child_fields = schema_of(c.plan);
+      auto own_fields = schema_of(id);
+      std::vector<int32_t> nfields;
+      for (size_t ni = 0; ni < child_fields.size(); ++ni) {
+        auto it = inv.find((int64_t)ni);
+        if (it != inv.end() && it->second < (int64_t)own_fields.size())
+          nfields.push_back(own_fields[it->second]);
+        else
+          nfields.push_back(child_fields[ni]);
+      }
+      std::vector<int32_t> nk{c.plan};
+      nk.insert(nk.end(), nfields.begin(), nfields.end());
+      return {b.add(P_SUBQUERY_ALIAS, nk, n.flags, n.ival, n.dval, n.s0,
+                    n.s1),
+              mapping};
+    }
+
+    // default: children pruned with full requirements
+    auto ins = inputs_of(id);
+    if (!ins.empty()) {
+      std::vector<int32_t> ni;
+      bool changed = false;
+      for (int32_t k : ins) {
+        std::set<int64_t> full;
+        for (int i = 0; i < schema_width(k); ++i) full.insert(i);
+        Pruned c = prune(k, full);
+        changed |= c.plan != k;
+        ni.push_back(c.plan);
+      }
+      if (changed) id = with_inputs(id, ni);
+    }
+    return {id, ident};
+  }
+
+  int32_t rule_pushdown_projection(int32_t plan) const {
+    std::set<int64_t> required;
+    int width = schema_width(plan);
+    for (int i = 0; i < width; ++i) required.insert(i);
+    Pruned out = prune(plan, required);
+    bool identity = true;
+    for (int64_t i : required)
+      if (out.mapping.at(i) != i) identity = false;
+    if (!identity) {
+      auto own_fields = schema_of(plan);
+      std::vector<int32_t> exprs, nfields;
+      for (int64_t i : required) {
+        const PNode f = b.nodes[own_fields[i]];
+        exprs.push_back(b.add(E_COLREF, {},
+                              ((f.flags >> 8) << 8) | (f.flags & 1),
+                              out.mapping.at(i), 0.0, f.s0));
+        nfields.push_back(own_fields[i]);
+      }
+      std::vector<int32_t> nk{out.plan};
+      nk.insert(nk.end(), nfields.begin(), nfields.end());
+      nk.insert(nk.end(), exprs.begin(), exprs.end());
+      return b.add(P_PROJECTION, nk, 0, (int64_t)nfields.size());
+    }
+    return out.plan;
+  }
+
+
+  // ---------------- DecorrelateSubqueries ----------------
+  bool has_outer_ref(int32_t e) const {
+    return expr_contains(e, [](const PNode n) { return n.kind == E_OUTERREF; });
+  }
+
+  // match `outer_expr = inner_expr` (either side); (-1,-1) when no match
+  std::pair<int32_t, int32_t> outer_eq_pair(int32_t c) const {
+    if (!is_fn(c, "eq")) return {-1, -1};
+    auto ks = b.kids(c);
+    auto side_info = [&](int32_t e, bool* all_outer, bool* has) {
+      *all_outer = true;
+      *has = false;
+      walk_expr(e, [&](int32_t x) {
+        const PNode n = b.nodes[x];
+        if (n.kind == E_OUTERREF) *has = true;
+        else if (n.kind == E_COLREF) *all_outer = false;
+      });
+    };
+    bool a_all, a_has, b_all, b_has;
+    side_info(ks[0], &a_all, &a_has);
+    side_info(ks[1], &b_all, &b_has);
+    if (a_has && a_all && !b_has) return {ks[0], ks[1]};
+    if (b_has && b_all && !a_has) return {ks[1], ks[0]};
+    return {-1, -1};
+  }
+
+  int32_t outer_to_local(int32_t e) const {
+    return transform_expr(e, [&](int32_t x) -> int32_t {
+      const PNode n = b.nodes[x];
+      if (n.kind == E_OUTERREF)
+        return b.add(E_COLREF, {}, n.flags, n.ival, n.dval, n.s0, n.s1);
+      return x;
+    });
+  }
+
+  bool nullable_expr(int32_t e) const {
+    bool out = false;
+    walk_expr(e, [&](int32_t x) {
+      const PNode n = b.nodes[x];
+      if ((n.kind == E_COLREF || n.kind == E_OUTERREF) && (n.flags & 1))
+        out = true;
+      if (n.kind == E_LITERAL && (n.flags & 0xFF) == LT_NULL) out = true;
+    });
+    return out;
+  }
+
+  void all_exprs_below(int32_t plan, std::vector<int32_t>& out) const {
+    for (int32_t e : node_exprs(plan)) out.push_back(e);
+    // TableScan filters count as node exprs in Python? _node_exprs has no
+    // TableScan case -> no.  walk_plan order: node then children.
+    for (int32_t k : inputs_of(plan)) all_exprs_below(k, out);
+  }
+
+  int32_t mk_field_node(const std::string& name, int ty, bool nullable) const {
+    return b.add(P_FIELD, {}, (ty << 8) | (nullable ? 1 : 0), 0, 0.0,
+                 b.intern_mut(name));
+  }
+
+  int32_t mk_colref_e(int64_t idx, const std::string& name, int ty,
+                      bool nullable) const {
+    return b.add(E_COLREF, {}, ty_flags(ty, nullable ? 1 : 0), idx, 0.0,
+                 b.intern_mut(name));
+  }
+
+  struct Correlation {
+    int32_t core = -1;                        // plan id (or -1: no match)
+    std::vector<int32_t> proj_exprs;          // exprs of the top projection
+    std::vector<std::pair<int32_t, int32_t>> pairs;  // (outer, inner)
+    std::vector<int32_t> corr_residuals;
+  };
+
+  Correlation extract_correlation(int32_t sub) const {
+    Correlation out;
+    int32_t node = sub;
+    while (b.nodes[node].kind == P_SUBQUERY_ALIAS ||
+           b.nodes[node].kind == P_DISTINCT)
+      node = b.kids(node)[0];
+    if (b.nodes[node].kind != P_PROJECTION) return out;
+    const PNode pn = b.nodes[node];
+    auto pks = b.kids(node);
+    std::vector<int32_t> proj_exprs(pks.begin() + 1 + pn.ival, pks.end());
+    std::vector<int32_t> kept;
+    int32_t core = pks[0];
+    std::vector<std::pair<int32_t, int32_t>> pairs;
+    std::vector<int32_t> corr_residuals;
+    while (b.nodes[core].kind == P_FILTER) {
+      auto fks = b.kids(core);
+      std::vector<int32_t> cjs;
+      conjuncts_of(fks.back(), cjs);
+      for (int32_t c : cjs) {
+        auto pr = outer_eq_pair(c);
+        if (pr.first >= 0) {
+          pairs.push_back(pr);
+        } else if (has_outer_ref(c)) {
+          if (has_subquery(c)) return out;
+          corr_residuals.push_back(c);
+        } else {
+          kept.push_back(c);
+        }
+      }
+      core = fks[0];
+    }
+    std::vector<int32_t> below;
+    all_exprs_below(core, below);
+    for (int32_t e : below)
+      if (has_outer_ref(e)) return out;
+    for (int32_t e : proj_exprs)
+      if (has_outer_ref(e)) return out;
+    if (!kept.empty()) core = mk_filter(core, conjoin(kept));
+    out.core = core;
+    out.proj_exprs = proj_exprs;
+    out.pairs = pairs;
+    out.corr_residuals = corr_residuals;
+    return out;
+  }
+
+  int expr_ty(int32_t e) const { return ty_of_flags(b.nodes[e].flags); }
+
+  int32_t rewrite_exists(int32_t plan_e, int32_t child, bool anti) const {
+    Correlation c = extract_correlation(plan_e);
+    if (c.core < 0 || (c.pairs.empty() && c.corr_residuals.empty()))
+      return -1;
+    int nleft = schema_width(child);
+    std::vector<int32_t> key_exprs;
+    for (auto& pr : c.pairs) key_exprs.push_back(pr.second);
+    std::set<int64_t> resid_inner_set;
+    for (int32_t r : c.corr_residuals)
+      walk_expr(r, [&](int32_t x) {
+        const PNode n = b.nodes[x];
+        if (n.kind == E_COLREF) resid_inner_set.insert(n.ival);
+      });
+    std::vector<int64_t> resid_inner(resid_inner_set.begin(),
+                                     resid_inner_set.end());
+    std::vector<int32_t> out_exprs = key_exprs;
+    auto core_fields = schema_of(c.core);
+    for (int64_t i : resid_inner) {
+      const PNode f = b.nodes[core_fields[i]];
+      out_exprs.push_back(b.add(E_COLREF, {}, f.flags, i, 0.0, f.s0));
+    }
+    std::vector<int32_t> fields;
+    for (size_t i = 0; i < out_exprs.size(); ++i)
+      fields.push_back(mk_field_node("__ckey" + std::to_string(i),
+                                     expr_ty(out_exprs[i]), true));
+    std::vector<int32_t> sk{c.core};
+    sk.insert(sk.end(), fields.begin(), fields.end());
+    sk.insert(sk.end(), out_exprs.begin(), out_exprs.end());
+    int32_t sub = b.add(P_PROJECTION, sk, 0, (int64_t)fields.size());
+    std::vector<int32_t> on;
+    for (size_t i = 0; i < c.pairs.size(); ++i) {
+      int32_t le = outer_to_local(c.pairs[i].first);
+      int32_t re = mk_colref_e(nleft + i, "__ckey" + std::to_string(i),
+                               expr_ty(key_exprs[i]), true);
+      on.push_back(b.add(P_ON_PAIR, {le, re}));
+    }
+    std::map<int64_t, int64_t> inner_pos;
+    for (size_t j = 0; j < resid_inner.size(); ++j)
+      inner_pos[resid_inner[j]] = nleft + key_exprs.size() + j;
+    std::vector<int32_t> fixed;
+    for (int32_t r : c.corr_residuals) {
+      fixed.push_back(transform_expr(r, [&](int32_t x) -> int32_t {
+        const PNode n = b.nodes[x];
+        if (n.kind == E_OUTERREF)
+          return b.add(E_COLREF, {}, n.flags, n.ival, n.dval, n.s0, n.s1);
+        if (n.kind == E_COLREF)
+          return b.add(E_COLREF, {}, n.flags, inner_pos.at(n.ival), n.dval,
+                       n.s0, n.s1);
+        return x;
+      }));
+    }
+    int32_t jfilter = fixed.empty() ? -1 : conjoin(fixed);
+    JoinParts jp{child, sub, schema_of(child), on, jfilter,
+                 anti ? "LEFTANTI" : "LEFTSEMI", false};
+    return mk_join(jp);
+  }
+
+  int32_t rewrite_in(int32_t arg, int32_t plan_e, int32_t child,
+                     bool anti) const {
+    Correlation c = extract_correlation(plan_e);
+    if (c.core < 0 || !c.corr_residuals.empty()) return -1;
+    auto sub_schema = schema_of(plan_e);
+    bool sub_nullable = (b.nodes[sub_schema[0]].flags & 1) != 0;
+    bool null_aware = anti && (sub_nullable || nullable_expr(arg));
+    int nleft = schema_width(child);
+    std::vector<int32_t> out_exprs{c.proj_exprs[0]};
+    for (auto& pr : c.pairs) out_exprs.push_back(pr.second);
+    std::vector<int32_t> fields;
+    for (size_t i = 0; i < out_exprs.size(); ++i)
+      fields.push_back(mk_field_node("__ckey" + std::to_string(i),
+                                     expr_ty(out_exprs[i]), true));
+    std::vector<int32_t> sk{c.core};
+    sk.insert(sk.end(), fields.begin(), fields.end());
+    sk.insert(sk.end(), out_exprs.begin(), out_exprs.end());
+    int32_t sub = b.add(P_PROJECTION, sk, 0, (int64_t)fields.size());
+    std::vector<int32_t> on;
+    on.push_back(b.add(P_ON_PAIR, {arg, mk_colref_e(
+        nleft, "__ckey0", expr_ty(out_exprs[0]), true)}));
+    for (size_t i = 0; i < c.pairs.size(); ++i) {
+      on.push_back(b.add(P_ON_PAIR, {
+          outer_to_local(c.pairs[i].first),
+          mk_colref_e(nleft + 1 + i, "__ckey" + std::to_string(1 + i),
+                      expr_ty(out_exprs[1 + i]), true)}));
+    }
+    JoinParts jp{child, sub, schema_of(child), on, -1,
+                 anti ? "LEFTANTI" : "LEFTSEMI", null_aware};
+    return mk_join(jp);
+  }
+
+  // try_rewrite for one conjunct; -1 when not applicable
+  int32_t try_rewrite_conjunct(int32_t pred, int32_t child) const {
+    const PNode n = b.nodes[pred];
+    if (n.kind == E_EXISTS)
+      return rewrite_exists(b.kids(pred)[0], child, (n.flags & 1) != 0);
+    if (is_fn(pred, "not")) {
+      int32_t inner = b.kids(pred)[0];
+      const PNode in_ = b.nodes[inner];
+      if (in_.kind == E_EXISTS)
+        return rewrite_exists(b.kids(inner)[0], child, !(in_.flags & 1));
+      if (in_.kind == E_INSUBQ) {
+        auto iks = b.kids(inner);
+        return rewrite_in(iks[0], iks[1], child, !(in_.flags & 1));
+      }
+    }
+    if (n.kind == E_INSUBQ) {
+      auto ks = b.kids(pred);
+      return rewrite_in(ks[0], ks[1], child, (n.flags & 1) != 0);
+    }
+    return -1;
+  }
+
+  // scalar-subquery rewrite; returns (new_child, new_conjunct) or (-1, _)
+  std::pair<int32_t, int32_t> rewrite_scalar(int32_t conjunct,
+                                             int32_t child) const {
+    std::vector<int32_t> subqs;
+    walk_expr(conjunct, [&](int32_t x) {
+      if (b.nodes[x].kind == E_SCALARSUBQ) subqs.push_back(x);
+    });
+    if (subqs.size() != 1) return {-1, -1};
+    int32_t sq = subqs[0];
+    int32_t node = b.kids(sq)[0];
+    while (b.nodes[node].kind == P_SUBQUERY_ALIAS) node = b.kids(node)[0];
+    if (b.nodes[node].kind != P_PROJECTION) return {-1, -1};
+    const PNode pn = b.nodes[node];
+    auto pks = b.kids(node);
+    std::vector<int32_t> proj_exprs(pks.begin() + 1 + pn.ival, pks.end());
+    if (proj_exprs.size() != 1) return {-1, -1};
+    int32_t agg = pks[0];
+    if (b.nodes[agg].kind != P_AGGREGATE || b.nodes[agg].flags != 0)
+      return {-1, -1};
+    const PNode an = b.nodes[agg];
+    auto aks = b.kids(agg);
+    std::vector<int32_t> agg_exprs(aks.begin() + 1 + an.ival, aks.end());
+    int32_t core = aks[0];
+    std::vector<std::pair<int32_t, int32_t>> pairs;
+    std::vector<int32_t> kept;
+    while (b.nodes[core].kind == P_FILTER) {
+      auto fks = b.kids(core);
+      std::vector<int32_t> cjs;
+      conjuncts_of(fks.back(), cjs);
+      for (int32_t cj : cjs) {
+        auto pr = outer_eq_pair(cj);
+        if (pr.first >= 0) pairs.push_back(pr);
+        else if (has_outer_ref(cj)) return {-1, -1};
+        else kept.push_back(cj);
+      }
+      core = fks[0];
+    }
+    if (pairs.empty()) return {-1, -1};
+    std::vector<int32_t> below;
+    all_exprs_below(core, below);
+    for (int32_t e : agg_exprs) below.push_back(e);
+    for (int32_t e : below)
+      if (has_outer_ref(e)) return {-1, -1};
+    if (!kept.empty()) core = mk_filter(core, conjoin(kept));
+    std::vector<int32_t> key_exprs;
+    for (auto& pr : pairs) key_exprs.push_back(pr.second);
+    int ngroups = (int)key_exprs.size();
+    int naggs = (int)agg_exprs.size();
+    std::vector<int32_t> agg_fields;
+    for (int i = 0; i < ngroups; ++i)
+      agg_fields.push_back(mk_field_node("__sckey" + std::to_string(i),
+                                         expr_ty(key_exprs[i]), true));
+    for (int j = 0; j < naggs; ++j)
+      agg_fields.push_back(mk_field_node("__scagg" + std::to_string(j),
+                                         expr_ty(agg_exprs[j]), true));
+    std::vector<int32_t> ak{core};
+    ak.insert(ak.end(), agg_fields.begin(), agg_fields.end());
+    ak.insert(ak.end(), key_exprs.begin(), key_exprs.end());
+    ak.insert(ak.end(), agg_exprs.begin(), agg_exprs.end());
+    int32_t agg2 = b.add(P_AGGREGATE, ak, ngroups,
+                         (int64_t)agg_fields.size());
+    std::vector<int32_t> sub_fields;
+    for (int j = 0; j < naggs; ++j)
+      sub_fields.push_back(mk_field_node("__scagg" + std::to_string(j),
+                                         expr_ty(agg_exprs[j]), true));
+    for (int i = 0; i < ngroups; ++i)
+      sub_fields.push_back(mk_field_node("__sckey" + std::to_string(i),
+                                         expr_ty(key_exprs[i]), true));
+    std::vector<int32_t> sub_exprs;
+    for (int j = 0; j < naggs; ++j)
+      sub_exprs.push_back(mk_colref_e(ngroups + j,
+                                      "__scagg" + std::to_string(j),
+                                      expr_ty(agg_exprs[j]), true));
+    for (int i = 0; i < ngroups; ++i)
+      sub_exprs.push_back(mk_colref_e(i, "__sckey" + std::to_string(i),
+                                      expr_ty(key_exprs[i]), true));
+    std::vector<int32_t> pk2{agg2};
+    pk2.insert(pk2.end(), sub_fields.begin(), sub_fields.end());
+    pk2.insert(pk2.end(), sub_exprs.begin(), sub_exprs.end());
+    int32_t sub = b.add(P_PROJECTION, pk2, 0, (int64_t)sub_fields.size());
+    int nleft = schema_width(child);
+    std::vector<int32_t> on;
+    for (int i = 0; i < ngroups; ++i)
+      on.push_back(b.add(P_ON_PAIR, {
+          outer_to_local(pairs[i].first),
+          mk_colref_e(nleft + naggs + i, "__sckey" + std::to_string(i),
+                      expr_ty(key_exprs[i]), true)}));
+    std::vector<int32_t> join_fields = schema_of(child);
+    join_fields.insert(join_fields.end(), sub_fields.begin(),
+                       sub_fields.end());
+    JoinParts jp{child, sub, join_fields, on, -1, "LEFT", false};
+    int32_t join = mk_join(jp);
+    // rebuild the subquery's projected expression against the join output
+    static const std::set<std::string> count_like = {"count", "count_star",
+                                                     "regr_count"};
+    int32_t val_expr = transform_expr(proj_exprs[0], [&](int32_t x) -> int32_t {
+      const PNode m = b.nodes[x];
+      if (m.kind == E_COLREF) {
+        int64_t j = m.ival;
+        int32_t a = agg_exprs[j];
+        int aty = expr_ty(a);
+        int32_t ref = mk_colref_e(nleft + j, "__scagg" + std::to_string(j),
+                                  aty, true);
+        std::string fname = str_of(b.nodes[a].s0);
+        if (count_like.count(fname)) {
+          int32_t zero = b.add(E_LITERAL, {}, ty_flags(aty, LT_INT), 0);
+          return b.add(E_SCALARFN, {ref, zero}, ty_flags(aty), 0, 0.0,
+                       b.intern_mut("coalesce"));
+        }
+        return ref;
+      }
+      return x;
+    });
+    int32_t new_conjunct = transform_expr(conjunct, [&](int32_t x) -> int32_t {
+      if (x == sq || b.eq(x, sq)) return val_expr;
+      return x;
+    });
+    return {join, new_conjunct};
+  }
+
+  int32_t rule_decorrelate(int32_t plan) const {
+    std::function<int32_t(int32_t)> go = [&](int32_t node0) -> int32_t {
+      int32_t node = node0;
+      auto ins = inputs_of(node);
+      if (!ins.empty()) {
+        std::vector<int32_t> ni;
+        bool changed = false;
+        for (int32_t k : ins) {
+          int32_t t = go(k);
+          changed |= t != k;
+          ni.push_back(t);
+        }
+        if (changed) node = with_inputs(node, ni);
+      }
+      // recurse into subquery plans embedded in expressions
+      node = map_node_exprs(node, [&](int32_t e) {
+        return transform_expr(e, [&](int32_t x) -> int32_t {
+          const PNode m = b.nodes[x];
+          if (m.kind == E_SCALARSUBQ || m.kind == E_EXISTS) {
+            auto ks = b.kids(x);
+            int32_t np = go(ks[0]);
+            if (np == ks[0]) return x;
+            return b.add(m.kind, {np}, m.flags, m.ival, m.dval, m.s0, m.s1);
+          }
+          if (m.kind == E_INSUBQ) {
+            auto ks = b.kids(x);
+            int32_t np = go(ks[1]);
+            if (np == ks[1]) return x;
+            return b.add(m.kind, {ks[0], np}, m.flags, m.ival, m.dval, m.s0,
+                         m.s1);
+          }
+          return x;
+        });
+      });
+      const PNode n = b.nodes[node];
+      if (n.kind != P_FILTER) return node;
+      auto ks = b.kids(node);
+      int32_t child = ks[0];
+      std::vector<int32_t> parts;
+      conjuncts_of(ks.back(), parts);
+      int orig_width = schema_width(child);
+      auto orig_fields = schema_of(child);
+      bool changed = false;
+      std::vector<int32_t> kept;
+      for (int32_t c : parts) {
+        int32_t new_child = try_rewrite_conjunct(c, child);
+        if (new_child >= 0) {
+          child = new_child;
+          changed = true;
+          continue;
+        }
+        auto res = rewrite_scalar(c, child);
+        if (res.first >= 0) {
+          child = res.first;
+          kept.push_back(res.second);
+          changed = true;
+          continue;
+        }
+        kept.push_back(c);
+      }
+      if (!changed) return node;
+      int32_t out = kept.empty() ? child : mk_filter(child, conjoin(kept));
+      if (schema_width(out) != orig_width) {
+        std::vector<int32_t> refs, nfields;
+        for (int i = 0; i < orig_width; ++i) {
+          const PNode f = b.nodes[orig_fields[i]];
+          refs.push_back(b.add(E_COLREF, {}, f.flags, i, 0.0, f.s0));
+          nfields.push_back(orig_fields[i]);
+        }
+        std::vector<int32_t> nk{out};
+        nk.insert(nk.end(), nfields.begin(), nfields.end());
+        nk.insert(nk.end(), refs.begin(), refs.end());
+        out = b.add(P_PROJECTION, nk, 0, (int64_t)nfields.size());
+      }
+      return out;
+    };
+    return go(plan);
+  }
+
+  // ---------------- driver ----------------
+  int32_t optimize(int32_t plan) const {
+    for (int pass = 0; pass < 2; ++pass) {
+      plan = rule_simplify(plan);
+      plan = rule_unwrap_cast(plan);
+      plan = rule_decorrelate(plan);
+      plan = rule_simplify(plan);
+      plan = rule_disjunctive(plan);
+      plan = rule_elim_cross_join(plan);
+      plan = rule_elim_limit(plan);
+      // FilterNullJoinKeys: no-op (join kernels drop NULL keys natively)
+      plan = rule_elim_outer_join(plan);
+      plan = rule_pushdown_limit(plan);
+      plan = rule_pushdown_filter(plan);
+      plan = rule_simplify(plan);
+      plan = rule_unwrap_cast(plan);
+      plan = rule_pushdown_projection(plan);
+      plan = rule_pushdown_limit(plan);
+    }
+    return plan;
+  }
+};
+
 }  // namespace
 
 extern "C" {
@@ -3172,5 +5234,58 @@ int32_t dsql_bind(const char* sql, int64_t n, const uint8_t* catalog_buf,
 }
 
 int32_t dsql_binder_abi_version() { return 1; }
+
+// Parse + bind + run the structural optimizer rule loop, all native.
+// Same rc codes as dsql_bind; `predicate_pushdown` mirrors the
+// sql.predicate_pushdown config knob.  Join reordering / DPP / embedded
+// subqueries remain Python post-passes on the decoded plan.
+int32_t dsql_plan(const char* sql, int64_t n, const uint8_t* catalog_buf,
+                  int64_t catalog_len, int32_t predicate_pushdown,
+                  uint8_t** out, int64_t* out_len) {
+  *out = nullptr;
+  *out_len = 0;
+  uint8_t* ast_buf = nullptr;
+  int64_t ast_len = 0;
+  int32_t prc = dsql_parse(sql, n, &ast_buf, &ast_len);
+  if (prc == 1) return 1;
+  if (prc == 2) {
+    *out = ast_buf;
+    *out_len = ast_len;
+    return 3;
+  }
+  Ast ast;
+  bool ok = ast.load(ast_buf, ast_len);
+  dsql_buf_free(ast_buf);
+  if (!ok) return 1;
+  try {
+    Catalog cat;
+    if (!cat.load(catalog_buf, catalog_len)) return 1;
+    auto stmts = ast.kids(ast.root);
+    if (stmts.size() != 1) return 1;
+    PBuilder pb;
+    Binder binder(ast, cat, pb);
+    int32_t root = binder.bind_statement(stmts[0]);
+    Optimizer opt(pb, predicate_pushdown != 0);
+    root = opt.optimize(root);
+    uint8_t* buf = pb.serialize(root, out_len);
+    if (!buf) return 1;
+    *out = buf;
+    return 0;
+  } catch (const BindErr& e) {
+    uint8_t* buf = static_cast<uint8_t*>(std::malloc(1 + e.msg.size()));
+    if (!buf) return 1;
+    buf[0] = static_cast<uint8_t>(e.klass);
+    std::memcpy(buf + 1, e.msg.data(), e.msg.size());
+    *out = buf;
+    *out_len = static_cast<int64_t>(1 + e.msg.size());
+    return 2;
+  } catch (const Unsupported&) {
+    return 1;
+  } catch (...) {
+    return 1;
+  }
+}
+
+int32_t dsql_optimizer_abi_version() { return 1; }
 
 }  // extern "C"

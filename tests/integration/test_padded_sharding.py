@@ -138,3 +138,16 @@ def test_padded_checkpoint_roundtrip(ctx7, tmp_path):
                  return_futures=False)
     assert int(got["n"][0]) == len(df)
     np.testing.assert_allclose(float(got["s"][0]), df.x.sum(), rtol=1e-9)
+
+
+def test_padded_frame_filter_mask(ctx7):
+    """A mask built from padded columns must never let pad rows through
+    (review finding: zero-filled pad rows satisfying e.g. `x >= 0`)."""
+    import jax.numpy as jnp
+
+    c, df = ctx7
+    t = _stored_table(c)
+    mask = t.columns["x"].data >= 0.0  # padded length; pad rows are 0.0 -> True
+    assert int(mask.shape[0]) == t.padded_rows
+    out = t.filter(mask)
+    assert out.num_rows == int((df.x >= 0).sum())
