@@ -34,6 +34,7 @@ DEFAULTS: Dict[str, Any] = {
     "sql.native.binder": "auto",  # C++ parse+bind (auto|on|off)
     "sql.compile": True,  # whole-pipeline jit for hot aggregation shapes
     "sql.compile.join": "auto",  # jit the shape-stable join probe phase
+    "sql.compile.select": True,  # one-kernel root select chains
     "sql.compile.segsum": "auto",  # scatter | matmul | pallas segment sums
     "sql.streaming.enabled": True,  # out-of-core parquet batch aggregation
     "sql.streaming.batch_rows": 2_000_000,

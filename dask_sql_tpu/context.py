@@ -73,7 +73,7 @@ class TpuFrame:
 
             with self._context.config.set(self._config_options):
                 executor = Executor(self._context)
-                self._result = executor.execute(self._plan)
+                self._result = executor.execute_root(self._plan)
         return self._result
 
     def compute(self):

@@ -1,0 +1,343 @@
+"""Compiled SELECT pipelines: one kernel + one transfer for root-level
+`scan -> filter* -> project [-> sort -> limit]` queries.
+
+The eager converters dispatch one XLA op per expression and per filter, then
+materialize column-by-column — on a tunneled TPU every dispatch and every
+pull is a round trip.  For the plan ROOT (the result goes straight to the
+host anyway), this module compiles the whole chain into ONE jitted program
+whose output is a single packed f64 matrix: row 0 is the selection mask,
+then each projected column (and its validity) — pulled in ONE device_get,
+compacted/ordered/limited with numpy on the host.
+
+Two static-shape kernels: kernel 1 evaluates the filter mask and its count
+(one scalar pull); kernel 2 — specialized per power-of-two survivor bucket,
+so XLA re-traces at most log2(n) times — compacts the input columns with a
+sized nonzero, evaluates the projections over the bucket, and packs
+everything into one matrix whose transfer size tracks the SURVIVORS, not
+the scan.  Sort/limit run on the compacted host result — the root is
+host-bound regardless, and np.lexsort on the survivor set replaces a device
+sort plus per-column gathers.
+
+Parity note: the reference executes the same shape as a dask task tree with
+one pandas kernel per operator; this is the TPU-native replacement.
+"""
+from __future__ import annotations
+
+import logging
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..columnar.column import Column
+from ..columnar.dtypes import STRING_TYPES, SqlType, sql_to_np
+from ..columnar.table import Table
+from ..planner import plan as p
+from ..planner.expressions import ColumnRef
+from .compiled import (
+    _TableMeta,
+    _TraceEval,
+    _Unsupported,
+    pack_flat,
+)
+
+logger = logging.getLogger(__name__)
+
+
+def _extract(root):
+    """Match [Limit]? [Sort]? Projection Filter* TableScan; None otherwise."""
+    node = root
+    limit = None
+    if isinstance(node, p.Limit):
+        limit = (node.skip, node.fetch)
+        node = node.input
+    sort_keys = None
+    sort_fetch = None
+    if isinstance(node, p.Sort):
+        sort_keys = list(node.keys)
+        sort_fetch = node.fetch  # caps the window INSIDE any outer Limit
+        node = node.input
+    if not isinstance(node, p.Projection):
+        return None
+    proj = node
+    node = proj.input
+    filters = []
+    while isinstance(node, p.Filter):
+        filters.append(node.predicate)
+        node = node.input
+    inner_limit = None
+    while isinstance(node, p.Limit):
+        # PushDownLimit parks (possibly stacked) Limits right above the
+        # scan: compose them (EliminateLimit's rule) into one row window
+        # baked into the mask
+        if inner_limit is None:
+            inner_limit = (node.skip, node.fetch)
+        else:
+            oskip, ofetch = inner_limit  # applied AFTER this inner node
+            iskip, ifetch = node.skip, node.fetch
+            fetches = [f for f in (
+                None if ifetch is None else max(ifetch - oskip, 0),
+                ofetch) if f is not None]
+            inner_limit = (iskip + oskip, min(fetches) if fetches else None)
+        node = node.input
+    if not isinstance(node, p.TableScan):
+        return None
+    return (node, list(filters) + list(node.filters), proj, sort_keys,
+            sort_fetch, limit, inner_limit)
+
+
+class CompiledSelect:
+    def __init__(self, table: Table, scan, filters, proj, sort_keys,
+                 sort_fetch, limit, inner_limit):
+        self.scan = scan
+        self.filters = filters
+        self.proj = proj
+        self.sort_keys = sort_keys
+        self.sort_fetch = sort_fetch
+        self.limit = limit
+        self.inner_limit = inner_limit
+        self.table: Optional[Table] = table
+
+        # eligibility: every output expr must trace; string outputs only as
+        # plain column refs (codes + dictionary pass through); sort keys must
+        # be output positions over non-string columns (host lexsort order on
+        # dictionary codes is only lexicographic for sorted dictionaries)
+        self.out_meta: List[Tuple[str, SqlType, Optional[object]]] = []
+        for e, f in zip(proj.exprs, proj.schema):
+            if f.sql_type in STRING_TYPES:
+                if not (isinstance(e, ColumnRef) and type(e) is ColumnRef):
+                    raise _Unsupported("computed string output")
+                dictionary = table.columns[table.column_names[e.index]].dictionary
+            else:
+                dictionary = None
+            self.out_meta.append((f.name, f.sql_type, dictionary))
+        if sort_keys is not None:
+            for k in sort_keys:
+                e = k.expr
+                if not (isinstance(e, ColumnRef) and type(e) is ColumnRef):
+                    raise _Unsupported("sort key is not an output column")
+                if proj.schema[e.index].sql_type in STRING_TYPES:
+                    dic = self.out_meta[e.index][2]
+                    if dic is None or not _dictionary_sorted(dic):
+                        raise _Unsupported("string sort key w/o sorted dict")
+
+        ev = _TraceEval(_TableMeta(table))
+        n_cols = len(table.column_names)
+        exprs = list(proj.exprs)
+        flts = list(filters)
+        self._pack_tags: List[Tuple[str, np.dtype]] = []
+
+        inner_limit = self.inner_limit
+
+        def mask_fn(datas, valids, row_valid):
+            slots = {i: (datas[i], valids[i]) for i in range(n_cols)}
+            nr = datas[0].shape[0] if datas else 0
+            mask = row_valid
+            for f in flts:
+                d, v = ev.eval(f, slots)
+                m = d if v is None else (d & v)
+                mask = m if mask is None else (mask & m)
+            if mask is None:
+                mask = jnp.ones(nr, dtype=bool)
+            elif mask.ndim == 0:  # constant predicate (e.g. WHERE 1 = 1)
+                mask = jnp.broadcast_to(mask, (nr,))
+            if inner_limit is not None:
+                # a Limit parked above the scan windows the FILTERED
+                # survivors (the scan applies its filters first): the
+                # survivor ordinal makes that a static-shape mask refinement
+                skip_i, fetch_i = inner_limit
+                ordinal = jnp.cumsum(mask.astype(jnp.int64))
+                w = ordinal > skip_i
+                if fetch_i is not None:
+                    w &= ordinal <= skip_i + fetch_i
+                mask = mask & w
+            return mask, jnp.sum(mask.astype(jnp.int64))
+
+        def gather_fn(datas, valids, mask, bucket):
+            # bucket is static per trace: sized nonzero keeps shapes static,
+            # and jit re-specializes per distinct bucket (<= log2 n traces)
+            (idx,) = jnp.nonzero(mask, size=bucket, fill_value=0)
+            slots = {}
+            for i in range(n_cols):
+                d = datas[i][idx]
+                v = valids[i][idx] if valids[i] is not None else None
+                slots[i] = (d, v)
+            flat = []
+            for e in exprs:
+                d, v = ev.eval(e, slots)
+                if d.ndim == 0:  # scalar literal output: broadcast
+                    d = jnp.broadcast_to(d, (bucket,))
+                if v is not None and v.ndim == 0:
+                    # kernels may emit a scalar validity (e.g. a literal arg
+                    # folded into the op's mask): broadcast to the row shape
+                    v = jnp.broadcast_to(v, (bucket,))
+                flat.append(d)
+                flat.append(v if v is not None else jnp.ones(bucket, dtype=bool))
+            return pack_flat(flat, self._pack_tags)
+
+        # trace-check now so ineligible expressions fall back BEFORE the
+        # plugin cache ever sees this object
+        datas_s = tuple(table.columns[n].data for n in table.column_names)
+        valids_s = tuple(table.columns[n].validity for n in table.column_names)
+        jax.eval_shape(mask_fn, datas_s, valids_s, table.row_valid)
+        jax.eval_shape(lambda d, v, m: gather_fn(d, v, m, 8), datas_s,
+                       valids_s,
+                       jax.ShapeDtypeStruct((table.padded_rows,), jnp.bool_))
+        self._mask_fn = jax.jit(mask_fn)
+        self._gather_fn = jax.jit(gather_fn, static_argnames=("bucket",))
+
+    def run(self) -> Table:
+        from ..utils import count_d2h
+        from .compiled import unpack_row
+
+        t = self.table
+        datas = tuple(t.columns[n].data for n in t.column_names)
+        valids = tuple(t.columns[n].validity for n in t.column_names)
+        mask, count_dev = self._mask_fn(datas, valids, t.row_valid)
+        count_d2h()
+        count = int(count_dev)  # one scalar round trip
+        # without an ORDER BY, a LIMIT caps how many survivors we even pull:
+        # sized nonzero returns ascending indices, so the first `want` rows
+        # ARE the eager path's first `want` rows
+        if self.sort_keys is None and self.limit is not None \
+                and self.limit[1] is not None:
+            count = min(count, self.limit[0] + self.limit[1])
+        cols: List[np.ndarray] = []
+        valid_arrs: List[Optional[np.ndarray]] = []
+        if count == 0:
+            for name, sql_type, dictionary in self.out_meta:
+                cols.append(np.zeros(0, dtype=sql_to_np(sql_type)))
+                valid_arrs.append(None)
+        else:
+            bucket = 1 << (count - 1).bit_length()
+            packed = self._gather_fn(datas, valids, mask, bucket=bucket)
+            count_d2h()
+            host = np.asarray(jax.device_get(packed))
+            tags = self._pack_tags
+            for i, (name, sql_type, dictionary) in enumerate(self.out_meta):
+                d = unpack_row(host, 2 * i, tags)[:count]
+                v = unpack_row(host, 1 + 2 * i, tags).astype(bool)[:count]
+                target = sql_to_np(sql_type)
+                if d.dtype != target:
+                    d = d.astype(target)
+                cols.append(d)
+                valid_arrs.append(None if bool(v.all()) else v)
+
+        # host-side ORDER BY: the same host-numpy sort the engine uses for
+        # tiny post-aggregate tables (ops/sorting.sort_permutation — NaN
+        # sorts as +inf, NULL placement per nulls_first)
+        order = None
+        if self.sort_keys:
+            from ..ops.sorting import sort_permutation
+
+            key_cols = []
+            for k in self.sort_keys:
+                idx = k.expr.index
+                _, sql_type, dictionary = self.out_meta[idx]
+                key_cols.append(Column(cols[idx], sql_type, valid_arrs[idx],
+                                       dictionary))
+            order = np.asarray(sort_permutation(
+                key_cols, [k.ascending for k in self.sort_keys],
+                [k.nulls_first_resolved() for k in self.sort_keys]))
+
+        from .rel.base import unique_names
+
+        names = [m[0] for m in self.out_meta]
+        uniq = unique_names(names)
+        out: Dict[str, Column] = {}
+        n_out = count
+        if self.sort_fetch is not None:
+            n_out = min(n_out, self.sort_fetch)
+        lo, hi = 0, n_out
+        if self.limit is not None:
+            skip, fetch = self.limit
+            lo = min(skip, n_out)
+            hi = n_out if fetch is None else min(skip + fetch, n_out)
+        for i, (uname, (name, sql_type, dictionary)) in enumerate(
+                zip(uniq, self.out_meta)):
+            d = cols[i]
+            v = valid_arrs[i]
+            if order is not None:
+                d = d[order]
+                v = v[order] if v is not None else None
+            d = d[lo:hi]
+            v = v[lo:hi] if v is not None else None
+            out[uname] = Column(d, sql_type, v, dictionary)
+        return Table(out, hi - lo)
+
+
+def _dictionary_sorted(dic) -> bool:
+    a = np.asarray(dic, dtype=object)
+    return bool(all(str(a[i]) <= str(a[i + 1]) for i in range(len(a) - 1)))
+
+
+_CACHE_CAP = 32
+_cache: "OrderedDict[Tuple, CompiledSelect]" = OrderedDict()
+
+
+def try_compiled_select(root, executor) -> Optional[Table]:
+    """Attempt the one-kernel/one-transfer path for a ROOT select chain."""
+    mode = executor.config.get("sql.compile.select", True)
+    if not mode or not executor.config.get("sql.compile", True):
+        return None
+    got = _extract(root)
+    if got is None:
+        return None
+    scan, filters, proj, sort_keys, sort_fetch, limit, inner_limit = got
+    try:
+        dc = executor.context.schema[scan.schema_name].tables.get(scan.table_name)
+        if dc is None:
+            return None  # view-backed scans take the eager path
+        from ..datacontainer import LazyParquetContainer
+
+        if isinstance(dc, LazyParquetContainer):
+            return None  # IO-pushdown path already minimizes transfers
+        table = executor.get_table(scan.schema_name, scan.table_name)
+        if scan.projection is not None:
+            table = table.select(scan.projection)
+        if not table.column_names:
+            return None
+        from ..parallel.dist_plan import table_is_sharded
+
+        if table_is_sharded(table):
+            # mesh-sharded scans keep the distributed operators (range-
+            # partition sort leaves results sharded in sort order; pulling
+            # the whole table to one host defeats the layout)
+            return None
+        key = (
+            dc.uid,
+            tuple(scan.projection or ()),
+            tuple(str(f) for f in filters),
+            tuple(str(e) for e in proj.exprs),
+            tuple(str(k.expr) + str(k.ascending) + str(k.nulls_first)
+                  for k in sort_keys) if sort_keys else None,
+            sort_fetch,
+            limit,
+            inner_limit,
+            table.num_rows,
+            table.padded_rows,
+        )
+        compiled = _cache.get(key)
+        if compiled is None:
+            compiled = CompiledSelect(table, scan, filters, proj, sort_keys,
+                                      sort_fetch, limit, inner_limit)
+            _cache[key] = compiled
+            while len(_cache) > _CACHE_CAP:
+                _cache.popitem(last=False)
+        else:
+            _cache.move_to_end(key)
+            compiled.table = table
+        try:
+            return compiled.run()
+        finally:
+            compiled.table = None
+    except _Unsupported as e:
+        logger.debug("compiled select unsupported: %s", e)
+        return None
+    except (ValueError, TypeError, NotImplementedError) as e:
+        # an expression the trace evaluator mis-shapes must never sink the
+        # query — the eager converters are always correct
+        logger.debug("compiled select declined: %s", e)
+        return None

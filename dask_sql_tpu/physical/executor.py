@@ -38,6 +38,17 @@ class Executor:
         cls._plugins[plugin.class_name] = plugin
         return plugin_class
 
+    def execute_root(self, rel: LogicalPlan) -> Table:
+        """Entry for the plan ROOT: the result goes straight to the host, so
+        root select chains compile to one kernel + one packed transfer
+        (physical/compiled_select.py) before the recursive converter runs."""
+        from .compiled_select import try_compiled_select
+
+        out = try_compiled_select(rel, self)
+        if out is not None:
+            return out
+        return self.execute(rel)
+
     def execute(self, rel: LogicalPlan) -> Table:
         key = id(rel)
         if key in self._memo:
