@@ -160,6 +160,24 @@ def main():
     best = min(times)
     throughput = N_ROWS / best
 
+    # root SELECT pipeline (filter+project+topk): two kernels, two round
+    # trips, transfer sized by survivors (physical/compiled_select.py)
+    sel_sql = ("SELECT l_returnflag, l_extendedprice * (1 - l_discount) AS rev "
+               "FROM lineitem WHERE l_discount > 0.09 "
+               "ORDER BY rev DESC LIMIT 100")
+    c.sql(sel_sql).compute()
+    TRANSFER_STATS["d2h"] = 0
+    t0 = time.perf_counter()
+    c.sql(sel_sql).compute()
+    t_sel = time.perf_counter() - t0
+    print(json.dumps({
+        "metric": "select_topk_rows_per_sec",
+        "value": round(N_ROWS / t_sel, 1),
+        "unit": "rows/s",
+        "backend": jax.default_backend(),
+        "d2h_round_trips": TRANSFER_STATS["d2h"],
+    }), flush=True)
+
     try:
         bench_q3_line(jax.default_backend())
     except Exception as e:  # Q3 must never sink the headline metric
