@@ -55,7 +55,24 @@ class WindowPlugin(BaseRelPlugin):
             for i, w in items:
                 args = [executor.eval_expr(a, inp) for a in w.args]
                 results[i] = _compute_window(w, args, layout)
+        # densify all-valid masks back to None in ONE device round trip for
+        # the whole node (per-expr bool(v.all()) syncs were a round trip
+        # each on a tunneled chip; downstream fast paths want None masks)
+        with_masks = [(name, col) for name, col in
+                      zip(names[len(inp.column_names):], results)
+                      if col.validity is not None]
+        if with_masks:
+            import numpy as _np
+
+            flags = _np.asarray(jax.device_get(jnp.stack(
+                [jnp.all(col.validity) for _, col in with_masks])))
+            from ....utils import count_d2h
+
+            count_d2h()
+            dense = {name: bool(f) for (name, _), f in zip(with_masks, flags)}
         for name, col in zip(names[len(inp.column_names):], results):
+            if col.validity is not None and dense.get(name):
+                col = Column(col.data, col.sql_type, None, col.dictionary)
             out_cols[name] = col
         return Table(out_cols, n)
 
@@ -106,9 +123,13 @@ class _SortedLayout:
         if col is None or col.dictionary is not None \
                 or col.data.dtype == jnp.bool_ or col.validity is not None:
             return None
-        if jnp.issubdtype(col.data.dtype, jnp.floating) and bool(jnp.isnan(col.data).any()):
-            return None
         v = col.data[self.perm]
+        if jnp.issubdtype(v.dtype, jnp.floating) and bool(jnp.isnan(v).any()):
+            # NaN breaks the monotone-segment invariant (and SQL orders NaN
+            # above +inf, so folding them together would mis-frame peers).
+            # The device round trip this costs is confined to explicit
+            # RANGE-offset frames over float keys — the only caller.
+            return None
         self._order_sorted = v if self._order_asc else -v
         return self._order_sorted
 
@@ -302,14 +323,18 @@ def _compute_window(w: WindowExpr, args: List[Column], lay: _SortedLayout) -> Co
         j_safe = jnp.clip(j, 0, n - 1)
         vals = xs[j_safe]
         valid = xv[j_safe] & inside
+        dictionary = x.dictionary
         if default is not None:
             dv = default.cast(x.sql_type)
+            if dictionary is not None:
+                # dv's codes index dv's OWN dictionary: translate into x's
+                # space, extending it when the default value is new
+                dictionary, dv = _remap_into_dictionary(dictionary, dv)
             ds = dv.data[lay.perm]
             vals = jnp.where(inside, vals, ds)
             valid = jnp.where(inside, valid, dv.valid_mask()[lay.perm])
         data, v = lay.scatter_back(vals, valid)
-        validity = None if bool(v.all()) else v
-        return Column(data, w.sql_type, validity, x.dictionary)
+        return Column(data, w.sql_type, v, dictionary)
 
     # frame-based functions
     lo, hi = _frame_bounds(w, lay)
@@ -342,8 +367,7 @@ def _compute_window(w: WindowExpr, args: List[Column], lay: _SortedLayout) -> Co
         vals = xs[j_safe]
         valid = xv[j_safe] & inside
         data, v = lay.scatter_back(vals, valid)
-        validity = None if bool(v.all()) else v
-        return Column(data, w.sql_type, validity, x.dictionary)
+        return Column(data, w.sql_type, v, x.dictionary)
 
     if func == "count_star":
         vals = (hi - lo).astype(jnp.int64)
@@ -373,15 +397,16 @@ def _compute_window(w: WindowExpr, args: List[Column], lay: _SortedLayout) -> Co
             vals = s
         valid = cnt > 0
         data, v = lay.scatter_back(vals, valid)
-        validity = None if bool(v.all()) else v
         target = sql_to_np(w.sql_type)
-        return Column(data.astype(target), w.sql_type, validity)
+        return Column(data.astype(target), w.sql_type, v)
     if func in ("min", "max"):
         big = _extreme_val(xs.dtype, func == "min")
         masked = jnp.where(xv, xs, big)
         # segmented running min/max handles prefix frames; bounded frames use
-        # a log-shift sparse table (O(n log w))
-        if bool(jnp.all(lo == lay.seg_start)) and bool(jnp.all(hi == i + 1) | jnp.all(hi == lay.peer_end)):
+        # a log-shift sparse table (O(n log w)).  Prefix-ness is decided
+        # STATICALLY from the frame spec — a device comparison here would be
+        # a host round trip per query on a tunneled chip
+        if _is_prefix_frame(w.spec):
             op = jnp.minimum if func == "min" else jnp.maximum
             run = _segmented_scan(masked, lay.new_seg, op)
             peer_adjusted = run[jnp.clip(hi - 1, 0, n - 1)]
@@ -392,8 +417,7 @@ def _compute_window(w: WindowExpr, args: List[Column], lay: _SortedLayout) -> Co
         cnt = Pc[hi] - Pc[lo]
         valid = cnt > 0
         data, v = lay.scatter_back(vals, valid)
-        validity = None if bool(v.all()) else v
-        return Column(data, w.sql_type, validity, x.dictionary)
+        return Column(data, w.sql_type, v, x.dictionary)
     if func in ("stddev_samp", "stddev_pop", "var_samp", "var_pop"):
         acc = jnp.where(xv, xs.astype(jnp.float64), 0.0)
         P1 = _prefix(acc)
@@ -409,8 +433,42 @@ def _compute_window(w: WindowExpr, args: List[Column], lay: _SortedLayout) -> Co
         vals = jnp.sqrt(var) if func.startswith("stddev") else var
         valid = cnt > ddof
         data, v = lay.scatter_back(vals, valid)
-        return Column(data, SqlType.DOUBLE, None if bool(v.all()) else v)
+        return Column(data, SqlType.DOUBLE, v)
     raise NotImplementedError(f"window function {func}")
+
+
+def _remap_into_dictionary(base_dict, col: Column):
+    """Translate `col`'s dictionary codes into `base_dict`'s code space,
+    appending values base_dict lacks.  Returns (new_dict, remapped_col)."""
+    src = np.asarray(col.dictionary if col.dictionary is not None
+                     else np.array([], dtype=object), dtype=object)
+    base = np.asarray(base_dict, dtype=object)
+    pos = {str(v): i for i, v in enumerate(base)}
+    extended = list(base)
+    mapping = np.zeros(max(len(src), 1), dtype=np.int32)
+    for i, v in enumerate(src):
+        key = str(v)
+        if key not in pos:
+            pos[key] = len(extended)
+            extended.append(v)
+        mapping[i] = pos[key]
+    codes = jnp.asarray(mapping)[jnp.clip(col.data, 0, max(len(src) - 1, 0))]
+    return (np.asarray(extended, dtype=object),
+            Column(codes, col.sql_type, col.validity,
+                   np.asarray(extended, dtype=object)))
+
+
+def _is_prefix_frame(spec) -> bool:
+    """Frame always spans [segment start, current row/peer end): the shapes
+    _frame_bounds emits lo = seg_start and hi = i+1 or peer_end for."""
+    if not spec.explicit_frame:
+        return True  # default frames are prefix frames either way
+    s, e = spec.start, spec.end
+    if s.kind != "UNBOUNDED_PRECEDING":
+        return False
+    if spec.units == "RANGE" or spec.order_by:
+        return e.kind == "CURRENT_ROW" and e.offset is None
+    return e.kind == "CURRENT_ROW"
 
 
 def _extreme_val(dtype, for_min: bool):

@@ -179,3 +179,16 @@ def test_range_interval_frame(c, datetime_table):
     ).compute().sort_values("no_timezone").reset_index(drop=True)
     # rows are 8h apart: each sees itself + the previous one
     assert list(result["n"]) == [1, 2, 2, 2, 2, 2]
+
+
+def test_lag_string_default_value(c):
+    """Review finding: LAG over a string column with a string default used
+    to decode the default's code against the source dictionary."""
+    import pandas as pd
+
+    df = pd.DataFrame({"g": [1, 1, 2], "s": ["zeta", "alpha", "beta"]})
+    c.create_table("lagd", df)
+    result = c.sql(
+        "SELECT g, s, LAG(s, 1, 'N/A') OVER (PARTITION BY g ORDER BY s) AS p "
+        "FROM lagd ORDER BY g, s").compute()
+    assert list(result["p"]) == ["N/A", "alpha", "N/A"]
