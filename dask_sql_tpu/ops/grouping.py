@@ -61,6 +61,9 @@ def radix_gid(cols: Sequence[Column], max_domain: int = 1 << 22):
     """
     radices = []
     offsets = []
+    # phase 1: classify columns, queueing every int key's min/max so ALL
+    # bounds ride ONE device pull (phase 2) — one sync per node, not per key
+    pending = []  # (slot, device min, device max)
     for c in cols:
         if c.sql_type in STRING_TYPES and c.dictionary is not None:
             radices.append(len(c.dictionary) + 1)  # +1 slot for NULL
@@ -69,16 +72,22 @@ def radix_gid(cols: Sequence[Column], max_domain: int = 1 << 22):
             radices.append(3)
             offsets.append(0)
         elif jnp.issubdtype(c.data.dtype, jnp.integer) and len(c):
-            # small-range ints: value-offset codes (one host sync for bounds)
-            lo = int(jnp.min(c.data))
-            hi = int(jnp.max(c.data))
+            pending.append((len(radices), jnp.min(c.data), jnp.max(c.data)))
+            radices.append(None)
+            offsets.append(None)
+        else:
+            return None
+    if pending:
+        from ..utils import host_ints
+
+        flat = host_ints(*[v for _, mn, mx in pending for v in (mn, mx)])
+        for j, (slot, _, _) in enumerate(pending):
+            lo, hi = flat[2 * j], flat[2 * j + 1]
             span = hi - lo + 1
             if span <= 0 or span > max_domain:
                 return None
-            radices.append(span + 1)
-            offsets.append(lo)
-        else:
-            return None
+            radices[slot] = span + 1
+            offsets[slot] = lo
     domain = 1
     for r in radices:
         domain *= r

@@ -23,6 +23,7 @@ import numpy as np
 from ..columnar.column import Column
 from ..columnar.dtypes import STRING_TYPES, promote
 from .grouping import factorize
+from ..utils import host_ints
 
 
 def _merge_string_dicts(lcol: Column, rcol: Column) -> Tuple[jnp.ndarray, jnp.ndarray]:
@@ -104,9 +105,11 @@ def _single_key_fast_path(lc: Column, rc: Column):
         rk = rk.astype(jnp.int64)
     lo = jnp.iinfo(jnp.int64).min
     if lc.validity is not None or rc.validity is not None:
-        # sentinel safety: real keys must not collide with the NULL sentinels
-        if (lk.shape[0] and int(jnp.min(lk)) <= lo + 1) or \
-                (rk.shape[0] and int(jnp.min(rk)) <= lo + 1):
+        # sentinel safety: real keys must not collide with the NULL
+        # sentinels — both mins ride one device pull
+        mins = host_ints(*([jnp.min(lk)] if lk.shape[0] else []),
+                         *([jnp.min(rk)] if rk.shape[0] else []))
+        if any(m <= lo + 1 for m in mins):
             return None
         if lc.validity is not None:
             lk = jnp.where(lc.valid_mask(), lk, lo)  # never matches rhs sentinel
@@ -143,7 +146,7 @@ def _dense_match(lgid, rgid):
     nr = int(rgid.shape[0])
     if nr == 0 or lgid.shape[0] == 0:
         return None
-    rmin, rmax = (int(x) for x in _minmax(rgid))
+    rmin, rmax = host_ints(*_minmax(rgid))
     size = rmax - rmin + 1
     if size <= 0 or size > max(_DENSE_RANGE_SLACK * nr, _DENSE_RANGE_FLOOR):
         return None
@@ -175,12 +178,12 @@ def dense_unique_lut(key: jnp.ndarray, valid=None):
         # exclude NULLs from the range scan so they can't blow the gate
         big = jnp.iinfo(jnp.int64).max
         small = jnp.iinfo(jnp.int64).min
-        rmin = int(jnp.min(jnp.where(valid, k, big)))
-        rmax = int(jnp.max(jnp.where(valid, k, small)))
+        rmin, rmax = host_ints(jnp.min(jnp.where(valid, k, big)),
+                               jnp.max(jnp.where(valid, k, small)))
         if rmin > rmax:
             return None  # all NULL
     else:
-        rmin, rmax = (int(x) for x in _minmax(k))
+        rmin, rmax = host_ints(*_minmax(k))
     size = rmax - rmin + 1
     if size <= 0 or size > max(_DENSE_RANGE_SLACK * nr, _DENSE_RANGE_FLOOR):
         return None

@@ -733,6 +733,7 @@ class CompiledAggregate:
         radices = []
         offsets = []
         gcols: List[Column] = []
+        pending = []  # (slot, device min, device max): ONE pull for all keys
         for e in group_exprs:
             if not (isinstance(e, ColumnRef) and type(e) is ColumnRef):
                 raise _Unsupported("non-column group key")
@@ -744,16 +745,23 @@ class CompiledAggregate:
                 radices.append(3)
                 offsets.append(0)
             elif jnp.issubdtype(c.data.dtype, jnp.integer) and len(c):
-                lo = int(jnp.min(c.data))
-                hi = int(jnp.max(c.data))
-                span = hi - lo + 1
-                if span <= 0 or span > (1 << 22):
-                    raise _Unsupported("integer key range too large")
-                radices.append(span + 1)
-                offsets.append(lo)
+                pending.append((len(radices), jnp.min(c.data), jnp.max(c.data)))
+                radices.append(None)
+                offsets.append(None)
             else:
                 raise _Unsupported("non-dictionary group key")
             gcols.append(c)
+        if pending:
+            from ..utils import host_ints
+
+            flat = host_ints(*[v for _, mn, mx in pending for v in (mn, mx)])
+            for j, (slot, _, _) in enumerate(pending):
+                lo, hi = flat[2 * j], flat[2 * j + 1]
+                span = hi - lo + 1
+                if span <= 0 or span > (1 << 22):
+                    raise _Unsupported("integer key range too large")
+                radices[slot] = span + 1
+                offsets[slot] = lo
         domain = 1
         for r in radices:
             domain *= r

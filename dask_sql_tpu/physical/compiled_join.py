@@ -302,6 +302,7 @@ class CompiledJoinAggregate:
         extra code per key for NULL)."""
         spec = []
         domain = 1
+        pending = []  # (slot, device min, device max): ONE pull for all keys
         for g in group_exprs:
             if isinstance(g, _BuildRef):
                 bt = build_tables[g.k]
@@ -318,16 +319,25 @@ class CompiledJoinAggregate:
                 spec.append({"ref": g, "kind": "bool", "r": 3, "off": 0,
                              "col": col})
             elif jnp.issubdtype(col.data.dtype, jnp.integer) and len(col):
-                lo = int(jnp.min(col.data))
-                hi = int(jnp.max(col.data))
+                pending.append((len(spec), jnp.min(col.data),
+                                jnp.max(col.data)))
+                spec.append({"ref": g, "kind": "int", "r": None,
+                             "off": None, "col": col})
+            else:
+                raise _Unsupported("group key not radix-encodable")
+        if pending:
+            from ..utils import host_ints
+
+            flat = host_ints(*[v for _, mn, mx in pending for v in (mn, mx)])
+            for j, (slot, _, _) in enumerate(pending):
+                lo, hi = flat[2 * j], flat[2 * j + 1]
                 span = hi - lo + 1
                 if span <= 0 or span > (1 << 22):
                     raise _Unsupported("integer key range too large")
-                spec.append({"ref": g, "kind": "int", "r": span + 1,
-                             "off": lo, "col": col})
-            else:
-                raise _Unsupported("group key not radix-encodable")
-            domain *= spec[-1]["r"]
+                spec[slot]["r"] = span + 1
+                spec[slot]["off"] = lo
+        for entry in spec:
+            domain *= entry["r"]
             if domain > (1 << 22):
                 raise _Unsupported("group domain too large")
         return spec

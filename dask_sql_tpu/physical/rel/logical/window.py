@@ -62,13 +62,11 @@ class WindowPlugin(BaseRelPlugin):
                       zip(names[len(inp.column_names):], results)
                       if col.validity is not None]
         if with_masks:
-            import numpy as _np
-
-            flags = _np.asarray(jax.device_get(jnp.stack(
-                [jnp.all(col.validity) for _, col in with_masks])))
             from ....utils import count_d2h
 
             count_d2h()
+            flags = np.asarray(jax.device_get(jnp.stack(
+                [jnp.all(col.validity) for _, col in with_masks])))
             dense = {name: bool(f) for (name, _), f in zip(with_masks, flags)}
         for name, col in zip(names[len(inp.column_names):], results):
             if col.validity is not None and dense.get(name):

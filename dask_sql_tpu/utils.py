@@ -76,3 +76,12 @@ TRANSFER_STATS: Dict[str, int] = {"d2h": 0}
 
 def count_d2h(n: int = 1) -> None:
     TRANSFER_STATS["d2h"] = TRANSFER_STATS.get("d2h", 0) + n
+
+
+def host_ints(*vals):
+    """Pull several device scalars in ONE device_get (each separate int()
+    call blocks on its own round trip on a tunneled chip)."""
+    import jax
+
+    count_d2h()
+    return tuple(int(v) for v in jax.device_get(vals))
