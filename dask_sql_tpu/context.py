@@ -495,12 +495,21 @@ class Context:
             strict = native_mode != "auto"
             if want_opt:
                 # one native call runs parse+bind+the structural rule loop
-                # (the reference's compiled DataFusion pipeline analogue)
+                # AND the stats-driven join reorder (the reference's
+                # compiled DataFusion pipeline analogue)
                 plan = native_plan(
                     sql_text, catalog, cat_buf=cat_buf,
                     predicate_pushdown=bool(
                         self.config.get("sql.predicate_pushdown", True)),
-                    strict=strict)
+                    strict=strict,
+                    fact_dimension_ratio=float(self.config.get(
+                        "sql.optimizer.fact_dimension_ratio", 0.7)),
+                    max_fact_tables=int(self.config.get(
+                        "sql.optimizer.max_fact_tables", 2)),
+                    preserve_user_order=bool(self.config.get(
+                        "sql.optimizer.preserve_user_order", True)),
+                    filter_selectivity=float(self.config.get(
+                        "sql.optimizer.filter_selectivity", 1.0)))
                 core_optimized = plan is not None
             if plan is None:
                 plan = native_bind(sql_text, catalog, cat_buf=cat_buf,
@@ -514,7 +523,8 @@ class Context:
             try:
                 if not core_optimized:
                     plan = optimize_core(plan, self.config, catalog)
-                plan = optimize_post(plan, self.config, catalog, context=self)
+                plan = optimize_post(plan, self.config, catalog, context=self,
+                                     skip_reorder=core_optimized)
             except Exception:
                 # parity: optimizer failure falls back to the unoptimized plan
                 # (context.py:857-864)

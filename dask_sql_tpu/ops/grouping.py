@@ -77,17 +77,12 @@ def radix_gid(cols: Sequence[Column], max_domain: int = 1 << 22):
             offsets.append(None)
         else:
             return None
-    if pending:
-        from ..utils import host_ints
-
-        flat = host_ints(*[v for _, mn, mx in pending for v in (mn, mx)])
-        for j, (slot, _, _) in enumerate(pending):
-            lo, hi = flat[2 * j], flat[2 * j + 1]
-            span = hi - lo + 1
-            if span <= 0 or span > max_domain:
-                return None
-            radices[slot] = span + 1
-            offsets[slot] = lo
+    spans = resolve_int_bounds(pending, max_domain)
+    if spans is None:
+        return None
+    for slot, (span, lo) in spans.items():
+        radices[slot] = span + 1
+        offsets[slot] = lo
     domain = 1
     for r in radices:
         domain *= r
@@ -127,6 +122,26 @@ def radix_gid(cols: Sequence[Column], max_domain: int = 1 << 22):
         return out
 
     return gid.astype(jnp.int32) if domain < 2**31 else gid, domain, decode
+
+
+def resolve_int_bounds(pending, max_domain):
+    """Batch-resolve queued (slot, device_min, device_max) integer-key
+    bounds in ONE device pull.  {slot: (span, lo)}, or None when any span
+    blows the domain gate.  Shared by the three radix planners so the
+    gate/backfill logic cannot drift."""
+    if not pending:
+        return {}
+    from ..utils import host_ints
+
+    flat = host_ints(*[v for _, mn, mx in pending for v in (mn, mx)])
+    out = {}
+    for j, (slot, _, _) in enumerate(pending):
+        lo, hi = flat[2 * j], flat[2 * j + 1]
+        span = hi - lo + 1
+        if span <= 0 or span > max_domain:
+            return None
+        out[slot] = (span, lo)
+    return out
 
 
 def factorize(keys: Sequence[jnp.ndarray]) -> Tuple[jnp.ndarray, jnp.ndarray, int]:

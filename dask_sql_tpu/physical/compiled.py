@@ -751,17 +751,14 @@ class CompiledAggregate:
             else:
                 raise _Unsupported("non-dictionary group key")
             gcols.append(c)
-        if pending:
-            from ..utils import host_ints
+        from ..ops.grouping import resolve_int_bounds
 
-            flat = host_ints(*[v for _, mn, mx in pending for v in (mn, mx)])
-            for j, (slot, _, _) in enumerate(pending):
-                lo, hi = flat[2 * j], flat[2 * j + 1]
-                span = hi - lo + 1
-                if span <= 0 or span > (1 << 22):
-                    raise _Unsupported("integer key range too large")
-                radices[slot] = span + 1
-                offsets[slot] = lo
+        spans = resolve_int_bounds(pending, 1 << 22)
+        if spans is None:
+            raise _Unsupported("integer key range too large")
+        for slot, (span, lo) in spans.items():
+            radices[slot] = span + 1
+            offsets[slot] = lo
         domain = 1
         for r in radices:
             domain *= r

@@ -325,17 +325,14 @@ class CompiledJoinAggregate:
                              "off": None, "col": col})
             else:
                 raise _Unsupported("group key not radix-encodable")
-        if pending:
-            from ..utils import host_ints
+        from ..ops.grouping import resolve_int_bounds
 
-            flat = host_ints(*[v for _, mn, mx in pending for v in (mn, mx)])
-            for j, (slot, _, _) in enumerate(pending):
-                lo, hi = flat[2 * j], flat[2 * j + 1]
-                span = hi - lo + 1
-                if span <= 0 or span > (1 << 22):
-                    raise _Unsupported("integer key range too large")
-                spec[slot]["r"] = span + 1
-                spec[slot]["off"] = lo
+        spans = resolve_int_bounds(pending, 1 << 22)
+        if spans is None:
+            raise _Unsupported("integer key range too large")
+        for slot, (span, lo) in spans.items():
+            spec[slot]["r"] = span + 1
+            spec[slot]["off"] = lo
         for entry in spec:
             domain *= entry["r"]
             if domain > (1 << 22):

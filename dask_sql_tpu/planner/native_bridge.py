@@ -631,7 +631,7 @@ def _get_binder_lib():
                 ctypes.POINTER(ctypes.c_int64),
             ]
             lib.dsql_binder_abi_version.restype = ctypes.c_int32
-            _binder_ok = lib.dsql_binder_abi_version() == 1
+            _binder_ok = lib.dsql_binder_abi_version() == 2
         except AttributeError:
             _binder_ok = False
     return lib if _binder_ok else None
@@ -662,6 +662,8 @@ def encode_catalog(catalog) -> bytes:
         w32(len(schema.tables))
         for tname, table in schema.tables.items():
             wstr(tname)
+            rc = table.statistics.row_count if table.statistics else None
+            out.extend(struct.pack("<d", -1.0 if rc is None else float(rc)))
             w32(len(table.fields))
             for f in table.fields:
                 wstr(f.name)
@@ -1090,18 +1092,23 @@ def _get_planner_lib():
             lib.dsql_plan.argtypes = [
                 ctypes.c_char_p, ctypes.c_int64,
                 ctypes.c_char_p, ctypes.c_int64, ctypes.c_int32,
+                ctypes.c_int32, ctypes.c_double, ctypes.c_int32,
+                ctypes.c_int32, ctypes.c_double,
                 ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
                 ctypes.POINTER(ctypes.c_int64),
             ]
             lib.dsql_optimizer_abi_version.restype = ctypes.c_int32
-            _planner_ok = lib.dsql_optimizer_abi_version() == 1
+            _planner_ok = lib.dsql_optimizer_abi_version() == 2
         except AttributeError:
             _planner_ok = False
     return lib if _planner_ok else None
 
 
 def native_plan(sql: str, catalog, cat_buf: Optional[bytes] = None,
-                predicate_pushdown: bool = True, strict: bool = False):
+                predicate_pushdown: bool = True, strict: bool = False,
+                reorder: bool = True, fact_dimension_ratio: float = 0.7,
+                max_fact_tables: int = 2, preserve_user_order: bool = True,
+                filter_selectivity: float = 1.0):
     """Parse + bind + run the core optimizer rule pipeline natively
     (native/binder.cpp Optimizer — the analogue of the reference's compiled
     DataFusion rule loop, optimizer.rs:53-98).  Returns the optimized
@@ -1122,6 +1129,10 @@ def native_plan(sql: str, catalog, cat_buf: Optional[bytes] = None,
     out_len = ctypes.c_int64()
     rc = lib.dsql_plan(raw, len(raw), cat_buf, len(cat_buf),
                        1 if predicate_pushdown else 0,
+                       1 if reorder else 0,
+                       float(fact_dimension_ratio), int(max_fact_tables),
+                       1 if preserve_user_order else 0,
+                       float(filter_selectivity),
                        ctypes.byref(out), ctypes.byref(out_len))
     if rc == 1:
         return None

@@ -54,13 +54,15 @@ def optimize_core(plan, config, catalog):
     return plan
 
 
-def optimize_post(plan, config, catalog, context=None):
+def optimize_post(plan, config, catalog, context=None, skip_reorder=False):
     """Statistics/data-driven passes after the structural loop: join
-    reordering (needs row counts), dynamic partition pruning (reads data at
-    plan time), and the embedded-subquery pipeline."""
+    reordering (needs row counts; skipped when the native planner already
+    reordered), dynamic partition pruning (reads data at plan time), and
+    the embedded-subquery pipeline."""
     from . import join_reorder, rules
 
-    plan = join_reorder.maybe_reorder(plan, config, catalog)
+    if not skip_reorder:
+        plan = join_reorder.maybe_reorder(plan, config, catalog)
     if config.get("sql.dynamic_partition_pruning", True):
         from . import dpp
 

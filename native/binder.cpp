@@ -506,6 +506,7 @@ struct CField {
 
 struct CTable {
   std::string schema_name, name;
+  double row_count = -1.0;  // -1 = unknown statistics
   std::vector<CField> fields;
 };
 
@@ -554,6 +555,9 @@ struct Catalog {
         CTable t;
         t.schema_name = sname;
         t.name = rstr();
+        if (p + 8 > end) throw Unsupported{};
+        std::memcpy(&t.row_count, p, 8);
+        p += 8;
         int32_t n_cols = r32();
         for (int k = 0; k < n_cols; ++k) {
           CField f;
@@ -5158,6 +5162,340 @@ class Optimizer {
     return go(plan);
   }
 
+  // ---------------- JoinReorder (join_reorder.rs parity) ----------------
+  // fact/dimension heuristic over a flattened filter-free INNER-join chain;
+  // twin of planner/optimizer/join_reorder.py (the differential reference)
+  mutable const Catalog* cat_ptr = nullptr;
+  mutable double jr_ratio = 0.7;
+  mutable int jr_max_facts = 2;
+  mutable bool jr_preserve = true;
+  mutable double jr_selectivity = 1.0;
+
+  double table_rows(int32_t node) const {
+    while (true) {
+      int k = b.nodes[node].kind;
+      if (k == P_FILTER || k == P_SUBQUERY_ALIAS || k == P_PROJECTION ||
+          k == P_AGGREGATE || k == P_WINDOW || k == P_LIMIT || k == P_DISTINCT)
+        node = b.kids(node)[0];
+      else
+        break;
+    }
+    const PNode n = b.nodes[node];
+    if (n.kind != P_TABLESCAN || cat_ptr == nullptr) return -1.0;
+    auto sit = cat_ptr->schemas.find(str_of(n.s0));
+    if (sit == cat_ptr->schemas.end()) return -1.0;
+    auto tit = sit->second.find(str_of(n.s1));
+    if (tit == sit->second.end()) return -1.0;
+    return tit->second.row_count;
+  }
+
+  bool is_not_null_pred(int32_t e) const {
+    const PNode n = b.nodes[e];
+    if (n.kind != E_SCALARFN) return false;
+    std::string op = str_of(n.s0);
+    return op == "is_not_null" || op == "isnotnull";
+  }
+
+  bool has_real_filter(int32_t node) const {
+    const PNode n = b.nodes[node];
+    if (n.kind == P_FILTER) {
+      std::vector<int32_t> cjs;
+      conjuncts_of(b.kids(node).back(), cjs);
+      for (int32_t c : cjs)
+        if (!is_not_null_pred(c)) return true;
+      return has_real_filter(b.kids(node)[0]);
+    }
+    if (n.kind == P_TABLESCAN) {
+      if (!(n.flags & 2)) return false;
+      auto ks = b.kids(node);
+      for (int32_t k : ks) {
+        int kk = b.nodes[k].kind;
+        if (kk != P_FIELD && kk != P_PART && !is_not_null_pred(k))
+          return true;
+      }
+      return false;
+    }
+    for (int32_t k : inputs_of(node))
+      if (has_real_filter(k)) return true;
+    return false;
+  }
+
+  // (column index, outermost cast wrapper or -1); {-1,-1} for computed keys
+  std::pair<int64_t, int32_t> single_col(int32_t e) const {
+    int32_t wrap = -1;
+    int32_t x = e;
+    while (b.nodes[x].kind == E_CAST) {
+      wrap = e;
+      x = b.kids(x)[0];
+    }
+    if (b.nodes[x].kind == E_COLREF) return {b.nodes[x].ival, wrap};
+    return {-1, -1};
+  }
+
+  int32_t rewrap(int32_t wrap, int32_t ref) const {
+    if (wrap < 0) return ref;
+    const PNode n = b.nodes[wrap];
+    if (n.kind == E_CAST) {
+      int32_t inner = rewrap(b.kids(wrap)[0], ref);
+      return b.add(E_CAST, {inner}, n.flags, n.ival, n.dval, n.s0, n.s1);
+    }
+    return ref;
+  }
+
+  struct JrLeaf {
+    int32_t plan;
+    int start;
+    int width;
+    double size;
+    bool filtered;
+  };
+  struct JrCond {
+    int la, oa;
+    int32_t wa;
+    int lb, ob;
+    int32_t wb;
+  };
+
+  bool jr_flatten(int32_t node, int base, std::vector<JrLeaf>& leaves,
+                  std::vector<std::array<int64_t, 4>>& conds) const {
+    const PNode n = b.nodes[node];
+    if (n.kind == P_JOIN) {
+      JoinParts jp = join_parts(node);
+      if (jp.jt == "INNER" && jp.residual < 0 && !jp.null_aware) {
+        int nleft = schema_width(jp.left);
+        if (!jr_flatten(jp.left, base, leaves, conds)) return false;
+        if (!jr_flatten(jp.right, base + nleft, leaves, conds)) return false;
+        for (int32_t pr : jp.on) {
+          auto pk = b.kids(pr);
+          auto lc = single_col(pk[0]);
+          auto rc = single_col(pk[1]);
+          if (lc.first < 0 || rc.first < 0) return false;
+          conds.push_back({base + lc.first, base + rc.first,
+                           (int64_t)lc.second, (int64_t)rc.second});
+        }
+        return true;
+      }
+    }
+    if (n.kind == P_CROSSJOIN) {
+      auto ks = b.kids(node);
+      int nleft = schema_width(ks[0]);
+      return jr_flatten(ks[0], base, leaves, conds) &&
+             jr_flatten(ks[1], base + nleft, leaves, conds);
+    }
+    double size = table_rows(node);
+    leaves.push_back({node, base, schema_width(node),
+                      size < 0 ? 100.0 : size, has_real_filter(node)});
+    return true;
+  }
+
+  struct JrTree {
+    int32_t plan;
+    std::vector<int> leaf_order;
+  };
+
+  struct JrBuilder {
+    const Optimizer& opt;
+    const std::vector<JrLeaf>& leaves;
+    // [((leaf, off, wrap), (leaf, off, wrap))]
+    std::vector<std::array<int64_t, 6>> remaining;
+    JrTree cur;
+
+    int offset_of(const JrTree& t, int leaf_idx) const {
+      int off = 0;
+      for (int li : t.leaf_order) {
+        if (li == leaf_idx) return off;
+        off += leaves[li].width;
+      }
+      return -1;
+    }
+
+    std::vector<std::array<int64_t, 6>> conds_between(
+        const std::set<int>& in_tree, const std::set<int>& leaf_set) {
+      std::vector<std::array<int64_t, 6>> found, rest;
+      for (auto& c : remaining) {
+        int la = (int)c[0], lb = (int)c[3];
+        if (in_tree.count(la) && leaf_set.count(lb)) {
+          found.push_back(c);
+        } else if (in_tree.count(lb) && leaf_set.count(la)) {
+          found.push_back({c[3], c[4], c[5], c[0], c[1], c[2]});
+        } else {
+          rest.push_back(c);
+        }
+      }
+      remaining = rest;
+      return found;
+    }
+
+    JrTree make_join(const JrTree& t, const JrTree& other,
+                     const std::vector<std::array<int64_t, 6>>& pairs) {
+      PBuilder& b = opt.b;
+      int lwidth = 0;
+      for (int li : t.leaf_order) lwidth += leaves[li].width;
+      std::vector<int32_t> on;
+      for (auto& pr : pairs) {
+        int ll = (int)pr[0], lo = (int)pr[1];
+        int32_t lw = (int32_t)pr[2];
+        int rl = (int)pr[3], ro = (int)pr[4];
+        int32_t rw = (int32_t)pr[5];
+        auto lfields = opt.schema_of(leaves[ll].plan);
+        auto rfields = opt.schema_of(leaves[rl].plan);
+        const PNode lf = b.nodes[lfields[lo]];
+        const PNode rf = b.nodes[rfields[ro]];
+        int lpos = offset_of(t, ll) + lo;
+        int rpos = lwidth + offset_of(other, rl) + ro;
+        int32_t le = opt.rewrap(
+            lw, b.add(E_COLREF, {}, lf.flags, lpos, 0.0, lf.s0));
+        int32_t re = opt.rewrap(
+            rw, b.add(E_COLREF, {}, rf.flags, rpos, 0.0, rf.s0));
+        on.push_back(b.add(P_ON_PAIR, {le, re}));
+      }
+      std::vector<int32_t> fields = opt.schema_of(t.plan);
+      auto of = opt.schema_of(other.plan);
+      fields.insert(fields.end(), of.begin(), of.end());
+      JoinParts jp{t.plan, other.plan, fields, on, -1, "INNER", false};
+      JrTree out;
+      out.plan = opt.mk_join(jp);
+      out.leaf_order = t.leaf_order;
+      out.leaf_order.insert(out.leaf_order.end(), other.leaf_order.begin(),
+                            other.leaf_order.end());
+      return out;
+    }
+
+    void start(int leaf_idx) { cur = {leaves[leaf_idx].plan, {leaf_idx}}; }
+
+    bool try_join(int leaf_idx) {
+      std::set<int> in_tree(cur.leaf_order.begin(), cur.leaf_order.end());
+      auto pairs = conds_between(in_tree, {leaf_idx});
+      if (pairs.empty()) return false;
+      cur = make_join(cur, {leaves[leaf_idx].plan, {leaf_idx}}, pairs);
+      return true;
+    }
+  };
+
+  int32_t reorder_chain(int32_t join_id) const {
+    std::vector<JrLeaf> leaves;
+    std::vector<std::array<int64_t, 4>> conds4;
+    if (!jr_flatten(join_id, 0, leaves, conds4)) return -1;
+    if (leaves.size() < 3) return -1;
+    double largest = 0;
+    for (auto& l : leaves) largest = std::max(largest, l.size);
+    std::vector<int> facts, dims;
+    for (size_t i = 0; i < leaves.size(); ++i) {
+      if (leaves[i].size / std::max(largest, 1e-9) > jr_ratio)
+        facts.push_back((int)i);
+      else
+        dims.push_back((int)i);
+    }
+    if (facts.empty() || dims.empty() || (int)facts.size() > jr_max_facts)
+      return -1;
+    std::vector<int> unfiltered, filtered;
+    for (int i : dims)
+      (leaves[i].filtered ? filtered : unfiltered).push_back(i);
+    auto stable_by_size = [&](std::vector<int>& v, double scale) {
+      std::stable_sort(v.begin(), v.end(), [&](int a2, int b2) {
+        return leaves[a2].size * scale < leaves[b2].size * scale;
+      });
+    };
+    if (!jr_preserve) stable_by_size(unfiltered, 1.0);
+    stable_by_size(filtered, jr_selectivity);
+    std::vector<int> ordered;
+    size_t fi = 0, ui = 0;
+    while (fi < filtered.size() || ui < unfiltered.size()) {
+      if (fi < filtered.size() &&
+          (ui >= unfiltered.size() ||
+           leaves[filtered[fi]].size * jr_selectivity <
+               leaves[unfiltered[ui]].size)) {
+        ordered.push_back(filtered[fi++]);
+      } else {
+        ordered.push_back(unfiltered[ui++]);
+      }
+    }
+    // global position -> (leaf, offset)
+    std::map<int, std::pair<int, int>> pos_to_leaf;
+    for (size_t li = 0; li < leaves.size(); ++li)
+      for (int off = 0; off < leaves[li].width; ++off)
+        pos_to_leaf[leaves[li].start + off] = {(int)li, off};
+    JrBuilder builder{*this, leaves, {}, {}};
+    for (auto& c : conds4) {
+      auto a = pos_to_leaf.at((int)c[0]);
+      auto d = pos_to_leaf.at((int)c[1]);
+      builder.remaining.push_back({(int64_t)a.first, (int64_t)a.second, c[2],
+                                   (int64_t)d.first, (int64_t)d.second, c[3]});
+    }
+    std::vector<int> unused = ordered;
+    std::vector<JrTree> trees;
+    for (int f : facts) {
+      builder.start(f);
+      for (int pass = 0; pass < 2 && !unused.empty(); ++pass) {
+        std::vector<int> still;
+        for (int d : unused)
+          if (!builder.try_join(d)) still.push_back(d);
+        unused = still;
+      }
+      trees.push_back(builder.cur);
+    }
+    if (!unused.empty()) return -1;
+    JrTree tree = trees[0];
+    for (size_t i = 1; i < trees.size(); ++i) {
+      std::set<int> a(tree.leaf_order.begin(), tree.leaf_order.end());
+      std::set<int> d(trees[i].leaf_order.begin(), trees[i].leaf_order.end());
+      auto pairs = builder.conds_between(a, d);
+      if (pairs.empty()) return -1;
+      tree = builder.make_join(tree, trees[i], pairs);
+    }
+    if (!builder.remaining.empty()) return -1;
+    // restore the original column order
+    std::map<std::pair<int, int>, int> new_pos;
+    int off = 0;
+    for (int li : tree.leaf_order) {
+      for (int o = 0; o < leaves[li].width; ++o) new_pos[{li, o}] = off + o;
+      off += leaves[li].width;
+    }
+    auto out_fields = schema_of(join_id);
+    std::vector<int32_t> exprs;
+    for (size_t i = 0; i < out_fields.size(); ++i) {
+      const PNode f = b.nodes[out_fields[i]];
+      exprs.push_back(b.add(E_COLREF, {}, f.flags,
+                            new_pos.at(pos_to_leaf.at((int)i)), 0.0, f.s0));
+    }
+    std::vector<int32_t> nk{tree.plan};
+    nk.insert(nk.end(), out_fields.begin(), out_fields.end());
+    nk.insert(nk.end(), exprs.begin(), exprs.end());
+    return b.add(P_PROJECTION, nk, 0, (int64_t)out_fields.size());
+  }
+
+  bool is_inner_chain_node(int32_t id) const {
+    const PNode n = b.nodes[id];
+    if (n.kind != P_JOIN) return false;
+    JoinParts jp = join_parts(id);
+    return jp.jt == "INNER" && jp.residual < 0 && !jp.null_aware;
+  }
+
+  int32_t rule_join_reorder(int32_t plan) const {
+    std::function<int32_t(int32_t, bool)> go =
+        [&](int32_t node, bool parent_is_chain) -> int32_t {
+      bool in_chain = is_inner_chain_node(node);
+      bool is_chain_head = in_chain && !parent_is_chain;
+      auto ins = inputs_of(node);
+      if (!ins.empty()) {
+        std::vector<int32_t> ni;
+        bool changed = false;
+        for (int32_t k : ins) {
+          int32_t t = go(k, in_chain);
+          changed |= t != k;
+          ni.push_back(t);
+        }
+        if (changed) node = with_inputs(node, ni);
+      }
+      if (is_chain_head) {
+        int32_t nw = reorder_chain(node);
+        if (nw >= 0) return nw;
+      }
+      return node;
+    };
+    return go(plan, false);
+  }
+
   // ---------------- driver ----------------
   int32_t optimize(int32_t plan) const {
     for (int pass = 0; pass < 2; ++pass) {
@@ -5233,7 +5571,7 @@ int32_t dsql_bind(const char* sql, int64_t n, const uint8_t* catalog_buf,
   }
 }
 
-int32_t dsql_binder_abi_version() { return 1; }
+int32_t dsql_binder_abi_version() { return 2; }
 
 // Parse + bind + run the structural optimizer rule loop, all native.
 // Same rc codes as dsql_bind; `predicate_pushdown` mirrors the
@@ -5241,7 +5579,10 @@ int32_t dsql_binder_abi_version() { return 1; }
 // subqueries remain Python post-passes on the decoded plan.
 int32_t dsql_plan(const char* sql, int64_t n, const uint8_t* catalog_buf,
                   int64_t catalog_len, int32_t predicate_pushdown,
-                  uint8_t** out, int64_t* out_len) {
+                  int32_t reorder, double fact_dimension_ratio,
+                  int32_t max_fact_tables, int32_t preserve_user_order,
+                  double filter_selectivity, uint8_t** out,
+                  int64_t* out_len) {
   *out = nullptr;
   *out_len = 0;
   uint8_t* ast_buf = nullptr;
@@ -5267,6 +5608,14 @@ int32_t dsql_plan(const char* sql, int64_t n, const uint8_t* catalog_buf,
     int32_t root = binder.bind_statement(stmts[0]);
     Optimizer opt(pb, predicate_pushdown != 0);
     root = opt.optimize(root);
+    if (reorder) {
+      opt.cat_ptr = &cat;
+      opt.jr_ratio = fact_dimension_ratio;
+      opt.jr_max_facts = max_fact_tables;
+      opt.jr_preserve = preserve_user_order != 0;
+      opt.jr_selectivity = filter_selectivity;
+      root = opt.rule_join_reorder(root);
+    }
     uint8_t* buf = pb.serialize(root, out_len);
     if (!buf) return 1;
     *out = buf;
@@ -5286,6 +5635,6 @@ int32_t dsql_plan(const char* sql, int64_t n, const uint8_t* catalog_buf,
   }
 }
 
-int32_t dsql_optimizer_abi_version() { return 1; }
+int32_t dsql_optimizer_abi_version() { return 2; }
 
 }  // extern "C"

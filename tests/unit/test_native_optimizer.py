@@ -17,6 +17,7 @@ from dask_sql_tpu.config import config
 from dask_sql_tpu.planner.binder import Binder
 from dask_sql_tpu.planner.native_bridge import native_parse, native_plan
 from dask_sql_tpu.planner.optimizer.driver import optimize_core
+from dask_sql_tpu.planner.optimizer.join_reorder import maybe_reorder
 from dask_sql_tpu.planner.parser import parse_sql
 
 from tests.tpch import QUERIES as TPCH_QUERIES, generate as tpch_generate
@@ -46,6 +47,13 @@ def tpcds_ctx():
     return c
 
 
+def _python_pipeline(catalog, sql):
+    """The native pipeline's Python twin: core rule loop + join reorder."""
+    ref = Binder(catalog).bind_statement(parse_sql(sql)[0])
+    ref = optimize_core(ref, config, catalog)
+    return maybe_reorder(ref, config, catalog)
+
+
 def _differential(c, sql, require_native=False):
     catalog = c._prepare_catalog()
     nat = native_plan(sql, catalog)
@@ -53,8 +61,7 @@ def _differential(c, sql, require_native=False):
         if require_native:
             pytest.fail("fell back to the Python optimizer")
         pytest.skip("native planner declined")
-    ref = Binder(catalog).bind_statement(parse_sql(sql)[0])
-    ref = optimize_core(ref, config, catalog)
+    ref = _python_pipeline(catalog, sql)
     ok, why = plans_equal(nat, ref)
     assert ok, why
 
@@ -79,9 +86,7 @@ def test_tpcds_corpus_differential(tpcds_ctx):
             misses.append(qnum)
             continue
         try:
-            ref = optimize_core(
-                Binder(catalog).bind_statement(parse_sql(sql)[0]),
-                config, catalog)
+            ref = _python_pipeline(catalog, sql)
         except Exception as e:  # noqa: BLE001
             ref = f"error:{type(e).__name__}"
         if isinstance(nat, str) or isinstance(ref, str):
@@ -157,8 +162,7 @@ def test_predicate_pushdown_knob_respected():
     catalog = c._prepare_catalog()
     sql = "SELECT a FROM t WHERE k = 1"
     with config.set({"sql.predicate_pushdown": False}):
-        ref = optimize_core(
-            Binder(catalog).bind_statement(parse_sql(sql)[0]), config, catalog)
+        ref = _python_pipeline(catalog, sql)
         nat = native_plan(sql, catalog, predicate_pushdown=False)
     assert nat is not None
     ok, why = plans_equal(nat, ref)
@@ -176,3 +180,37 @@ def test_end_to_end_native_planner_values(tpch_ctx):
                            config_options={"sql.native.binder": "off"})
         pd.testing.assert_frame_equal(on.reset_index(drop=True),
                                       off.reset_index(drop=True))
+
+
+@needs_native
+def test_join_reorder_differential():
+    """Stats-driven reorder: the native tree must equal the Python
+    join_reorder on a stats-bearing star-join chain (fact + dims)."""
+    import numpy as np
+
+    from dask_sql_tpu import Context
+    from dask_sql_tpu.datacontainer import Statistics
+
+    c = Context()
+    rng = np.random.RandomState(0)
+    fact = pd.DataFrame({"fk1": rng.randint(0, 50, 10000),
+                         "fk2": rng.randint(0, 20, 10000),
+                         "x": rng.rand(10000)})
+    d1 = pd.DataFrame({"k1": np.arange(50), "w1": rng.rand(50)})
+    d2 = pd.DataFrame({"k2": np.arange(20), "w2": rng.rand(20)})
+    c.create_table("fact", fact, statistics=Statistics(10000))
+    c.create_table("d1", d1, statistics=Statistics(50))
+    c.create_table("d2", d2, statistics=Statistics(20))
+    for sql in [
+        "SELECT x, w1, w2 FROM fact, d1, d2 WHERE fk1 = k1 AND fk2 = k2",
+        "SELECT x, w1, w2 FROM fact JOIN d1 ON fk1 = k1 JOIN d2 ON fk2 = k2 "
+        "WHERE w1 > 0.1",
+    ]:
+        _differential(c, sql, require_native=True)
+        on = c.sql(sql, return_futures=False,
+                   config_options={"sql.native.binder": "on"})
+        off = c.sql(sql, return_futures=False,
+                    config_options={"sql.native.binder": "off"})
+        pd.testing.assert_frame_equal(
+            on.sort_values(list(on.columns)).reset_index(drop=True),
+            off.sort_values(list(off.columns)).reset_index(drop=True))
