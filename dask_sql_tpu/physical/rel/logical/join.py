@@ -87,21 +87,25 @@ class JoinPlugin(BaseRelPlugin):
                 executor, left, right, lkeys, rkeys, lgid, rgid)
         if dist_pairs is not None:
             li, ri, lmatched = dist_pairs
+            if jt == "LEFTMARK":
+                # matched flag from the collectives probe — no local resort
+                if rel.filter is None:
+                    mask = jnp.asarray(lmatched)
+                else:
+                    mask = self._filtered_match_mask(rel, executor, left,
+                                                     right, li, ri)
+                return self.fix_column_to_row_type(
+                    self._append_mark(rel, left, mask), rel.schema)
             if jt in ("LEFTSEMI", "LEFTANTI"):
                 if rel.filter is None:
                     mask = jnp.asarray(lmatched)
-                    if jt == "LEFTANTI":
-                        mask = ~mask
-                    return self.fix_column_to_row_type(left.filter(mask), rel.schema)
-                combined = _materialize(left, right, li, ri)
-                cond = executor.eval_expr(rel.filter, combined)
-                keep = cond.data & cond.valid_mask()
-                matched = jnp.zeros(left.num_rows, dtype=bool)
-                if int(li.shape[0]):
-                    matched = matched.at[li].max(keep)
+                else:
+                    mask = self._filtered_match_mask(rel, executor, left,
+                                                     right, li, ri)
                 if jt == "LEFTANTI":
-                    matched = ~matched
-                return self.fix_column_to_row_type(left.filter(matched), rel.schema)
+                    mask = ~mask
+                return self.fix_column_to_row_type(left.filter(mask),
+                                                   rel.schema)
             if jt == "INNER":
                 combined = _materialize(left, right, li, ri)
                 if rel.filter is not None:
@@ -112,17 +116,25 @@ class JoinPlugin(BaseRelPlugin):
                 return self._outer_from_pairs(rel, executor, left, right, li, ri, jt)
             raise NotImplementedError(f"join type {jt}")
 
+        if jt == "LEFTMARK":
+            # semi-join as a boolean column: left rows pass through with an
+            # appended matched flag (decorrelation of EXISTS under OR)
+            if rel.filter is None:
+                mask = join_ops.semi_join_mask(lgid, rgid)
+            else:
+                li, ri = join_ops.inner_join_indices(lgid, rgid, use_jit)
+                mask = self._filtered_match_mask(rel, executor, left, right,
+                                                 li, ri)
+            return self.fix_column_to_row_type(
+                self._append_mark(rel, left, mask), rel.schema)
+
         if jt in ("LEFTSEMI", "LEFTANTI"):
             if rel.filter is None:
                 mask = join_ops.semi_join_mask(lgid, rgid, anti=(jt == "LEFTANTI"))
                 return self.fix_column_to_row_type(left.filter(mask), rel.schema)
             li, ri = join_ops.inner_join_indices(lgid, rgid, use_jit)
-            combined = _materialize(left, right, li, ri)
-            cond = executor.eval_expr(rel.filter, combined)
-            keep = cond.data & cond.valid_mask()
-            matched = jnp.zeros(left.num_rows, dtype=bool)
-            if int(li.shape[0]):
-                matched = matched.at[li].max(keep)
+            matched = self._filtered_match_mask(rel, executor, left, right,
+                                                li, ri)
             if jt == "LEFTANTI":
                 matched = ~matched
             return self.fix_column_to_row_type(left.filter(matched), rel.schema)
@@ -145,6 +157,28 @@ class JoinPlugin(BaseRelPlugin):
             return self._outer_from_pairs(rel, executor, left, right, li, ri, jt)
 
         raise NotImplementedError(f"join type {jt}")
+
+    def _filtered_match_mask(self, rel, executor, left, right, li, ri):
+        """Per-left-row matched flag under the residual filter (shared by
+        the semi/anti/mark variants on both probe paths)."""
+        combined = _materialize(left, right, li, ri)
+        cond = executor.eval_expr(rel.filter, combined)
+        keep = cond.data & cond.valid_mask()
+        matched = jnp.zeros(left.num_rows, dtype=bool)
+        if int(li.shape[0]):
+            matched = matched.at[li].max(keep)
+        return matched
+
+    @staticmethod
+    def _append_mark(rel, left: Table, mask) -> Table:
+        names = unique_names([f.name for f in rel.schema])
+        cols = {n: left.columns[src]
+                for n, src in zip(names[:-1], left.column_names)}
+        from ....columnar.column import Column
+        from ....columnar.dtypes import SqlType as _St
+
+        cols[names[-1]] = Column(jnp.asarray(mask), _St.BOOLEAN)
+        return Table(cols, left.num_rows)
 
     def _null_aware_anti(self, left: Table, lkeys, rkeys, lgid, rgid,
                          n_right: int) -> Table:

@@ -326,7 +326,8 @@ class PushDownFilter(Rule):
                         continue
                     cols = referenced_columns(c)
                     if cols and max(cols) < nleft and jt in ("INNER", "LEFT", "CROSS",
-                                                            "LEFTSEMI", "LEFTANTI"):
+                                                            "LEFTSEMI", "LEFTANTI",
+                                                            "LEFTMARK"):
                         left_parts.append(c)
                     elif cols and min(cols) >= nleft and jt in ("INNER", "RIGHT", "CROSS"):
                         right_parts.append(shift_columns(c, -nleft))
@@ -475,6 +476,13 @@ def _prune(plan, required: Set[int]) -> Tuple[p.LogicalPlan, Dict[int, int]]:
         f = p.Filter(new_child, pred, list(new_child.schema))
         return f, mapping
 
+    if isinstance(plan, p.Join) and plan.join_type == "LEFTMARK":
+        kids = plan.inputs()
+        new_kids = [_prune(k, set(range(len(k.schema))))[0] for k in kids]
+        if any(a is not b for a, b in zip(kids, new_kids)):
+            plan = plan.with_inputs(new_kids)
+        return plan, {i: i for i in range(len(plan.schema))}
+
     if isinstance(plan, p.Join):
         nleft = len(plan.left.schema)
         need = set(required)
@@ -617,7 +625,13 @@ class DecorrelateSubqueries(Rule):
             node = _map_node_exprs(node, go_expr)
             if not isinstance(node, p.Filter):
                 return node
-            parts = _conjuncts(node.predicate)
+            # factor common conjuncts out of disjunctions FIRST: q41's
+            # correlation hides as (corr AND a) OR (corr AND b), which
+            # factors to corr AND (a OR b) — only then is the equality
+            # extractable.  (RewriteDisjunctivePredicate can't reach
+            # filters inside expr-embedded subquery plans; this walk can.)
+            factored = _rewrite_disjunction(node.predicate)
+            parts = _conjuncts(factored)
             child = node.input
             orig_width = len(child.schema)
             orig_schema = list(child.schema)
@@ -635,9 +649,20 @@ class DecorrelateSubqueries(Rule):
                     kept.append(new_c)
                     changed = True
                     continue
+                res = self._rewrite_marks(c, child)
+                if res is not None:
+                    child, new_c = res
+                    kept.append(new_c)
+                    changed = True
+                    continue
                 kept.append(c)
             if not changed:
-                return node
+                if factored == node.predicate:
+                    return node
+                # keep the factored form: the OUTER query's scalar-subquery
+                # extraction walks this filter and needs the correlation as
+                # its own conjunct
+                return p.Filter(child, factored, node.schema)
             out = p.Filter(child, _conjoin(kept), child.schema) if kept else child
             if len(out.schema) != orig_width:
                 # scalar rewrites widened the row; project back
@@ -736,6 +761,39 @@ class DecorrelateSubqueries(Rule):
         new_conjunct = transform(conjunct, fn)
         return join, new_conjunct
 
+    def _rewrite_marks(self, conjunct: Expr, child):
+        """Correlated EXISTS that conjunct-wise rewriting can't reach (under
+        OR / mixed boolean logic — TPC-DS q10/q35, which the reference
+        xfails): each one becomes a MARK JOIN — a semi-join that APPENDS a
+        boolean matched column instead of filtering — and the subquery
+        expression is replaced by a reference to that column, so the
+        disjunction evaluates as ordinary boolean arithmetic.  Returns
+        (new_child, rewritten_conjunct) or None."""
+        marks = [x for x in walk(conjunct) if isinstance(x, ExistsExpr)
+                 and any(isinstance(y, _OuterRef)
+                         for e in _all_exprs_below(x.plan) for y in walk(e))]
+        if not marks:
+            return None
+        # plans are immutable, so a mid-loop decline just discards the
+        # locally-built chain — no up-front validation pass needed
+        replacements: Dict[int, Expr] = {}
+        for sub in marks:
+            mark_join = self._rewrite_exists(sub, child, anti=False,
+                                             mark=True)
+            if mark_join is None:
+                return None
+            nleft = len(child.schema)
+            child = mark_join
+            ref: Expr = ColumnRef(nleft, "__mark", SqlType.BOOLEAN, False)
+            if sub.negated:
+                ref = ScalarFunc("not", (ref,), SqlType.BOOLEAN)
+            replacements[id(sub)] = ref
+
+        def fn(x):
+            return replacements.get(id(x), x)
+
+        return child, transform(conjunct, fn)
+
     def _try_rewrite(self, pred: Expr, child) -> Optional[p.LogicalPlan]:
         # EXISTS / NOT EXISTS
         if isinstance(pred, ExistsExpr):
@@ -793,7 +851,8 @@ class DecorrelateSubqueries(Rule):
             core = p.Filter(core, _conjoin(kept), core.schema)
         return core, proj_exprs, pairs, corr_residuals
 
-    def _rewrite_exists(self, pred: ExistsExpr, child, anti: bool) -> Optional[p.LogicalPlan]:
+    def _rewrite_exists(self, pred: ExistsExpr, child, anti: bool,
+                        mark: bool = False) -> Optional[p.LogicalPlan]:
         core, _, pairs, corr_residuals = self._extract_correlation(pred.plan)
         if core is None or not (pairs or corr_residuals):
             return None  # uncorrelated EXISTS is evaluated directly (cheap)
@@ -828,6 +887,10 @@ class DecorrelateSubqueries(Rule):
             return transform(r, fn)
 
         jfilter = _conjoin([fix_residual(r) for r in corr_residuals]) if corr_residuals else None
+        if mark:
+            fields = list(child.schema) + [Field("__mark", SqlType.BOOLEAN,
+                                                 False)]
+            return p.Join(child, sub, "LEFTMARK", on, jfilter, fields)
         jt = "LEFTANTI" if anti else "LEFTSEMI"
         return p.Join(child, sub, jt, on, jfilter, list(child.schema))
 

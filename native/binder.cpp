@@ -4315,7 +4315,8 @@ class Optimizer {
           referenced_cols(c, cols);
           bool to_left = !cols.empty() && *cols.rbegin() < nleft &&
                          (jt == "INNER" || jt == "LEFT" || jt == "CROSS" ||
-                          jt == "LEFTSEMI" || jt == "LEFTANTI");
+                          jt == "LEFTSEMI" || jt == "LEFTANTI" ||
+                          jt == "LEFTMARK");
           bool to_right = !cols.empty() && *cols.begin() >= nleft &&
                           (jt == "INNER" || jt == "RIGHT" || jt == "CROSS");
           if (to_left) left_parts.push_back(c);
@@ -4521,6 +4522,23 @@ class Optimizer {
       for (int64_t old : child_req) mapping[old] = c.mapping.at(old);
       auto nfields = schema_of(c.plan);
       return {mk_filter_with_fields(c.plan, npred, nfields), mapping};
+    }
+
+    if (n.kind == P_JOIN && str_of(n.s0) == "LEFTMARK") {
+      auto ins = inputs_of(id);
+      std::vector<int32_t> ni;
+      bool changed = false;
+      for (int32_t k : ins) {
+        std::set<int64_t> full;
+        for (int i2 = 0; i2 < schema_width(k); ++i2) full.insert(i2);
+        Pruned c = prune(k, full);
+        changed |= c.plan != k;
+        ni.push_back(c.plan);
+      }
+      if (changed) id = with_inputs(id, ni);
+      std::map<int64_t, int64_t> ident2;
+      for (int i2 = 0; i2 < schema_width(id); ++i2) ident2[i2] = i2;
+      return {id, ident2};
     }
 
     if (n.kind == P_JOIN) {
@@ -4852,7 +4870,8 @@ class Optimizer {
 
   int expr_ty(int32_t e) const { return ty_of_flags(b.nodes[e].flags); }
 
-  int32_t rewrite_exists(int32_t plan_e, int32_t child, bool anti) const {
+  int32_t rewrite_exists(int32_t plan_e, int32_t child, bool anti,
+                         bool mark = false) const {
     Correlation c = extract_correlation(plan_e);
     if (c.core < 0 || (c.pairs.empty() && c.corr_residuals.empty()))
       return -1;
@@ -4904,6 +4923,12 @@ class Optimizer {
       }));
     }
     int32_t jfilter = fixed.empty() ? -1 : conjoin(fixed);
+    if (mark) {
+      std::vector<int32_t> mfields = schema_of(child);
+      mfields.push_back(mk_field_node("__mark", TY_BOOLEAN, false));
+      JoinParts jp{child, sub, mfields, on, jfilter, "LEFTMARK", false};
+      return mk_join(jp);
+    }
     JoinParts jp{child, sub, schema_of(child), on, jfilter,
                  anti ? "LEFTANTI" : "LEFTSEMI", false};
     return mk_join(jp);
@@ -5083,6 +5108,45 @@ class Optimizer {
     return {join, new_conjunct};
   }
 
+  bool plan_has_outer_ref(int32_t plan) const {
+    std::vector<int32_t> below;
+    all_exprs_below(plan, below);
+    for (int32_t e : below)
+      if (has_outer_ref(e)) return true;
+    return false;
+  }
+
+  // correlated EXISTS under OR / mixed boolean logic: each becomes a MARK
+  // JOIN appending a boolean matched column (rules._rewrite_marks twin)
+  std::pair<int32_t, int32_t> rewrite_marks(int32_t conjunct,
+                                            int32_t child) const {
+    std::vector<int32_t> marks;
+    walk_expr(conjunct, [&](int32_t x) {
+      if (b.nodes[x].kind == E_EXISTS &&
+          plan_has_outer_ref(b.kids(x)[0]))
+        marks.push_back(x);
+    });
+    if (marks.empty()) return {-1, -1};
+    // nodes are immutable: a mid-loop decline just discards the local chain
+    std::map<int32_t, int32_t> replacements;
+    for (int32_t sub : marks) {
+      int32_t mark_join = rewrite_exists(b.kids(sub)[0], child, false, true);
+      if (mark_join < 0) return {-1, -1};
+      int nleft = schema_width(child);
+      child = mark_join;
+      int32_t ref = mk_colref_e(nleft, "__mark", TY_BOOLEAN, false);
+      if (b.nodes[sub].flags & 1)  // NOT EXISTS
+        ref = b.add(E_SCALARFN, {ref}, ty_flags(TY_BOOLEAN), 0, 0.0,
+                    b.intern_mut("not"));
+      replacements[sub] = ref;
+    }
+    int32_t out = transform_expr(conjunct, [&](int32_t x) -> int32_t {
+      auto it = replacements.find(x);
+      return it == replacements.end() ? x : it->second;
+    });
+    return {child, out};
+  }
+
   int32_t rule_decorrelate(int32_t plan) const {
     std::function<int32_t(int32_t)> go = [&](int32_t node0) -> int32_t {
       int32_t node = node0;
@@ -5121,8 +5185,11 @@ class Optimizer {
       if (n.kind != P_FILTER) return node;
       auto ks = b.kids(node);
       int32_t child = ks[0];
+      // factor common conjuncts out of disjunctions first (q41: the
+      // correlation hides as (corr AND a) OR (corr AND b))
+      int32_t factored = rewrite_disjunction(ks.back());
       std::vector<int32_t> parts;
-      conjuncts_of(ks.back(), parts);
+      conjuncts_of(factored, parts);
       int orig_width = schema_width(child);
       auto orig_fields = schema_of(child);
       bool changed = false;
@@ -5141,9 +5208,22 @@ class Optimizer {
           changed = true;
           continue;
         }
+        auto mres = rewrite_marks(c, child);
+        if (mres.first >= 0) {
+          child = mres.first;
+          kept.push_back(mres.second);
+          changed = true;
+          continue;
+        }
         kept.push_back(c);
       }
-      if (!changed) return node;
+      if (!changed) {
+        if (b.eq(factored, ks.back())) return node;
+        // keep the factored predicate for the outer extraction walk
+        return mk_filter_with_fields(
+            child, factored,
+            std::vector<int32_t>(ks.begin() + 1, ks.end() - 1));
+      }
       int32_t out = kept.empty() ? child : mk_filter(child, conjoin(kept));
       if (schema_width(out) != orig_width) {
         std::vector<int32_t> refs, nfields;

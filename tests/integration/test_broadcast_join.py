@@ -248,3 +248,18 @@ def test_sorted_probe_empty_build_counts_stats():
         jnp.zeros(0, dtype=jnp.int64), jnp.zeros(0, dtype=bool))
     assert STATS["broadcast_join_sorted"] == before + 1
     assert len(bi) == 0 and not matched.any()
+
+
+def test_mark_join_distributed(q5_ctx):
+    """EXISTS-under-OR on a sharded table: the mark join rides the same
+    collectives probe as semi joins (no local resort of global arrays)."""
+    c, t = q5_ctx
+    got = c.sql(
+        "SELECT COUNT(*) AS n FROM lineitem l WHERE "
+        "(EXISTS (SELECT 1 FROM orders o WHERE o.o_key = l.l_okey "
+        "         AND o.o_ckey < 100) OR l.l_price > 9000)",
+        return_futures=False)
+    li, o = t["lineitem"], t["orders"]
+    ok = set(o[o.o_ckey < 100].o_key)
+    exp = int((li.l_okey.isin(ok) | (li.l_price > 9000)).sum())
+    assert int(got["n"][0]) == exp

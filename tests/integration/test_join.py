@@ -165,3 +165,32 @@ def test_join_jit_probe_mode(c, user_table_1, user_table_2, monkeypatch):
               check_dtype=False)
     with pytest.raises(Exception):
         c.sql(q, config_options={"sql.compile.join": "bogus"}).compute()
+
+
+def test_mark_join_exists_under_or(c, user_table_1, user_table_2):
+    """Correlated EXISTS under OR decorrelates via a MARK join (the
+    reference xfails this shape — TPC-DS q10/q35)."""
+    result = c.sql(
+        "SELECT * FROM user_table_1 u WHERE b > 0 AND "
+        "(EXISTS (SELECT 1 FROM user_table_2 v WHERE v.user_id = u.user_id) "
+        " OR u.b > 2)"
+    ).compute()
+    u1, u2 = user_table_1, user_table_2
+    keep = (u1.b > 0) & (u1.user_id.isin(u2.user_id) | (u1.b > 2))
+    expected = u1[keep]
+    from tests.utils import assert_eq
+
+    assert_eq(result, expected, check_dtype=False, sort_results=True)
+
+
+def test_mark_join_not_exists_under_or(c, user_table_1, user_table_2):
+    result = c.sql(
+        "SELECT * FROM user_table_1 u WHERE "
+        "(NOT EXISTS (SELECT 1 FROM user_table_2 v WHERE v.user_id = u.user_id) "
+        " OR u.b = 3)"
+    ).compute()
+    u1, u2 = user_table_1, user_table_2
+    keep = (~u1.user_id.isin(u2.user_id)) | (u1.b == 3)
+    from tests.utils import assert_eq
+
+    assert_eq(result, u1[keep], check_dtype=False, sort_results=True)

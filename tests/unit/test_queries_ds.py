@@ -32,10 +32,10 @@ from tests.tpcds_queries import QUERIES
 # remaining shapes — EXISTS under OR (q10/q35) and a correlated scalar
 # COUNT whose correlation predicate sits under OR (q41) — are xfailed by
 # the REFERENCE too (reference tests/unit/test_queries.py:5-39).
+#: round 5: q10/q35 decorrelate via MARK joins (EXISTS under OR becomes a
+#: boolean matched column) and q41's hidden correlation factors out of its
+#: disjunction — all three of the REFERENCE'S OWN xfails now pass here
 XFAIL_QUERIES = {
-    10: "decorrelate: EXISTS under OR (reference xfails q10 too)",
-    35: "decorrelate: EXISTS under OR (reference xfails q35 too)",
-    41: "decorrelate: correlation predicate under OR (reference xfails q41 too)",
 }
 # round 4: the former SLOW skips (q23/q24/q64) are gone — the optimizer now
 # descends into subquery-embedded plans and the join reorderer flattens
