@@ -35,9 +35,10 @@ def key_arrays(cols: Sequence[Column]) -> List[jnp.ndarray]:
         if c.validity is not None:
             valid = c.valid_mask()
         if jnp.issubdtype(data.dtype, jnp.floating):
+            # unconditional: a content check would be a device round trip;
+            # an all-true mask keys identically
             nan = jnp.isnan(data)
-            if bool(nan.any()):
-                valid = ~nan if valid is None else (valid & ~nan)
+            valid = ~nan if valid is None else (valid & ~nan)
         if valid is not None:
             # NULL forms its own single group (dropna=False semantics,
             # reference aggregate.py:575-577): zero the payload under NULL and
@@ -104,12 +105,19 @@ def radix_gid(cols: Sequence[Column], max_domain: int = 1 << 22):
             strides.append(s)
             s *= r
         strides = list(reversed(strides))
-        for c, r, off, stride in zip(cols, radices, offsets, strides):
+        # ONE device pull decides every column's NULL-group presence (a
+        # per-column bool(any()) was a round trip each on a tunneled chip)
+        null_masks = [(gids // stride) % r == (r - 1)
+                      for r, stride in zip(radices, strides)]
+        if null_masks:
+            from ..utils import host_ints
+
+            flags = host_ints(*[m.any() for m in null_masks])
+        for ci, (c, r, off, stride) in enumerate(zip(cols, radices, offsets,
+                                                     strides)):
             code = (gids // stride) % r
-            validity = None
-            is_null = code == (r - 1)
-            if bool(is_null.any()):
-                validity = ~is_null
+            is_null = null_masks[ci]
+            validity = ~is_null if bool(flags[ci]) else None
             code = jnp.minimum(code, r - 2)
             if c.sql_type in STRING_TYPES:
                 out.append(Column(code.astype(jnp.int32), c.sql_type, validity,

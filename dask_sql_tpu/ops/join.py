@@ -287,8 +287,13 @@ def _probe(lgid, rgid, use_jit: bool = False):
     return li, ri, counts
 
 
-def take_with_nulls(col: Column, indices: jnp.ndarray) -> Column:
-    """Gather rows; index -1 produces NULL (outer-join fill)."""
+def take_with_nulls(col: Column, indices: jnp.ndarray,
+                    may_pad: Optional[bool] = None) -> Column:
+    """Gather rows; index -1 produces NULL (outer-join fill).
+
+    `may_pad` tells the gather statically whether -1 fills can occur
+    (False for inner/semi matches, True for outer padding) — without it a
+    per-column content check costs a device round trip per column."""
     n = len(col)
     if n == 0:
         # empty source: every index is the -1 fill (outer join against an
@@ -299,7 +304,9 @@ def take_with_nulls(col: Column, indices: jnp.ndarray) -> Column:
     neg = indices < 0
     safe = jnp.clip(indices, 0, max(n - 1, 0))
     data = col.data[safe]
-    valid = col.valid_mask()[safe] & ~neg
-    if not bool(neg.any()) and col.validity is None:
+    if may_pad is None:
+        may_pad = bool(neg.any())
+    if not may_pad and col.validity is None:
         return Column(data, col.sql_type, None, col.dictionary)
+    valid = col.valid_mask()[safe] & ~neg
     return Column(data, col.sql_type, valid, col.dictionary)

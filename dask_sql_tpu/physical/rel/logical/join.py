@@ -27,14 +27,19 @@ def _cross_indices(nl: int, nr: int):
     return li, ri
 
 
-def _materialize(left: Table, right: Table, li, ri) -> Table:
-    """Gather a combined table from index pairs; -1 indices produce NULLs."""
+def _materialize(left: Table, right: Table, li, ri,
+                 l_may_pad=None, r_may_pad=None) -> Table:
+    """Gather a combined table from index pairs; -1 indices produce NULLs.
+
+    `l_may_pad`/`r_may_pad` pass the static pad-possibility per side (inner
+    matches never pad) so the per-column content sync in take_with_nulls is
+    skipped; None keeps the dynamic check."""
     names = unique_names(list(left.column_names) + list(right.column_names))
     cols = {}
     for name, src in zip(names[: len(left.column_names)], left.column_names):
-        cols[name] = join_ops.take_with_nulls(left.columns[src], li)
+        cols[name] = join_ops.take_with_nulls(left.columns[src], li, l_may_pad)
     for name, src in zip(names[len(left.column_names):], right.column_names):
-        cols[name] = join_ops.take_with_nulls(right.columns[src], ri)
+        cols[name] = join_ops.take_with_nulls(right.columns[src], ri, r_may_pad)
     return Table(cols, int(li.shape[0]))
 
 
@@ -107,7 +112,7 @@ class JoinPlugin(BaseRelPlugin):
                 return self.fix_column_to_row_type(left.filter(mask),
                                                    rel.schema)
             if jt == "INNER":
-                combined = _materialize(left, right, li, ri)
+                combined = _materialize(left, right, li, ri, False, False)
                 if rel.filter is not None:
                     cond = executor.eval_expr(rel.filter, combined)
                     combined = combined.filter(cond.data & cond.valid_mask())
@@ -146,7 +151,7 @@ class JoinPlugin(BaseRelPlugin):
                 li, ri = join_ops.inner_join_indices(lgid, rgid, use_jit)
             else:
                 ri, li = join_ops.inner_join_indices(rgid, lgid, use_jit)
-            combined = _materialize(left, right, li, ri)
+            combined = _materialize(left, right, li, ri, False, False)
             if rel.filter is not None:
                 cond = executor.eval_expr(rel.filter, combined)
                 combined = combined.filter(cond.data & cond.valid_mask())
@@ -161,7 +166,7 @@ class JoinPlugin(BaseRelPlugin):
     def _filtered_match_mask(self, rel, executor, left, right, li, ri):
         """Per-left-row matched flag under the residual filter (shared by
         the semi/anti/mark variants on both probe paths)."""
-        combined = _materialize(left, right, li, ri)
+        combined = _materialize(left, right, li, ri, False, False)
         cond = executor.eval_expr(rel.filter, combined)
         keep = cond.data & cond.valid_mask()
         matched = jnp.zeros(left.num_rows, dtype=bool)
@@ -218,7 +223,7 @@ class JoinPlugin(BaseRelPlugin):
         """Outer join from inner (li, ri) pairs: apply the residual to matched
         pairs, then pad outer rows that lost all their matches."""
         if rel.filter is not None and int(li.shape[0]):
-            combined = _materialize(left, right, li, ri)
+            combined = _materialize(left, right, li, ri, False, False)
             cond = executor.eval_expr(rel.filter, combined)
             keep = cond.data & cond.valid_mask()
             li, ri = li[keep], ri[keep]
@@ -237,7 +242,10 @@ class JoinPlugin(BaseRelPlugin):
             pad = jnp.nonzero(~rm)[0].astype(jnp.int64)
             li2 = jnp.concatenate([li2, jnp.full(pad.shape[0], -1, dtype=jnp.int64)])
             ri2 = jnp.concatenate([ri2, pad])
-        combined = _materialize(left, right, li2, ri2)
+        # pad-possibility is static per join type: LEFT/FULL pad the right
+        # side, RIGHT/FULL the left
+        combined = _materialize(left, right, li2, ri2,
+                                jt in ("RIGHT", "FULL"), jt in ("LEFT", "FULL"))
         return self.fix_column_to_row_type(combined, rel.schema)
 
     def _maybe_dist_pairs(self, executor, left, right, lkeys, rkeys, lgid, rgid):
@@ -296,4 +304,4 @@ class CrossJoinPlugin(BaseRelPlugin):
         left, right = self.assert_inputs(rel, 2, executor)
         li, ri = _cross_indices(left.num_rows, right.num_rows)
         return self.fix_column_to_row_type(
-            _materialize(left, right, li, ri), rel.schema)
+            _materialize(left, right, li, ri, False, False), rel.schema)

@@ -54,8 +54,6 @@ def _pandas_q67(tables):
 
     The LIMIT-stripped comparand drops the top-level LIMIT only; rank ties
     make the <=100 cut itself well-defined (RANK admits all peers)."""
-    import numpy as np
-
     ss, dd = tables["store_sales"], tables["date_dim"]
     st, it = tables["store"], tables["item"]
     m = (ss.merge(dd, left_on="ss_sold_date_sk", right_on="d_date_sk")
