@@ -101,6 +101,8 @@ def bench_q3_line(backend: str):
     n = 1_000_000
     tables = generate(scale_rows=n)
     c = Context()
+    # result cache off: measure execution, not serving-cache lookups
+    c.config.update({"serving.cache.enabled": False})
     for name, frame in tables.items():
         c.create_table(name, frame)
     q3 = QUERIES[3]
@@ -129,6 +131,8 @@ def main():
     df = gen_lineitem(N_ROWS)
 
     c = Context()
+    # result cache off: measure execution, not serving-cache lookups
+    c.config.update({"serving.cache.enabled": False})
     c.create_table("lineitem", df)
 
     # warm-up (compile caches, device transfer)

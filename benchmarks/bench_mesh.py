@@ -70,6 +70,8 @@ def main():
         mesh_mod.set_default_mesh(mesh)
         try:
             c = Context()
+            # result cache off: measure execution, not serving-cache lookups
+            c.config.update({"serving.cache.enabled": False})
             c.create_table("lineitem", q1_df, distributed=ndev > 1)
             t1 = run_query(c, Q1_QUERY)
             c2 = Context()

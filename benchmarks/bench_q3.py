@@ -22,6 +22,8 @@ def main(scale_rows: int = 1_000_000):
 
     tables = generate(scale_rows=scale_rows)
     c = Context()
+    # result cache off: measure execution, not serving-cache lookups
+    c.config.update({"serving.cache.enabled": False})
     for name, df in tables.items():
         c.create_table(name, df)
 

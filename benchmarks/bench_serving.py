@@ -1,0 +1,144 @@
+"""Serving-runtime benchmark: repeated-query throughput (cold vs. warm
+result cache) and latency percentiles under 32 concurrent clients, through
+the real Presto HTTP server.
+
+Prints JSON lines in the bench.py convention:
+  {"metric": "serving_warm_qps", "value": ..., "unit": "queries/s", ...}
+so the driver's next BENCH_*.json tail can record it.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+
+sys.path.insert(0, ".")
+sys.path.insert(0, "tests")
+
+N_ROWS = 2_000_000
+N_CLIENTS = 32
+N_QUERIES = 96  # total across clients, per phase
+QUERY = ("SELECT g, SUM(x) AS s, COUNT(*) AS n FROM traffic "
+         "GROUP BY g ORDER BY s DESC")
+
+
+def _post(port: int, sql: str):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/statement", data=sql.encode(),
+        method="POST")
+    with urllib.request.urlopen(req) as resp:
+        return json.loads(resp.read())
+
+
+def _follow(payload, timeout=120.0):
+    deadline = time.time() + timeout
+    while "nextUri" in payload and time.time() < deadline:
+        time.sleep(0.01)
+        with urllib.request.urlopen(payload["nextUri"]) as resp:
+            payload = json.loads(resp.read())
+    return payload
+
+
+def _run_phase(port: int, sqls) -> dict:
+    """Fire the statements from N_CLIENTS threads; return wall + latencies."""
+    import concurrent.futures
+
+    lat = []
+
+    def one(sql):
+        t0 = time.perf_counter()
+        payload = _follow(_post(port, sql))
+        state = payload.get("stats", {}).get("state")
+        assert state == "FINISHED", payload.get("error", state)
+        lat.append(time.perf_counter() - t0)
+
+    t0 = time.perf_counter()
+    with concurrent.futures.ThreadPoolExecutor(max_workers=N_CLIENTS) as pool:
+        list(pool.map(one, sqls))
+    wall = time.perf_counter() - t0
+    lat_s = sorted(lat)
+
+    def pct(q):
+        return lat_s[min(len(lat_s) - 1, int(q * (len(lat_s) - 1) + 0.5))]
+
+    return {"wall_s": round(wall, 3), "qps": round(len(sqls) / wall, 1),
+            "p50_ms": round(pct(0.5) * 1e3, 1),
+            "p99_ms": round(pct(0.99) * 1e3, 1)}
+
+
+def main():
+    import pandas as pd
+
+    from dask_sql_tpu import Context
+    from dask_sql_tpu.server.app import run_server
+
+    rng = np.random.RandomState(0)
+    c = Context()
+    c.create_table("traffic", pd.DataFrame({
+        "g": rng.randint(0, 128, N_ROWS),
+        "x": rng.rand(N_ROWS),
+    }))
+    srv = run_server(context=c, host="127.0.0.1", port=0, blocking=False)
+    port = srv.port
+    try:
+        # warm compile caches once so "cold" measures execution, not XLA
+        _follow(_post(port, QUERY))
+
+        # cold: distinct statements -> every query misses the result cache
+        cold_sqls = [QUERY + f" LIMIT {100 + i}" for i in range(N_QUERIES)]
+        cold = _run_phase(port, cold_sqls)
+        print(json.dumps({"metric": "serving_cold_qps", "unit": "queries/s",
+                          "clients": N_CLIENTS, **cold}))
+
+        # warm: one identical statement -> result cache serves repeats
+        warm = _run_phase(port, [QUERY] * N_QUERIES)
+        print(json.dumps({"metric": "serving_warm_qps", "unit": "queries/s",
+                          "clients": N_CLIENTS, **warm}))
+
+        m = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/v1/metrics").read())
+        cache = m.get("resultCache", {})
+        print(json.dumps({
+            "metric": "serving_cache",
+            "hitRate": cache.get("hitRate"),
+            "hits": cache.get("hits"), "misses": cache.get("misses"),
+            "bytes": cache.get("bytes"),
+            "warm_speedup": round(warm["qps"] / max(cold["qps"], 1e-9), 2),
+        }))
+
+        # shed behavior against a deliberately tiny queue
+        shed = _shed_probe()
+        print(json.dumps({"metric": "serving_shed_probe", **shed}))
+    finally:
+        srv.shutdown()
+
+
+def _shed_probe() -> dict:
+    """Burst 16 instant submits at a 1-worker/1-slot runtime; count sheds."""
+    import threading
+
+    from dask_sql_tpu.serving import QueueFullError, ServingRuntime
+
+    rt = ServingRuntime(workers=1, bounds={"interactive": 1, "batch": 1})
+    gate = threading.Event()
+    rt.submit(lambda t: gate.wait(10))
+    accepted, shed, retry_hints = 1, 0, []
+    for _ in range(16):
+        try:
+            rt.submit(lambda t: None)
+            accepted += 1
+        except QueueFullError as e:
+            shed += 1
+            retry_hints.append(e.retry_after_s)
+    gate.set()
+    rt.shutdown()
+    return {"accepted": accepted, "shed": shed,
+            "retry_after_s": retry_hints[0] if retry_hints else None}
+
+
+if __name__ == "__main__":
+    main()

@@ -4,6 +4,12 @@ Role parity: reference piggybacks on dask.config with `sql.yaml` defaults +
 `sql-schema.yaml` docs (config.py:1-12 there).  Self-contained here: a
 process-global nested config with the same `sql.*` keys, `set()` context
 manager for per-query overrides (Context.sql(config_options=...)).
+
+The `serving.*` keys configure the serving runtime (serving/): worker-pool
+size, per-class admission queue bounds and the batch running cap, default
+query deadline + retry-after floor for load shedding, and the result cache
+(enabled / byte budget / per-entry cap / TTL).  Each key's default below
+carries an inline doc comment; docs/serving.md has the full semantics.
 """
 from __future__ import annotations
 
@@ -42,15 +48,48 @@ DEFAULTS: Dict[str, Any] = {
     "sql.distributed.aggregate": "auto",  # collectives engine routing
     "sql.distributed.join": "auto",
     "sql.distributed.sort": "auto",  # range-partition sort over the mesh
+    "sql.debug.validate_take": False,  # assert gather-index invariants (host sync per gather)
+    # Serving runtime (serving/) — admission control, result cache, metrics.
+    # See docs/serving.md for semantics; all keys are read when the runtime
+    # or Context is constructed (per-query config_options do not re-size
+    # pools, but DO partition the result-cache key).
+    "serving.workers": 8,  # query worker threads in the Presto server pool
+    "serving.queue.interactive": 32,  # max WAITING interactive queries before shedding
+    "serving.queue.batch": 64,  # max WAITING batch queries before shedding
+    "serving.batch.max_running": None,  # concurrent batch cap (None = workers-1; 0 pauses batch)
+    "serving.deadline_s": None,  # default per-query deadline, seconds (None = unbounded)
+    "serving.retry_after_s": 1.0,  # floor of the retry-after hint on load shed
+    "serving.cache.enabled": True,  # result cache for repeated identical queries
+    "serving.cache.max_bytes": 256 << 20,  # total resident bytes before LRU eviction
+    "serving.cache.max_entry_bytes": 64 << 20,  # per-entry cap (huge results bypass the cache)
+    "serving.cache.ttl_s": 300.0,  # entry time-to-live, seconds (None = no TTL)
+    "serving.metrics.node_traces": False,  # per-plan-node tracing folded into the registry
 }
 
 
 class Config:
+    """Process-global base values + thread-local scoped overlays.
+
+    `update()` mutates the global base (visible everywhere).  `set()` pushes
+    a scoped overlay onto THIS thread's stack only: concurrent queries on
+    server worker threads each see their own per-query options, so one
+    query's override can never leak into another's execution — or into the
+    result-cache key it is stored under."""
+
     def __init__(self):
         self._values: Dict[str, Any] = dict(DEFAULTS)
         self._lock = threading.RLock()
+        self._local = threading.local()
+
+    def _overlay_stack(self):
+        return getattr(self._local, "stack", None)
 
     def get(self, key: str, default: Any = None) -> Any:
+        stack = self._overlay_stack()
+        if stack:
+            for frame in reversed(stack):
+                if key in frame:
+                    return frame[key]
         with self._lock:
             if key in self._values:
                 return self._values[key]
@@ -66,17 +105,24 @@ class Config:
     def set(self, options: Optional[Dict[str, Any]] = None, **kwargs):
         options = dict(options or {})
         options.update(kwargs)
-        with self._lock:
-            saved = {k: self._values[k] for k in options if k in self._values}
-            missing = [k for k in options if k not in self._values]
-            self._values.update(options)
+        stack = self._overlay_stack()
+        if stack is None:
+            stack = []
+            self._local.stack = stack
+        stack.append(options)
         try:
             yield self
         finally:
-            with self._lock:
-                self._values.update(saved)
-                for k in missing:
-                    self._values.pop(k, None)
+            stack.pop()
+
+    def effective_items(self):
+        """Sorted (key, value) pairs of the config THIS thread sees — base
+        values merged with any active overlays; the cache-key ingredient."""
+        with self._lock:
+            merged = dict(self._values)
+        for frame in self._overlay_stack() or ():
+            merged.update(frame)
+        return tuple(sorted(merged.items()))
 
 
 #: process-global config (parity: dask.config global)

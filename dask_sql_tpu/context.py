@@ -16,6 +16,7 @@ jax/XLA kernels through the physical plugin registries.
 from __future__ import annotations
 
 import logging
+import time
 from collections import OrderedDict
 from typing import Any, Callable, Dict, List, Optional, Tuple, Union
 
@@ -67,13 +68,37 @@ class TpuFrame:
         return list(self._field_names)
 
     def execute(self) -> Table:
-        """Run the plan to a device Table (cached)."""
+        """Run the plan to a device Table (cached).
+
+        Serving integration: before executing, the context's result cache is
+        consulted under a key of (plan fingerprint, catalog signature,
+        config) — a repeated identical query returns the materialized Table
+        without touching the executor; any DDL/DML on a referenced table
+        changes the key (uid / `_catalog_serial` versioning), so stale
+        results can never be served."""
         if self._result is None:
             from .physical.executor import Executor
 
-            with self._context.config.set(self._config_options):
-                executor = Executor(self._context)
+            ctx = self._context
+            with ctx.config.set(self._config_options):
+                key = ctx._result_cache_key(self._plan, self._config_options)
+                if key is not None:
+                    hit = ctx._result_cache.get(key)
+                    if hit is not None:
+                        self._result = hit
+                        return self._result
+                trace = bool(ctx.config.get("serving.metrics.node_traces",
+                                            False))
+                executor = Executor(ctx, trace=trace)
+                t0 = time.perf_counter()
                 self._result = executor.execute_root(self._plan)
+                ctx.metrics.observe(
+                    "query.execute_ms", (time.perf_counter() - t0) * 1000.0)
+                ctx.metrics.inc("query.executed")
+                if trace:
+                    executor.tracer.publish(ctx.metrics)
+                if key is not None:
+                    ctx._result_cache.put(key, self._result)
         return self._result
 
     def compute(self):
@@ -133,6 +158,24 @@ class Context:
         self._plan_cache: "OrderedDict[Tuple, List[Any]]" = OrderedDict()
         #: bumped on every view/function (re)definition or drop
         self._catalog_serial = 0
+        from .serving.cache import ResultCache
+        from .serving.metrics import MetricsRegistry
+
+        #: serving metrics registry: query/cache/executor counters and
+        #: latency histograms (SHOW METRICS, server /v1/metrics)
+        self.metrics = MetricsRegistry()
+        #: materialized-result cache (serving/cache.py); keyed via
+        #: _result_cache_key so DDL/DML versions entries out
+        self._result_cache = ResultCache(
+            max_bytes=int(self.config.get("serving.cache.max_bytes",
+                                          256 << 20)),
+            max_entry_bytes=int(self.config.get(
+                "serving.cache.max_entry_bytes", 64 << 20)),
+            ttl_s=self.config.get("serving.cache.ttl_s", 300.0),
+            metrics=self.metrics)
+        #: the ServingRuntime when a server front-end attached one (so
+        #: SHOW METRICS can surface admission/queue state)
+        self.serving = None
         logging.basicConfig(level=logging_level)
 
     _PLAN_CACHE_CAP = 128
@@ -144,27 +187,93 @@ class Context:
         inputs are pinned by the table uids in the signature)."""
         try:
             parts: List[Any] = [sql, self.schema_name]
-            for schema_name in sorted(self.schema):
-                container = self.schema[schema_name]
-                parts.append(schema_name)
-                parts.append(tuple(sorted(
-                    (name, dc.uid) for name, dc in container.tables.items())))
-                stats = container.statistics
-                parts.append(tuple(sorted(
-                    (name, s.row_count) for name, s in stats.items()
-                    if s is not None)))
-                parts.append(tuple(sorted(self._views.get(schema_name, {}))))
-                parts.append(tuple(sorted(container.function_lists)))
+            parts.extend(self._catalog_signature())
             # id()-free: view/function redefinitions bump _catalog_serial
             # (id reuse after a drop would silently replay a stale plan)
             parts.append(self._catalog_serial)
-            parts.append(tuple(sorted(self.config._values.items())))
+            parts.append(self.config.effective_items())
             if config_options:
                 parts.append(tuple(sorted(config_options.items())))
             key = tuple(parts)
             hash(key)  # unhashable config values -> skip caching
             return key
         except TypeError:
+            return None
+
+    def _catalog_signature(self) -> List[Any]:
+        """Versioned identity of the catalog: table uids, statistics row
+        counts, view and function names per schema.  Shared by the plan
+        cache and the result cache — any DDL/DML that replaces a table
+        (fresh uid), redefines a view/function (`_catalog_serial` bump) or
+        refreshes statistics changes the signature."""
+        parts: List[Any] = []
+        for schema_name in sorted(self.schema):
+            container = self.schema[schema_name]
+            parts.append(schema_name)
+            parts.append(tuple(sorted(
+                (name, dc.uid) for name, dc in container.tables.items())))
+            stats = container.statistics
+            parts.append(tuple(sorted(
+                (name, s.row_count) for name, s in stats.items()
+                if s is not None)))
+            parts.append(tuple(sorted(self._views.get(schema_name, {}))))
+            parts.append(tuple(sorted(container.function_lists)))
+        return parts
+
+    def _on_catalog_change(self) -> None:
+        """Called by every DDL-shaped mutation (table/view/function/model/
+        schema changes).  The result-cache keys embed the catalog signature,
+        so stale entries could never be *hit* — but unreachable entries
+        would stay pinned in HBM until byte-pressure from new inserts.
+        Dropping the cache eagerly frees those buffers now."""
+        self._result_cache.invalidate_all()
+
+    def _result_cache_key(self, plan, config_options) -> Optional[Tuple]:
+        """Result-cache key: (normalized plan fingerprint, catalog
+        signature + serial, config options) — or None when this result must
+        not be cached (caching disabled, side-effecting/model statements,
+        unhashable config)."""
+        if not self.config.get("serving.cache.enabled", True):
+            return None
+        if isinstance(plan, plan_nodes.CustomNode):
+            # DDL / ML statements: side effects or model-object state that
+            # the catalog signature does not fully version
+            return None
+        from .datacontainer import LazyParquetContainer
+
+        stack = [plan]
+        while stack:
+            node = stack.pop()
+            if isinstance(node, plan_nodes.TableScan):
+                dc = self.schema.get(node.schema_name, SchemaContainer(
+                    node.schema_name)).tables.get(node.table_name)
+                if isinstance(dc, LazyParquetContainer):
+                    # file-backed scan: the files can change on disk without
+                    # any catalog version bump, so the result is uncacheable
+                    return None
+            # volatile calls (RAND / CURRENT_TIMESTAMP) and UDFs (arbitrary
+            # host code) must re-evaluate per query; nested subquery plans
+            # join the walk so nothing hides inside an expression
+            nested, uncacheable = _scan_node_exprs(node)
+            if uncacheable:
+                return None
+            stack.extend(nested)
+            stack.extend(node.inputs())
+        try:
+            # repr() (not explain()) as the plan fingerprint: dataclass reprs
+            # include every semantic field recursively, so two plans that
+            # differ only in a detail the pretty-printer omits (e.g. sort
+            # null ordering) can never collide
+            parts: List[Any] = ["result", repr(plan), self.schema_name]
+            parts.extend(self._catalog_signature())
+            parts.append(self._catalog_serial)
+            parts.append(self.config.effective_items())
+            if config_options:
+                parts.append(tuple(sorted(config_options.items())))
+            key = tuple(parts)
+            hash(key)
+            return key
+        except Exception:  # unhashable config / unprintable plan
             return None
 
     # ------------------------------------------------------------ tables
@@ -218,6 +327,7 @@ class Context:
             self.schema[schema_name].filepaths[table_name] = filepath
         if self._views.setdefault(schema_name, {}).pop(table_name, None) is not None:
             self._catalog_serial += 1
+        self._on_catalog_change()
 
     def drop_table(self, table_name: str, schema_name: Optional[str] = None) -> None:
         schema_name = schema_name or self.schema_name
@@ -225,6 +335,7 @@ class Context:
         self.schema[schema_name].statistics.pop(table_name, None)
         if self._views.get(schema_name, {}).pop(table_name, None) is not None:
             self._catalog_serial += 1
+        self._on_catalog_change()
 
     def alter_table(self, old_name: str, new_name: str,
                     schema_name: Optional[str] = None) -> None:
@@ -235,11 +346,13 @@ class Context:
         stats = self.schema[schema_name].statistics
         if old_name in stats:
             stats[new_name] = stats.pop(old_name)
+        self._on_catalog_change()
 
     # ------------------------------------------------------------ schemas
     def create_schema(self, schema_name: str) -> None:
         self.schema[schema_name] = SchemaContainer(schema_name)
         self._views.setdefault(schema_name, {})
+        self._on_catalog_change()
 
     def drop_schema(self, schema_name: str) -> None:
         if schema_name == self.schema_name:
@@ -247,6 +360,7 @@ class Context:
         self.schema.pop(schema_name, None)
         if self._views.pop(schema_name, None):
             self._catalog_serial += 1
+        self._on_catalog_change()
 
     def alter_schema(self, old_name: str, new_name: str) -> None:
         if old_name in self.schema:
@@ -256,6 +370,7 @@ class Context:
             self._views[new_name] = self._views.pop(old_name, {})
             if self.schema_name == old_name:
                 self.schema_name = new_name
+            self._on_catalog_change()
 
     # ------------------------------------------------------------ functions
     def register_function(
@@ -308,6 +423,7 @@ class Context:
             schema.function_lists[lower] = [fd]
         schema.functions[lower] = fd
         self._catalog_serial += 1
+        self._on_catalog_change()
 
     # ------------------------------------------------------------ checkpoint
     def save_state(self, location: str) -> dict:
@@ -333,6 +449,7 @@ class Context:
         schema_name = schema_name or self.schema_name
         self.schema[schema_name].models[model_name] = (model, list(training_columns))
         self._catalog_serial += 1
+        self._on_catalog_change()
 
     # ------------------------------------------------------------ queries
     def sql(
@@ -355,9 +472,11 @@ class Context:
             result = None
             if plans is not None:
                 self._plan_cache.move_to_end(key)
+                self.metrics.inc("query.plan_cache.hit")
                 for plan in plans:
                     result = self._run_plan(plan, config_options)
             else:
+                self.metrics.inc("query.plan_cache.miss")
                 statements = parse_sql(sql)
                 plans = []
                 # plan each statement right before running it: a later
@@ -536,9 +655,13 @@ class Context:
         """Catalog bytes for the native binder, cached across queries until
         any table/view/function changes (keyed like the plan cache)."""
         try:
+            # statistics row counts are serialized into the buffer for the
+            # native join reorderer, so an in-place stats refresh (same uid,
+            # same serial) must also invalidate (ADVICE r5)
             key = (self._catalog_serial, catalog.case_sensitive,
                    catalog.current_schema, tuple(
-                       (sname, tname, dc.uid)
+                       (sname, tname, dc.uid,
+                        getattr(cont.statistics.get(tname), "row_count", None))
                        for sname, cont in sorted(self.schema.items())
                        for tname, dc in sorted(cont.tables.items())))
         except Exception:
@@ -596,6 +719,7 @@ class Context:
     def _register_view(self, name: str, plan, schema_name: str) -> None:
         self._views.setdefault(schema_name, {})[name] = plan
         self._catalog_serial += 1
+        self._on_catalog_change()
 
     def _table_schema_name(self, parts: List[str]) -> Tuple[str, str]:
         if len(parts) >= 2:
@@ -653,6 +777,56 @@ class Context:
     def fqn(self, parts) -> Tuple[str, str]:
         """Fully-qualified (schema, table) from a name (parity context helper)."""
         return self._table_schema_name(list(parts))
+
+
+#: ops whose value changes between executions of the same plan (parity:
+#: optimizer rules' _is_volatile, plus the clock functions)
+_VOLATILE_OPS = frozenset(
+    {"rand", "rand_integer", "current_timestamp", "current_date"})
+
+
+def _scan_node_exprs(node) -> Tuple[List[Any], bool]:
+    """Walk every expression hanging off one plan node.  Returns
+    (nested subquery plans to keep walking, uncacheable) where uncacheable
+    means a volatile builtin or any user-defined function was found — such
+    results must never be served from the result cache."""
+    import dataclasses
+
+    from .planner.expressions import (
+        ExistsExpr,
+        Expr,
+        InSubqueryExpr,
+        ScalarFunc,
+        ScalarSubqueryExpr,
+        SortKey,
+        UdfExpr,
+    )
+    from .planner.expressions import walk as expr_walk
+
+    def exprs_of(v):
+        if isinstance(v, Expr):
+            yield v
+        elif isinstance(v, SortKey):
+            yield v.expr
+        elif isinstance(v, (list, tuple)):
+            for item in v:
+                yield from exprs_of(item)
+
+    nested: List[Any] = []
+    if not dataclasses.is_dataclass(node):
+        return nested, False
+    for f in dataclasses.fields(node):
+        for e in exprs_of(getattr(node, f.name, None)):
+            for x in expr_walk(e):
+                if isinstance(x, ScalarFunc) and x.op in _VOLATILE_OPS:
+                    return nested, True
+                if isinstance(x, UdfExpr):
+                    # arbitrary host code: assume nondeterministic
+                    return nested, True
+                if isinstance(x, (ScalarSubqueryExpr, InSubqueryExpr,
+                                  ExistsExpr)) and x.plan is not None:
+                    nested.append(x.plan)
+    return nested, False
 
 
 def _to_sql_type(t) -> SqlType:

@@ -302,6 +302,16 @@ def take_with_nulls(col: Column, indices: jnp.ndarray,
         return Column(jnp.zeros(m, dtype=col.data.dtype), col.sql_type,
                       jnp.zeros(m, dtype=bool), col.dictionary)
     neg = indices < 0
+    if may_pad is False and __debug__:
+        # contract check: may_pad=False promises no -1 fills, and a violation
+        # silently materializes clamped garbage rows marked valid.  The
+        # device sync is only paid when the validation flag is on.
+        from .. import config as config_module
+
+        if config_module.get("sql.debug.validate_take", False):
+            assert not bool(neg.any()), (
+                "take_with_nulls(may_pad=False) received negative indices; "
+                "the calling join type must pass may_pad=True")
     safe = jnp.clip(indices, 0, max(n - 1, 0))
     data = col.data[safe]
     if may_pad is None:

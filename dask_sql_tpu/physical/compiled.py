@@ -77,6 +77,18 @@ class _Unsupported(Exception):
     pass
 
 
+def padded_int_bounds(data, row_valid):
+    """Device min/max of an integer group-key column, with pad rows masked
+    out: on a padded sharded table the zero pad rows would otherwise widen
+    the radix span/offset, and real keys far from 0 could falsely trip the
+    1<<22 domain gate (ADVICE r5).  Row 0 is always a logical row when any
+    exist (padding appends at the tail), so it is a safe fill value."""
+    if row_valid is None:
+        return jnp.min(data), jnp.max(data)
+    safe = jnp.where(row_valid, data, data[0])
+    return jnp.min(safe), jnp.max(safe)
+
+
 def check_agg_static_support(agg_exprs):
     """Plan-only aggregate eligibility for the compiled pipelines (shared by
     CompiledAggregate and compiled_join) — raises _Unsupported."""
@@ -745,7 +757,8 @@ class CompiledAggregate:
                 radices.append(3)
                 offsets.append(0)
             elif jnp.issubdtype(c.data.dtype, jnp.integer) and len(c):
-                pending.append((len(radices), jnp.min(c.data), jnp.max(c.data)))
+                lo, hi = padded_int_bounds(c.data, table.row_valid)
+                pending.append((len(radices), lo, hi))
                 radices.append(None)
                 offsets.append(None)
             else:

@@ -307,8 +307,10 @@ class CompiledJoinAggregate:
             if isinstance(g, _BuildRef):
                 bt = build_tables[g.k]
                 col = bt.columns[bt.column_names[g.col]]
+                row_valid = bt.row_valid
             elif isinstance(g, ColumnRef) and type(g) is ColumnRef:
                 col = probe_table.columns[probe_table.column_names[g.index]]
+                row_valid = probe_table.row_valid
             else:
                 raise _Unsupported("non-column group key")
             if col.sql_type in STRING_TYPES and col.dictionary is not None:
@@ -319,8 +321,10 @@ class CompiledJoinAggregate:
                 spec.append({"ref": g, "kind": "bool", "r": 3, "off": 0,
                              "col": col})
             elif jnp.issubdtype(col.data.dtype, jnp.integer) and len(col):
-                pending.append((len(spec), jnp.min(col.data),
-                                jnp.max(col.data)))
+                from .compiled import padded_int_bounds
+
+                lo, hi = padded_int_bounds(col.data, row_valid)
+                pending.append((len(spec), lo, hi))
                 spec.append({"ref": g, "kind": "int", "r": None,
                              "off": None, "col": col})
             else:

@@ -84,15 +84,17 @@ def _extract(root):
         node = node.input
     if not isinstance(node, p.TableScan):
         return None
-    return (node, list(filters) + list(node.filters), proj, sort_keys,
-            sort_fetch, limit, inner_limit)
+    # upper Filter-node predicates stay separate from scan.filters: a Limit
+    # parked between them windows only the scan-filtered rows
+    # (limit-then-filter), so the mask builder needs both lists
+    return (node, list(filters), proj, sort_keys, sort_fetch, limit,
+            inner_limit)
 
 
 class CompiledSelect:
-    def __init__(self, table: Table, scan, filters, proj, sort_keys,
+    def __init__(self, table: Table, scan, upper_filters, proj, sort_keys,
                  sort_fetch, limit, inner_limit):
         self.scan = scan
-        self.filters = filters
         self.proj = proj
         self.sort_keys = sort_keys
         self.sort_fetch = sort_fetch
@@ -126,7 +128,8 @@ class CompiledSelect:
         ev = _TraceEval(_TableMeta(table))
         n_cols = len(table.column_names)
         exprs = list(proj.exprs)
-        flts = list(filters)
+        upper_flts = list(upper_filters)
+        scan_flts = list(scan.filters)
         self._pack_tags: List[Tuple[str, np.dtype]] = []
 
         inner_limit = self.inner_limit
@@ -134,25 +137,39 @@ class CompiledSelect:
         def mask_fn(datas, valids, row_valid):
             slots = {i: (datas[i], valids[i]) for i in range(n_cols)}
             nr = datas[0].shape[0] if datas else 0
-            mask = row_valid
-            for f in flts:
+
+            def fold(mask, f):
                 d, v = ev.eval(f, slots)
                 m = d if v is None else (d & v)
-                mask = m if mask is None else (mask & m)
-            if mask is None:
-                mask = jnp.ones(nr, dtype=bool)
-            elif mask.ndim == 0:  # constant predicate (e.g. WHERE 1 = 1)
-                mask = jnp.broadcast_to(mask, (nr,))
+                return m if mask is None else (mask & m)
+
+            def as_rows(mask):
+                if mask is None:
+                    return jnp.ones(nr, dtype=bool)
+                if mask.ndim == 0:  # constant predicate (e.g. WHERE 1 = 1)
+                    return jnp.broadcast_to(mask, (nr,))
+                return mask
+
+            mask = row_valid
+            for f in scan_flts:
+                mask = fold(mask, f)
             if inner_limit is not None:
-                # a Limit parked above the scan windows the FILTERED
-                # survivors (the scan applies its filters first): the
-                # survivor ordinal makes that a static-shape mask refinement
+                # a Limit parked above the scan windows the rows the SCAN's
+                # own filters keep — the plan order is limit-then-filter
+                # (Projection <- Filter* <- Limit <- TableScan), so upper
+                # Filter-node predicates must apply AFTER the window, not
+                # shrink it (ADVICE r5).  The survivor ordinal makes the
+                # window a static-shape mask refinement.
+                mask = as_rows(mask)
                 skip_i, fetch_i = inner_limit
                 ordinal = jnp.cumsum(mask.astype(jnp.int64))
                 w = ordinal > skip_i
                 if fetch_i is not None:
                     w &= ordinal <= skip_i + fetch_i
                 mask = mask & w
+            for f in upper_flts:
+                mask = fold(mask, f)
+            mask = as_rows(mask)
             return mask, jnp.sum(mask.astype(jnp.int64))
 
         def gather_fn(datas, valids, mask, bucket):
@@ -285,7 +302,7 @@ def try_compiled_select(root, executor) -> Optional[Table]:
     got = _extract(root)
     if got is None:
         return None
-    scan, filters, proj, sort_keys, sort_fetch, limit, inner_limit = got
+    scan, upper_filters, proj, sort_keys, sort_fetch, limit, inner_limit = got
     try:
         dc = executor.context.schema[scan.schema_name].tables.get(scan.table_name)
         if dc is None:
@@ -309,7 +326,8 @@ def try_compiled_select(root, executor) -> Optional[Table]:
         key = (
             dc.uid,
             tuple(scan.projection or ()),
-            tuple(str(f) for f in filters),
+            tuple(str(f) for f in upper_filters),
+            tuple(str(f) for f in scan.filters),
             tuple(str(e) for e in proj.exprs),
             tuple(str(k.expr) + str(k.ascending) + str(k.nulls_first)
                   for k in sort_keys) if sort_keys else None,
@@ -321,8 +339,9 @@ def try_compiled_select(root, executor) -> Optional[Table]:
         )
         compiled = _cache.get(key)
         if compiled is None:
-            compiled = CompiledSelect(table, scan, filters, proj, sort_keys,
-                                      sort_fetch, limit, inner_limit)
+            compiled = CompiledSelect(table, scan, upper_filters, proj,
+                                      sort_keys, sort_fetch, limit,
+                                      inner_limit)
             _cache[key] = compiled
             while len(_cache) > _CACHE_CAP:
                 _cache.popitem(last=False)

@@ -13,6 +13,7 @@ from typing import Dict, Optional
 
 from ..columnar.table import Table
 from ..planner.plan import LogicalPlan
+from ..serving.runtime import current_ticket
 from .rel.base import BaseRelPlugin
 from .rex.convert import RexConverter
 
@@ -44,12 +45,21 @@ class Executor:
         (physical/compiled_select.py) before the recursive converter runs."""
         from .compiled_select import try_compiled_select
 
+        ticket = current_ticket()
+        if ticket is not None:  # checkpoint before the one-kernel fast path
+            ticket.checkpoint()
         out = try_compiled_select(rel, self)
         if out is not None:
             return out
         return self.execute(rel)
 
     def execute(self, rel: LogicalPlan) -> Table:
+        # cooperative cancellation checkpoint: a query past its serving
+        # deadline (or cancelled by the client) raises here, between plan
+        # nodes, instead of holding a worker until the full plan finishes
+        ticket = current_ticket()
+        if ticket is not None:
+            ticket.checkpoint()
         key = id(rel)
         if key in self._memo:
             return self._memo[key]

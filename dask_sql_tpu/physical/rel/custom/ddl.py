@@ -205,6 +205,56 @@ class ShowModelsPlugin(BaseRelPlugin):
         return _string_table({"Model": list(ctx.schema[schema].models.keys())})
 
 
+def _like_match(pattern: str, name: str) -> bool:
+    """SQL LIKE semantics when the pattern uses a % wildcard (then _ is the
+    single-char wildcard too); plain substring containment otherwise.
+    Metric names routinely contain literal underscores, so a bare `_` does
+    NOT switch to LIKE mode — 'result_cache' filters by substring while
+    'serving.%' matches as a real pattern."""
+    if "%" in pattern:
+        import re
+
+        from ....ops.strings import like_to_regex
+
+        return re.match(like_to_regex(pattern), name) is not None
+    return pattern in name
+
+
+def _flatten_metrics(prefix: str, value) -> list:
+    """Nested snapshot dicts -> sorted (dotted-name, str) rows."""
+    if isinstance(value, dict):
+        out = []
+        for k in sorted(value):
+            out.extend(_flatten_metrics(f"{prefix}.{k}", value[k]))
+        return out
+    return [(prefix, "" if value is None else str(value))]
+
+
+@Executor.add_plugin_class
+class ShowMetricsPlugin(BaseRelPlugin):
+    """SHOW METRICS [LIKE 'pat'] — the serving runtime's registry as a
+    result set: query/cache counters, latency histograms (p50/p95/p99),
+    result-cache occupancy, and (when a server attached a ServingRuntime)
+    admission queue depths and rejection counts."""
+
+    class_name = "ShowMetricsNode"
+
+    def convert(self, rel: p.ShowMetricsNode, executor) -> Table:
+        ctx = executor.context
+        rows = list(ctx.metrics.rows())
+        rows.extend(_flatten_metrics("result_cache",
+                                     ctx._result_cache.snapshot()))
+        rows.append(("plan_cache.entries", str(len(ctx._plan_cache))))
+        if getattr(ctx, "serving", None) is not None:
+            rows.extend(_flatten_metrics("serving.runtime",
+                                         ctx.serving.snapshot()))
+        if rel.like:
+            rows = [r for r in rows if _like_match(rel.like, r[0])]
+        rows.sort()
+        return _string_table({"Metric": [r[0] for r in rows],
+                              "Value": [r[1] for r in rows]})
+
+
 @Executor.add_plugin_class
 class AnalyzeTablePlugin(BaseRelPlugin):
     """ANALYZE TABLE ... COMPUTE STATISTICS (parity: analyze_table.py:15 —

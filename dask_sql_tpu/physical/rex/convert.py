@@ -200,12 +200,24 @@ class RexConverter:
         args = [self.convert(a, table) for a in expr.args]
         if fd.row_udf:
             # row UDF: pandas-style row dicts on host (reference UDF wrapper,
-            # datacontainer.py:234-270 there)
+            # datacontainer.py:234-270 there).  Row-wise host loops are the
+            # longest single-node stretch of a plan, so the serving ticket is
+            # polled per row — a cancel/deadline takes effect mid-UDF instead
+            # of after the whole column is computed.
             import pandas as pd
+
+            from ...serving.runtime import current_ticket
+
+            ticket = current_ticket()
+
+            def _call(row):
+                if ticket is not None:
+                    ticket.checkpoint()
+                return fd.func(row)
 
             frame = pd.DataFrame({f"arg{i}": a.to_numpy() for i, a in enumerate(args)})
             frame.columns = [p[0] for p in fd.parameters][: len(args)]
-            out = frame.apply(lambda row: fd.func(row), axis=1).to_numpy()
+            out = frame.apply(_call, axis=1).to_numpy()
             col = Column.from_numpy(np.asarray(out))
         else:
             out = fd.func(*[a.data for a in args])

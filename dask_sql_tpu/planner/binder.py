@@ -162,6 +162,10 @@ class Binder:
             return p.ShowColumnsNode(fields, stmt.table)
         if isinstance(stmt, a.ShowModels):
             return p.ShowModelsNode([Field("Model", SqlType.VARCHAR)], stmt.schema)
+        if isinstance(stmt, a.ShowMetrics):
+            return p.ShowMetricsNode(
+                [Field("Metric", SqlType.VARCHAR), Field("Value", SqlType.VARCHAR)],
+                stmt.like)
         if isinstance(stmt, a.AnalyzeTable):
             return p.AnalyzeTableNode([], stmt.table, stmt.columns)
         if isinstance(stmt, a.CreateModel):

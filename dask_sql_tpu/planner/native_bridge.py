@@ -128,6 +128,7 @@ _K_SHOW_SCHEMAS = 88; _K_SHOW_TABLES = 89; _K_SHOW_COLUMNS = 90
 _K_SHOW_MODELS = 91; _K_ANALYZE_TABLE = 92; _K_CREATE_MODEL = 93
 _K_DROP_MODEL = 94; _K_DESCRIBE_MODEL = 95; _K_EXPORT_MODEL = 96
 _K_CREATE_EXPERIMENT = 97; _K_KWARGS = 98; _K_KV = 99; _K_KWLIST = 100
+_K_SHOW_METRICS = 101
 
 _FRAME_KINDS = ["UNBOUNDED_PRECEDING", "PRECEDING", "CURRENT_ROW",
                 "FOLLOWING", "UNBOUNDED_FOLLOWING"]
@@ -553,6 +554,8 @@ def _decode_statement(f: "_FlatAst", sid: int):
         return a.ShowColumns(_decode_qname(f, kids[0]))
     if kind == _K_SHOW_MODELS:
         return a.ShowModels(f.s(s0))
+    if kind == _K_SHOW_METRICS:
+        return a.ShowMetrics(f.s(s0))
     if kind == _K_ANALYZE_TABLE:
         cols = [f.s(f.nodes[p][4]) for p in kids[1:]]
         return a.AnalyzeTable(_decode_qname(f, kids[0]), cols)
@@ -592,7 +595,7 @@ _P_ALTER_SCHEMA = 26; _P_ALTER_TABLE = 27; _P_SHOW_SCHEMAS = 28
 _P_SHOW_TABLES = 29; _P_SHOW_COLUMNS = 30; _P_SHOW_MODELS = 31
 _P_ANALYZE_TABLE = 32; _P_CREATE_MODEL = 33; _P_DROP_MODEL = 34
 _P_DESCRIBE_MODEL = 35; _P_EXPORT_MODEL = 36; _P_CREATE_EXPERIMENT = 37
-_P_PREDICT_MODEL = 38
+_P_PREDICT_MODEL = 38; _P_SHOW_METRICS = 39
 _P_FIELD = 50; _P_SORTKEY = 51; _P_ON_PAIR = 52; _P_VALUES_ROW = 53
 _P_PART = 54; _P_KWARGS = 55; _P_KV = 56; _P_KWLIST = 57; _P_WINSPEC = 58
 _P_FRAME_BOUND = 59
@@ -987,6 +990,9 @@ class _PlanDecoder:
         if kind == _P_SHOW_MODELS:
             sc = F.s(s0) if flags & 1 else None
             return p.ShowModelsNode(self.fields(kids), sc)
+        if kind == _P_SHOW_METRICS:
+            like = F.s(s0) if flags & 1 else None
+            return p.ShowMetricsNode(self.fields(kids), like)
         if kind == _P_ANALYZE_TABLE:
             table = [F.s(F.nodes[i][4]) for i in kids if F.nodes[i][1] == 0]
             columns = [F.s(F.nodes[i][4]) for i in kids if F.nodes[i][1] == 1]

@@ -266,7 +266,13 @@ class Parser:
             if self.accept_keyword("FROM") or self.accept_keyword("IN"):
                 schema = self.parse_identifier()
             return a.ShowModels(schema)
-        raise self.error("Expected SCHEMAS, TABLES, COLUMNS or MODELS after SHOW")
+        if self.accept_keyword("METRICS"):
+            like = None
+            if self.accept_keyword("LIKE"):
+                like = self.next().value
+            return a.ShowMetrics(like)
+        raise self.error(
+            "Expected SCHEMAS, TABLES, COLUMNS, MODELS or METRICS after SHOW")
 
     def parse_alter(self) -> a.Statement:
         self.expect_keyword("ALTER")

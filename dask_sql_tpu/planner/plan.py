@@ -192,7 +192,10 @@ class Sort(LogicalPlan):
 
     def _label(self):
         ks = ", ".join(
-            f"{k.expr} {'ASC' if k.ascending else 'DESC'}" for k in self.keys
+            f"{k.expr} {'ASC' if k.ascending else 'DESC'}"
+            + ("" if k.nulls_first is None
+               else (" NULLS FIRST" if k.nulls_first else " NULLS LAST"))
+            for k in self.keys
         )
         return f"Sort: [{ks}]" + (f" fetch={self.fetch}" if self.fetch is not None else "")
 
@@ -429,6 +432,13 @@ class ShowColumnsNode(CustomNode):
 @dataclass(eq=False)
 class ShowModelsNode(CustomNode):
     schema_name: Optional[str] = None
+
+
+@dataclass(eq=False)
+class ShowMetricsNode(CustomNode):
+    """SHOW METRICS — serving runtime observability (serving/metrics.py)."""
+
+    like: Optional[str] = None
 
 
 @dataclass(eq=False)

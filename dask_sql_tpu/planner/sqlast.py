@@ -393,6 +393,13 @@ class ShowModels(Statement):
 
 
 @dataclass
+class ShowMetrics(Statement):
+    """SHOW METRICS: serving-runtime counters/histograms as a result set."""
+
+    like: Optional[str] = None
+
+
+@dataclass
 class AnalyzeTable(Statement):
     table: List[str]
     columns: List[str] = field(default_factory=list)

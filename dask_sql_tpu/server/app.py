@@ -4,22 +4,38 @@ Role parity: reference server/app.py — POST /v1/statement (app.py:69-100),
 async status polling GET /v1/statement/{id} (app.py:44-66), cancellation
 DELETE /v1/cancel/{id} (app.py:28-41), /v1/empty, plus JDBC metadata tables
 (server/presto_jdbc.py).  Built on the stdlib ThreadingHTTPServer (this image
-ships no fastapi/uvicorn); queries run on a worker thread pool so polling
-stays responsive — the analogue of the reference's distributed futures.
+ships no fastapi/uvicorn).
+
+Queries no longer run on a bare thread pool: submission goes through the
+serving runtime (serving/) — bounded per-class admission queues with load
+shedding (a submit past the bound returns a structured 429 + Retry-After
+through the wire protocol instead of queueing unbounded work), per-query
+deadlines that cancel cooperatively at executor checkpoints, and a metrics
+registry surfaced at /v1/metrics and via ``SHOW METRICS``.  Clients pick a
+concurrency class with the ``X-Dsql-Class: interactive|batch`` header and a
+deadline with ``X-Dsql-Deadline-Ms``.
 """
 from __future__ import annotations
 
 import json
 import logging
+import math
 import threading
 import time
 import uuid
 from collections import deque
-from concurrent.futures import Future, ThreadPoolExecutor
+from concurrent.futures import CancelledError
 from dataclasses import dataclass
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional
 
+from ..serving.admission import (
+    DeadlineExceededError,
+    QueryCancelledError,
+    QueryTicket,
+    QueueFullError,
+)
+from ..serving.runtime import ServingRuntime
 from . import responses
 
 logger = logging.getLogger(__name__)
@@ -29,8 +45,9 @@ logger = logging.getLogger(__name__)
 class _QueryEntry:
     """Lifecycle of one submitted statement, for the stats/metrics surfaces."""
 
-    future: Future
+    future: Any
     submitted: float
+    ticket: Optional[QueryTicket] = None
     started: Optional[float] = None
     plan_done: Optional[float] = None
     finished: Optional[float] = None
@@ -51,56 +68,78 @@ class _QueryEntry:
 
 
 class _QueryRegistry:
-    """Future registry (parity: the reference's app.future_list, app.py:20).
+    """Per-query lifecycle over the serving runtime.
 
-    Queries run on a worker pool; the GIL drops during device execution, so
-    host-side parse/plan/decode of one query overlaps device compute of
-    another (the analogue of the reference's overlapping distributed
-    futures, reference server/app.py:89).  Tracks per-query lifecycle
-    timestamps + completed-latency aggregates for /v1/metrics."""
+    The runtime (serving/runtime.py) owns scheduling: class-aware bounded
+    admission, the worker pool, deadline/cancel tickets.  This registry owns
+    the HTTP-facing bookkeeping — qid -> entry lookup for status polls,
+    queued/running gauges, completed-latency aggregates — the analogue of
+    the reference's app.future_list (reference server/app.py:20)."""
 
     #: terminal entries retained for late status polls before eviction
     KEEP_TERMINAL = 512
 
-    def __init__(self, max_workers: int = 8):
-        self.pool = ThreadPoolExecutor(max_workers=max_workers)
+    def __init__(self, context=None, config=None):
+        if config is None:
+            from .. import config as config_module
+
+            config = context.config if context is not None \
+                else config_module.config
+        metrics = context.metrics if context is not None else None
+        self.runtime = ServingRuntime.from_config(config, metrics=metrics)
+        self.metrics_registry = self.runtime.metrics
+        self.context = context
+        if context is not None:
+            # SHOW METRICS surfaces the admission/queue state of the runtime
+            context.serving = self.runtime
         self.entries: Dict[str, _QueryEntry] = {}
         self.lock = threading.Lock()
-        self.max_workers = max_workers
+        self.max_workers = self.runtime.workers
         self.completed = 0
         self.failed = 0
         self.cancelled = 0
+        self.rejected = 0
         self.n_queued = 0  # gauges, so /v1/metrics never scans the registry
         self.n_running = 0
+        self.latency_samples = 0
         self.total_latency_s = 0.0
         self.total_queued_s = 0.0
         self._terminal: "deque[str]" = deque()
 
-    def submit(self, fn) -> str:
+    def submit(self, fn, priority_class: str = "interactive",
+               deadline_s: Optional[float] = None) -> str:
+        """Admit + enqueue; raises `QueueFullError` (load shed) without
+        registering an entry."""
         qid = str(uuid.uuid4())
 
-        def run():
+        def run(ticket):
             with self.lock:
                 entry = self.entries.get(qid)
-                if entry is None:  # raced with a cancel that won
-                    return None
+                if entry is None:
+                    # defensive: entries outlive running queries now, so a
+                    # missing entry means a bookkeeping bug upstream — fail
+                    # the query rather than report FINISHED with no data
+                    raise QueryCancelledError(f"query {qid} entry lost")
                 entry.started = time.monotonic()
                 self.n_queued -= 1
                 self.n_running += 1
-            try:
-                return fn(lambda: self._mark_planned(qid))
-            except Exception:
-                self._finish(qid, error=True)
-                raise
-            finally:
-                self._finish(qid, error=False)
+            return fn(lambda: self._mark_planned(qid))
 
         with self.lock:
-            # entry registered before submit so run() always finds it
-            self.entries[qid] = _QueryEntry(future=None,  # type: ignore[arg-type]
-                                            submitted=time.monotonic())
+            # entry registered (and future attached) under one lock hold so
+            # a status poll can never observe a half-built entry
+            try:
+                _, fut, ticket = self.runtime.submit(
+                    run, qid=qid, priority_class=priority_class,
+                    deadline_s=deadline_s)
+            except QueueFullError:
+                self.rejected += 1
+                raise
+            self.entries[qid] = _QueryEntry(future=fut,
+                                            submitted=time.monotonic(),
+                                            ticket=ticket)
             self.n_queued += 1
-            self.entries[qid].future = self.pool.submit(run)
+        fut.add_done_callback(lambda f: self._finish(qid, f))
         return qid
 
     def _mark_planned(self, qid: str):
@@ -109,20 +148,37 @@ class _QueryRegistry:
             if e is not None and e.plan_done is None:
                 e.plan_done = time.monotonic()
 
-    def _finish(self, qid: str, error: bool):
+    def _finish(self, qid: str, fut):
+        """Done-callback: single finalization point for every outcome
+        (result, error, deadline, cancel-while-queued, cancel-mid-run)."""
         with self.lock:
             e = self.entries.get(qid)
             if e is None or e.finished is not None:
                 return
             e.finished = time.monotonic()
-            self.n_running -= 1
-            if error:
-                e.error = True
-                self.failed += 1
+            if e.started is None:
+                self.n_queued -= 1
             else:
-                self.completed += 1
-            self.total_latency_s += e.finished - e.submitted
+                self.n_running -= 1
+            if fut.cancelled():
+                self.cancelled += 1
+            else:
+                exc = fut.exception()
+                if isinstance(exc, QueryCancelledError):
+                    e.error = True
+                    self.cancelled += 1
+                elif exc is not None:
+                    e.error = True
+                    self.failed += 1
+                else:
+                    self.completed += 1
+            # the latency average divides by its own sample count: only
+            # queries that actually RAN contribute (a 60s queued-then-
+            # cancelled or queued-then-expired query must not inflate the
+            # operator's latency average with pure queue wait)
             if e.started is not None:
+                self.latency_samples += 1
+                self.total_latency_s += e.finished - e.submitted
                 self.total_queued_s += e.started - e.submitted
             # retain for late polls, bounded: the Future pins the result frame
             self._terminal.append(qid)
@@ -138,31 +194,44 @@ class _QueryRegistry:
             entry = self.entries.get(qid)
         if entry is None:
             return False
-        ok = entry.future.cancel()
-        if ok:
-            # cancel() only succeeds before run() starts, so the entry is
-            # still QUEUED; a running query keeps its entry (and its status
-            # polls) — parity with concurrent.futures semantics
-            with self.lock:
-                if self.entries.pop(qid, None) is not None:
-                    self.cancelled += 1
-                    self.n_queued -= 1
-        return ok
+        if entry.future.cancel():
+            # still queued: the runtime worker will skip it; _finish runs
+            # via the done-callback
+            if entry.ticket is not None:
+                entry.ticket.cancel()
+            return True
+        if entry.future.done():
+            return False
+        if entry.ticket is not None:
+            # running: cooperative — raises at the executor's next
+            # per-node cancellation checkpoint
+            entry.ticket.cancel()
+            return True
+        return False
 
     def metrics(self) -> Dict[str, Any]:
-        """Queue-depth / latency snapshot (VERDICT r4 #8)."""
+        """Queue-depth / latency snapshot + the serving registry."""
         with self.lock:
-            done = self.completed + self.failed
-            return {
+            n = self.latency_samples
+            out = {
                 "workers": self.max_workers,
                 "queueDepth": self.n_queued,
                 "running": self.n_running,
                 "completed": self.completed,
                 "failed": self.failed,
                 "cancelled": self.cancelled,
-                "avgLatencyMillis": int(self.total_latency_s / done * 1000) if done else 0,
-                "avgQueuedMillis": int(self.total_queued_s / done * 1000) if done else 0,
+                "rejected": self.rejected,
+                "avgLatencyMillis": int(self.total_latency_s / n * 1000) if n else 0,
+                "avgQueuedMillis": int(self.total_queued_s / n * 1000) if n else 0,
             }
+        out["serving"] = self.runtime.snapshot()
+        out["registry"] = self.metrics_registry.snapshot()
+        if self.context is not None:
+            out["resultCache"] = self.context._result_cache.snapshot()
+        return out
+
+    def shutdown(self):
+        self.runtime.shutdown()
 
 
 def _make_handler(context, registry: _QueryRegistry, jdbc_meta: bool):
@@ -172,11 +241,14 @@ def _make_handler(context, registry: _QueryRegistry, jdbc_meta: bool):
         def log_message(self, fmt, *args):  # quiet
             logger.debug(fmt, *args)
 
-        def _send(self, payload: Dict[str, Any], status: int = 200):
+        def _send(self, payload: Dict[str, Any], status: int = 200,
+                  headers: Optional[Dict[str, str]] = None):
             body = json.dumps(payload).encode()
             self.send_response(status)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
             self.end_headers()
             self.wfile.write(body)
 
@@ -205,7 +277,26 @@ def _make_handler(context, registry: _QueryRegistry, jdbc_meta: bool):
                 mark_planned()  # parse/bind/optimize done; device work next
                 return result.compute() if result is not None else None
 
-            qid = registry.submit(run)
+            priority_class = (self.headers.get("X-Dsql-Class")
+                              or "interactive").strip().lower()
+            deadline_s = None
+            deadline_ms = self.headers.get("X-Dsql-Deadline-Ms")
+            if deadline_ms:
+                try:
+                    deadline_s = max(0.0, float(deadline_ms) / 1000.0)
+                except ValueError:
+                    deadline_s = None
+            try:
+                qid = registry.submit(run, priority_class=priority_class,
+                                      deadline_s=deadline_s)
+            except QueueFullError as e:
+                # load shed: structured retry-after error instead of
+                # accepting unbounded work (parity: Trino's 429 + Retry-After)
+                retry_after = int(math.ceil(e.retry_after_s))
+                self._send(
+                    responses.queue_full_results(str(uuid.uuid4()), e),
+                    429, headers={"Retry-After": str(retry_after)})
+                return
             self._send({
                 "id": qid,
                 "infoUri": f"{self._base()}/v1/info/{qid}",
@@ -260,6 +351,21 @@ def _make_handler(context, registry: _QueryRegistry, jdbc_meta: bool):
                 return
             try:
                 df = entry.future.result()
+            except CancelledError:
+                self._send(responses.error_results(
+                    qid, None, QueryCancelledError(f"query {qid} cancelled"),
+                    error_name="USER_CANCELED"))
+                return
+            except QueryCancelledError as e:
+                # cancelled mid-run at an executor checkpoint: same wire
+                # error as a queued-state cancel
+                self._send(responses.error_results(
+                    qid, None, e, error_name="USER_CANCELED"))
+                return
+            except DeadlineExceededError as e:
+                self._send(responses.error_results(
+                    qid, None, e, error_name="EXCEEDED_TIME_LIMIT"))
+                return
             except Exception as e:  # noqa: BLE001 - surfaced to the client
                 self._send(responses.error_results(qid, None, e))
                 return
@@ -296,7 +402,7 @@ class PrestoServer:
             from .presto_jdbc import create_meta_data
 
             create_meta_data(self.context)
-        self.registry = _QueryRegistry()
+        self.registry = _QueryRegistry(context=self.context)
         handler = _make_handler(self.context, self.registry, jdbc_metadata)
         self.httpd = ThreadingHTTPServer((host, port), handler)
         self._thread: Optional[threading.Thread] = None
@@ -317,6 +423,7 @@ class PrestoServer:
     def shutdown(self):
         self.httpd.shutdown()
         self.httpd.server_close()
+        self.registry.shutdown()
 
 
 def run_server(context=None, host: str = "0.0.0.0", port: int = 8080,

@@ -124,7 +124,9 @@ def data_from_frame(df) -> List[List[Any]]:
     return out
 
 
-def error_results(query_id: str, next_uri: Optional[str], error: Exception) -> Dict[str, Any]:
+def error_results(query_id: str, next_uri: Optional[str], error: Exception,
+                  error_name: Optional[str] = None,
+                  error_type: str = "USER_ERROR") -> Dict[str, Any]:
     # parity: reference responses.py:128-141 ErrorResults formatting
     return {
         "id": query_id,
@@ -133,8 +135,8 @@ def error_results(query_id: str, next_uri: Optional[str], error: Exception) -> D
         "error": {
             "message": str(error),
             "errorCode": 1,
-            "errorName": type(error).__name__,
-            "errorType": "USER_ERROR",
+            "errorName": error_name or type(error).__name__,
+            "errorType": error_type,
             "failureInfo": {
                 "type": type(error).__name__,
                 "message": str(error),
@@ -143,3 +145,17 @@ def error_results(query_id: str, next_uri: Optional[str], error: Exception) -> D
         },
         "warnings": [],
     }
+
+
+def queue_full_results(query_id: str, error) -> Dict[str, Any]:
+    """Load-shed response: the admission queue is at its bound.  Structured
+    like a Presto ErrorResults with QUERY_QUEUE_FULL / INSUFFICIENT_RESOURCES
+    so drivers surface it as retryable, plus a machine-readable
+    ``retryAfterSeconds`` (also sent as the HTTP Retry-After header)."""
+    payload = error_results(query_id, None, error,
+                            error_name="QUERY_QUEUE_FULL",
+                            error_type="INSUFFICIENT_RESOURCES")
+    payload["error"]["retryAfterSeconds"] = float(
+        getattr(error, "retry_after_s", 1.0))
+    payload["error"]["priorityClass"] = getattr(error, "priority_class", "")
+    return payload

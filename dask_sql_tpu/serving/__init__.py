@@ -1,0 +1,42 @@
+"""Serving runtime: the layer between the front-ends (Presto server,
+`Context.sql`) and the executor for multi-query traffic.
+
+Three cooperating parts (TCR, arXiv:2203.01877 — once kernels are XLA-bound,
+end-to-end serving wins come from the runtime around them; Flare,
+arXiv:1703.08219 makes the same point for compiled Spark):
+
+- :mod:`.admission` — bounded per-class admission control with deadlines and
+  load shedding (structured retry-after errors instead of unbounded queues);
+- :mod:`.cache` — an LRU-by-bytes cache of materialized result Tables keyed
+  on (plan fingerprint, catalog signature, config), invalidated by DDL/DML
+  through the same versioning the plan cache uses;
+- :mod:`.metrics` — counters + latency/queue-depth histograms aggregated
+  from the per-node Tracer, surfaced as ``SHOW METRICS`` and ``/v1/metrics``.
+
+:mod:`.runtime` ties them together into the worker pool the Presto server
+runs queries on.
+"""
+from .admission import (
+    AdmissionController,
+    DeadlineExceededError,
+    QueryCancelledError,
+    QueryTicket,
+    QueueFullError,
+)
+from .cache import ResultCache, table_nbytes
+from .metrics import Histogram, MetricsRegistry
+from .runtime import ServingRuntime, current_ticket
+
+__all__ = [
+    "AdmissionController",
+    "DeadlineExceededError",
+    "Histogram",
+    "MetricsRegistry",
+    "QueryCancelledError",
+    "QueryTicket",
+    "QueueFullError",
+    "ResultCache",
+    "ServingRuntime",
+    "current_ticket",
+    "table_nbytes",
+]

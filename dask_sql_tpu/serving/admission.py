@@ -1,0 +1,178 @@
+"""Admission control: bounded per-class queues, deadlines, load shedding.
+
+Two concurrency classes — ``interactive`` (dashboards, point lookups:
+scheduled first) and ``batch`` (reports, ETL: capped so it can never starve
+interactive traffic).  Each class has a bounded *waiting* queue; a submit
+past the bound is rejected immediately with a structured retry-after error
+(`QueueFullError`) instead of queueing unbounded work — the Presto server
+translates that into a wire-level error payload, so clients back off
+instead of piling on.
+
+Deadlines propagate as a `QueryTicket` that the executor polls at
+cooperative cancellation checkpoints (`physical/executor.py` checks the
+current ticket before every plan node): a query past its deadline or
+cancelled by the client raises out of the next checkpoint rather than
+holding a worker until completion.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+#: scheduling order — lower runs first
+CLASSES = ("interactive", "batch")
+
+
+class QueueFullError(RuntimeError):
+    """Load shed: the class queue is at its bound; retry after a delay."""
+
+    def __init__(self, priority_class: str, bound: int, retry_after_s: float):
+        super().__init__(
+            f"admission queue for class {priority_class!r} is full "
+            f"({bound} waiting); retry after {retry_after_s:.1f}s")
+        self.priority_class = priority_class
+        self.bound = bound
+        self.retry_after_s = retry_after_s
+
+
+class DeadlineExceededError(RuntimeError):
+    """The query ran past its deadline and was cancelled at a checkpoint."""
+
+
+class QueryCancelledError(RuntimeError):
+    """The client cancelled the query; raised at the next checkpoint."""
+
+
+class QueryTicket:
+    """Per-admitted-query token: class, deadline, cooperative cancel flag.
+
+    `checkpoint()` is the only method hot code calls — it is lock-free
+    (reads a bool + the clock) so the executor can afford one per plan node.
+    """
+
+    __slots__ = ("qid", "priority_class", "deadline", "admitted_at",
+                 "started_at", "_cancelled")
+
+    def __init__(self, qid: str, priority_class: str = "interactive",
+                 deadline: Optional[float] = None):
+        self.qid = qid
+        self.priority_class = priority_class
+        #: absolute monotonic deadline (None = unbounded)
+        self.deadline = deadline
+        self.admitted_at = time.monotonic()
+        self.started_at: Optional[float] = None
+        self._cancelled = False
+
+    def cancel(self) -> None:
+        self._cancelled = True
+
+    @property
+    def cancelled(self) -> bool:
+        return self._cancelled
+
+    def expired(self) -> bool:
+        return self.deadline is not None and time.monotonic() > self.deadline
+
+    def remaining_s(self) -> Optional[float]:
+        if self.deadline is None:
+            return None
+        return self.deadline - time.monotonic()
+
+    def checkpoint(self) -> None:
+        """Raise if this query should stop; called from executor hot paths."""
+        if self._cancelled:
+            raise QueryCancelledError(f"query {self.qid} cancelled")
+        if self.expired():
+            raise DeadlineExceededError(
+                f"query {self.qid} exceeded its deadline")
+
+
+class AdmissionController:
+    """Bounded admission per concurrency class.
+
+    Tracks waiting/running counts; `admit` either returns a ticket or
+    sheds load with `QueueFullError`.  The retry-after hint scales with the
+    observed average latency and current backlog so shed clients spread out
+    instead of synchronizing their retries.
+    """
+
+    def __init__(self, bounds: Dict[str, int], workers: int,
+                 retry_after_s: float = 1.0, metrics=None):
+        self.bounds = {c: int(bounds.get(c, 32)) for c in CLASSES}
+        self.workers = max(1, int(workers))
+        self.retry_after_s = float(retry_after_s)
+        self.metrics = metrics
+        self._lock = threading.Lock()
+        self.waiting = {c: 0 for c in CLASSES}
+        self.running = {c: 0 for c in CLASSES}
+        self._latency_sum = 0.0
+        self._latency_n = 0
+
+    # ------------------------------------------------------------ lifecycle
+    def admit(self, qid: str, priority_class: str = "interactive",
+              deadline_s: Optional[float] = None) -> QueryTicket:
+        if priority_class not in self.bounds:
+            # unknown class names (typo'd header, future class) fall back to
+            # the documented default rather than silently demoting to batch
+            priority_class = "interactive"
+        with self._lock:
+            bound = self.bounds[priority_class]
+            if self.waiting[priority_class] >= bound:
+                retry = self._retry_after_locked(priority_class)
+                if self.metrics is not None:
+                    self.metrics.inc("serving.rejected")
+                    self.metrics.inc(f"serving.rejected.{priority_class}")
+                raise QueueFullError(priority_class, bound, retry)
+            self.waiting[priority_class] += 1
+            if self.metrics is not None:
+                self.metrics.inc("serving.admitted")
+                self.metrics.inc(f"serving.admitted.{priority_class}")
+        deadline = None if deadline_s is None \
+            else time.monotonic() + float(deadline_s)
+        return QueryTicket(qid, priority_class, deadline)
+
+    def on_start(self, ticket: QueryTicket) -> None:
+        ticket.started_at = time.monotonic()
+        with self._lock:
+            self.waiting[ticket.priority_class] -= 1
+            self.running[ticket.priority_class] += 1
+        if self.metrics is not None:
+            self.metrics.observe(
+                "serving.queue_wait_ms",
+                (ticket.started_at - ticket.admitted_at) * 1000.0)
+
+    def on_finish(self, ticket: QueryTicket, started: bool = True) -> None:
+        now = time.monotonic()
+        with self._lock:
+            if started:
+                self.running[ticket.priority_class] -= 1
+                self._latency_sum += now - ticket.admitted_at
+                self._latency_n += 1
+            else:
+                # never ran (cancelled / expired while queued)
+                self.waiting[ticket.priority_class] -= 1
+
+    # ------------------------------------------------------------- queries
+    def depth(self, priority_class: Optional[str] = None) -> int:
+        with self._lock:
+            if priority_class is not None:
+                return self.waiting[priority_class]
+            return sum(self.waiting.values())
+
+    def _retry_after_locked(self, priority_class: str) -> float:
+        avg = self._latency_sum / self._latency_n if self._latency_n else 0.0
+        backlog = sum(self.waiting.values()) + sum(self.running.values())
+        est = avg * backlog / self.workers if avg else self.retry_after_s
+        return min(60.0, max(self.retry_after_s, est))
+
+    def snapshot(self) -> Dict[str, object]:
+        with self._lock:
+            return {
+                "bounds": dict(self.bounds),
+                "waiting": dict(self.waiting),
+                "running": dict(self.running),
+                "avgLatencyMillis": int(
+                    self._latency_sum / self._latency_n * 1000)
+                if self._latency_n else 0,
+            }

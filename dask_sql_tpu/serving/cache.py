@@ -1,0 +1,170 @@
+"""Result cache: LRU-by-bytes of materialized result Tables.
+
+The plan cache (`Context._plan_cache`) removes re-parse/re-bind/re-optimize
+cost; repeated identical queries still re-execute the kernels.  For serving
+traffic (dashboards, retried requests) the result itself is the hot object,
+so this cache keys the *materialized* Table on (normalized plan fingerprint,
+catalog signature, config options) — the same catalog-versioning scheme the
+plan cache uses (table uids + `_catalog_serial` + statistics), so any
+DDL/DML that replaces or drops a referenced table changes the key and the
+stale entry simply can never be hit again (LRU pressure reclaims it).
+
+Byte accounting is explicit: eviction is by total resident bytes (a result
+Table pins HBM/host buffers, entry *count* is meaningless), a per-entry cap
+keeps one huge result from evicting the whole working set, and a TTL bounds
+staleness of anything keyed on out-of-band state.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Any, Dict, Hashable, Optional, Tuple
+
+
+def table_nbytes(table) -> int:
+    """Estimated resident bytes of a columnar Table (device buffers +
+    validity masks + host dictionaries)."""
+    total = 0
+    for col in table.columns.values():
+        data = getattr(col, "data", None)
+        if data is not None:
+            total += int(getattr(data, "nbytes", 0) or 0)
+        validity = getattr(col, "validity", None)
+        if validity is not None:
+            total += int(getattr(validity, "nbytes", 0) or 0)
+        dictionary = getattr(col, "dictionary", None)
+        if dictionary is not None:
+            # host object array of uniques: nbytes only counts pointers
+            total += sum(len(str(v)) for v in dictionary) + dictionary.nbytes
+    if table.row_valid is not None:
+        total += int(table.row_valid.nbytes)
+    return total
+
+
+@dataclass
+class _Entry:
+    value: Any
+    nbytes: int
+    created: float
+    hits: int = 0
+
+
+@dataclass
+class CacheStats:
+    hits: int = 0
+    misses: int = 0
+    inserts: int = 0
+    evictions: int = 0
+    expirations: int = 0
+    oversize_rejects: int = 0
+    bytes: int = 0
+    entries: int = 0
+
+    def as_dict(self) -> Dict[str, int]:
+        return dict(self.__dict__)
+
+
+class ResultCache:
+    """LRU-by-bytes cache with TTL and a per-entry byte cap.
+
+    Thread-safe; values are immutable columnar Tables (frozen dataclass
+    Columns over jax arrays), so sharing one instance across queries and
+    server worker threads is safe.
+    """
+
+    def __init__(self, max_bytes: int = 256 << 20,
+                 max_entry_bytes: int = 64 << 20,
+                 ttl_s: Optional[float] = 300.0,
+                 metrics=None,
+                 clock=time.monotonic):
+        self.max_bytes = int(max_bytes)
+        self.max_entry_bytes = int(max_entry_bytes)
+        self.ttl_s = ttl_s
+        self.metrics = metrics
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[Hashable, _Entry]" = OrderedDict()
+        self.stats = CacheStats()
+
+    # ------------------------------------------------------------------ ops
+    def get(self, key: Hashable) -> Optional[Any]:
+        now = self._clock()
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is not None and self.ttl_s is not None \
+                    and now - entry.created > self.ttl_s:
+                self._drop(key, entry)
+                self.stats.expirations += 1
+                entry = None
+            if entry is None:
+                self.stats.misses += 1
+                self._mark("query.cache.miss")
+                return None
+            entry.hits += 1
+            self.stats.hits += 1
+            self._entries.move_to_end(key)
+            self._mark("query.cache.hit")
+            return entry.value
+
+    def put(self, key: Hashable, value: Any,
+            nbytes: Optional[int] = None) -> bool:
+        """Insert (or refresh) an entry; returns False when the value is
+        over the per-entry cap and was not cached."""
+        if nbytes is None:
+            nbytes = table_nbytes(value)
+        nbytes = int(nbytes)
+        if nbytes > self.max_entry_bytes or nbytes > self.max_bytes:
+            with self._lock:
+                self.stats.oversize_rejects += 1
+            self._mark("query.cache.oversize")
+            return False
+        with self._lock:
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self.stats.bytes -= old.nbytes
+                self.stats.entries -= 1
+            self._entries[key] = _Entry(value, nbytes, self._clock())
+            self.stats.bytes += nbytes
+            self.stats.entries += 1
+            self.stats.inserts += 1
+            while self.stats.bytes > self.max_bytes and len(self._entries) > 1:
+                k, e = next(iter(self._entries.items()))
+                self._drop(k, e)
+                self.stats.evictions += 1
+                self._mark("query.cache.evicted")
+        return True
+
+    def invalidate_all(self) -> int:
+        with self._lock:
+            n = len(self._entries)
+            self._entries.clear()
+            self.stats.bytes = 0
+            self.stats.entries = 0
+        return n
+
+    # ------------------------------------------------------------- helpers
+    def _drop(self, key, entry) -> None:
+        # caller holds the lock
+        self._entries.pop(key, None)
+        self.stats.bytes -= entry.nbytes
+        self.stats.entries -= 1
+
+    def _mark(self, name: str) -> None:
+        if self.metrics is not None:
+            self.metrics.inc(name)
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            out = self.stats.as_dict()
+        total = out["hits"] + out["misses"]
+        out["hitRate"] = round(out["hits"] / total, 4) if total else 0.0
+        out["maxBytes"] = self.max_bytes
+        out["maxEntryBytes"] = self.max_entry_bytes
+        out["ttlSeconds"] = self.ttl_s
+        return out
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)

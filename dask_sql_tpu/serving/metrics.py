@@ -1,0 +1,150 @@
+"""Metrics registry: counters, gauges, and latency histograms for the
+serving runtime.
+
+Role parity: the reference points users at the dask dashboard for this;
+an inference-serving stack needs its own registry (admissions, rejections,
+timeouts, cache hit rate, queue-depth and latency percentiles) that both
+``SHOW METRICS`` and the server's ``/v1/metrics`` endpoint can snapshot.
+Aggregation from the per-node `Tracer` happens through `observe_trace`.
+"""
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+
+class Histogram:
+    """Bounded-reservoir histogram: O(1) observe, percentile on snapshot.
+
+    The reservoir keeps the most recent `window` observations — serving
+    percentiles should reflect *current* traffic, not the process lifetime —
+    while count/total stay exact cumulative aggregates."""
+
+    __slots__ = ("window", "count", "total", "vmax", "_ring")
+
+    def __init__(self, window: int = 2048):
+        self.window = window
+        self.count = 0
+        self.total = 0.0
+        self.vmax = 0.0
+        self._ring: "deque[float]" = deque(maxlen=window)
+
+    def observe(self, value: float) -> None:
+        value = float(value)
+        self.count += 1
+        self.total += value
+        if value > self.vmax:
+            self.vmax = value
+        self._ring.append(value)
+
+    def percentiles(self, qs: Iterable[float] = (0.5, 0.95, 0.99)) -> List[float]:
+        data = sorted(self._ring)
+        if not data:
+            return [0.0 for _ in qs]
+        n = len(data)
+        return [data[min(n - 1, int(q * (n - 1) + 0.5))] for q in qs]
+
+    def snapshot(self) -> Dict[str, Any]:
+        p50, p95, p99 = self.percentiles()
+        return {
+            "count": self.count,
+            "sum": round(self.total, 3),
+            "avg": round(self.total / self.count, 3) if self.count else 0.0,
+            "p50": round(p50, 3),
+            "p95": round(p95, 3),
+            "p99": round(p99, 3),
+            "max": round(self.vmax, 3),
+        }
+
+
+class MetricsRegistry:
+    """Thread-safe named counters / gauges / histograms.
+
+    Flat dotted names (``query.cache.hit``, ``serving.rejected``); the
+    snapshot is JSON-ready for ``/v1/metrics`` and row-flattened for
+    ``SHOW METRICS``."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[str, int] = {}
+        self._gauges: Dict[str, float] = {}
+        self._hists: Dict[str, Histogram] = {}
+
+    # ------------------------------------------------------------- writes
+    def inc(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + n
+
+    def gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self._gauges[name] = value
+
+    def observe(self, name: str, value: float) -> None:
+        with self._lock:
+            hist = self._hists.get(name)
+            if hist is None:
+                hist = self._hists[name] = Histogram()
+            hist.observe(value)
+
+    def observe_trace(self, root) -> None:
+        """Fold one executor `NodeTrace` tree into per-node-type wall-time
+        histograms (``executor.node.<type>.ms``) and row counters."""
+        if root is None:
+            return
+        stack = [root]
+        while stack:
+            t = stack.pop()
+            self.observe(f"executor.node.{t.node_type}.ms", t.wall_ms)
+            if t.rows >= 0:
+                self.inc(f"executor.node.{t.node_type}.rows", t.rows)
+            stack.extend(t.children)
+
+    # -------------------------------------------------------------- reads
+    def counter(self, name: str) -> int:
+        with self._lock:
+            return self._counters.get(name, 0)
+
+    def hit_rate(self, hit: str, miss: str) -> float:
+        with self._lock:
+            h = self._counters.get(hit, 0)
+            m = self._counters.get(miss, 0)
+        return h / (h + m) if (h + m) else 0.0
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            out: Dict[str, Any] = {
+                "counters": dict(self._counters),
+                "gauges": dict(self._gauges),
+                "histograms": {k: h.snapshot() for k, h in self._hists.items()},
+            }
+        out["cacheHitRate"] = round(
+            self.hit_rate("query.cache.hit", "query.cache.miss"), 4)
+        return out
+
+    def rows(self) -> List[Tuple[str, str]]:
+        """Flatten the snapshot to (metric, value) string pairs, sorted by
+        name — the ``SHOW METRICS`` result shape."""
+        snap = self.snapshot()
+        rows: List[Tuple[str, str]] = []
+        for name, v in snap["counters"].items():
+            rows.append((name, str(v)))
+        for name, v in snap["gauges"].items():
+            rows.append((name, _fmt(v)))
+        for name, h in snap["histograms"].items():
+            for stat in ("count", "avg", "p50", "p95", "p99", "max"):
+                rows.append((f"{name}.{stat}", _fmt(h[stat])))
+        rows.append(("query.cache.hit_rate", _fmt(snap["cacheHitRate"])))
+        return sorted(rows)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._hists.clear()
+
+
+def _fmt(v) -> str:
+    if isinstance(v, float) and v == int(v):
+        return str(int(v))
+    return str(v)

@@ -1,0 +1,213 @@
+"""ServingRuntime: the worker pool queries actually run on.
+
+Replaces the server's bare ThreadPoolExecutor (fixed workers, unbounded
+submission) with class-aware scheduling over the admission controller:
+
+- ``interactive`` work is always popped first;
+- ``batch`` work runs only while fewer than ``batch_max_running`` batch
+  queries are in flight, so a burst of reports cannot occupy every worker;
+- a submit past the class queue bound raises `QueueFullError` *in the
+  submitting thread* (the server turns it into a retry-after wire error);
+- each admitted query carries a `QueryTicket`; while the query runs the
+  ticket is installed in a thread-local that `physical/executor.py` polls
+  at per-node cancellation checkpoints, so deadline expiry and client
+  cancels take effect mid-plan instead of after the fact.
+
+The GIL drops during device execution, so host-side parse/plan/decode of
+one query overlaps device compute of another (the analogue of the
+reference's overlapping distributed futures, reference server/app.py:89).
+"""
+from __future__ import annotations
+
+import logging
+import threading
+import time
+import uuid
+from collections import deque
+from concurrent.futures import Future
+from typing import Callable, Dict, Optional, Tuple
+
+from .admission import (
+    CLASSES,
+    AdmissionController,
+    DeadlineExceededError,
+    QueryCancelledError,
+    QueryTicket,
+)
+from .metrics import MetricsRegistry
+
+logger = logging.getLogger(__name__)
+
+_tls = threading.local()
+
+
+def current_ticket() -> Optional[QueryTicket]:
+    """The ticket of the query running on this thread, if any — the
+    executor's cancellation checkpoints poll this."""
+    return getattr(_tls, "ticket", None)
+
+
+class ServingRuntime:
+    def __init__(self, workers: int = 8,
+                 bounds: Optional[Dict[str, int]] = None,
+                 batch_max_running: Optional[int] = None,
+                 retry_after_s: float = 1.0,
+                 default_deadline_s: Optional[float] = None,
+                 metrics: Optional[MetricsRegistry] = None):
+        self.workers = max(1, int(workers))
+        self.metrics = metrics if metrics is not None else MetricsRegistry()
+        self.admission = AdmissionController(
+            bounds or {"interactive": 32, "batch": 64}, self.workers,
+            retry_after_s=retry_after_s, metrics=self.metrics)
+        # 0 is a legitimate setting (pause batch entirely), so only None
+        # falls back to the workers-1 default
+        self.batch_max_running = int(batch_max_running) \
+            if batch_max_running is not None else max(1, self.workers - 1)
+        self.default_deadline_s = default_deadline_s
+        self._queues: Dict[str, deque] = {c: deque() for c in CLASSES}
+        self._cv = threading.Condition()
+        #: batch queries popped-but-not-finished, owned by _cv (admission's
+        #: running counter is updated later under its own lock, so checking
+        #: it from _pop_locked would let a burst overshoot the cap)
+        self._batch_in_flight = 0
+        self._shutdown = False
+        self._threads = [
+            threading.Thread(target=self._worker, daemon=True,
+                             name=f"dsql-serving-{i}")
+            for i in range(self.workers)
+        ]
+        for t in self._threads:
+            t.start()
+
+    @classmethod
+    def from_config(cls, config, metrics=None) -> "ServingRuntime":
+        """Build from the ``serving.*`` keys (see config.py docstrings)."""
+        return cls(
+            workers=int(config.get("serving.workers", 8)),
+            bounds={
+                "interactive": int(config.get("serving.queue.interactive", 32)),
+                "batch": int(config.get("serving.queue.batch", 64)),
+            },
+            batch_max_running=config.get("serving.batch.max_running"),
+            retry_after_s=float(config.get("serving.retry_after_s", 1.0)),
+            default_deadline_s=config.get("serving.deadline_s"),
+            metrics=metrics,
+        )
+
+    # -------------------------------------------------------------- submit
+    def submit(self, fn: Callable[[QueryTicket], object],
+               qid: Optional[str] = None,
+               priority_class: str = "interactive",
+               deadline_s: Optional[float] = None,
+               ) -> Tuple[str, Future, QueryTicket]:
+        """Admit and enqueue `fn(ticket)`; raises `QueueFullError` when the
+        class queue is at its bound (load shedding, never blocks)."""
+        if self._shutdown:
+            raise RuntimeError("serving runtime is shut down")
+        if priority_class == "batch" and self.batch_max_running == 0:
+            # batch is paused: shed immediately instead of admitting work
+            # that no worker would ever pop (client would hang in QUEUED)
+            from .admission import QueueFullError
+
+            self.metrics.inc("serving.rejected")
+            self.metrics.inc("serving.rejected.batch")
+            raise QueueFullError("batch", 0, self.admission.retry_after_s)
+        qid = qid or str(uuid.uuid4())
+        if deadline_s is None:
+            deadline_s = self.default_deadline_s
+        ticket = self.admission.admit(qid, priority_class, deadline_s)
+        fut: Future = Future()
+        with self._cv:
+            self._queues[ticket.priority_class].append((ticket, fn, fut))
+            self._cv.notify()
+        return qid, fut, ticket
+
+    # -------------------------------------------------------------- workers
+    def _pop_locked(self):
+        q = self._queues["interactive"]
+        if q:
+            return q.popleft()
+        q = self._queues["batch"]
+        if q and self._batch_in_flight < self.batch_max_running:
+            self._batch_in_flight += 1
+            return q.popleft()
+        return None
+
+    def _worker(self):
+        while True:
+            with self._cv:
+                item = self._pop_locked()
+                while item is None and not self._shutdown:
+                    self._cv.wait()
+                    item = self._pop_locked()
+                if item is None:  # shutdown with a drained queue
+                    return
+            ticket, fn, fut = item
+            if not fut.set_running_or_notify_cancel():
+                # cancelled while queued through Future.cancel()
+                self.admission.on_finish(ticket, started=False)
+                self.metrics.inc("serving.cancelled")
+                self._release(ticket)
+                continue
+            if ticket.cancelled or ticket.expired():
+                self.admission.on_finish(ticket, started=False)
+                if ticket.cancelled:
+                    self.metrics.inc("serving.cancelled")
+                    fut.set_exception(
+                        QueryCancelledError(f"query {ticket.qid} cancelled"))
+                else:
+                    self.metrics.inc("serving.timeouts")
+                    fut.set_exception(DeadlineExceededError(
+                        f"query {ticket.qid} expired while queued"))
+                self._release(ticket)
+                continue
+            self.admission.on_start(ticket)
+            _tls.ticket = ticket
+            try:
+                result = fn(ticket)
+            except QueryCancelledError as e:
+                self.metrics.inc("serving.cancelled")
+                fut.set_exception(e)
+            except DeadlineExceededError as e:
+                self.metrics.inc("serving.timeouts")
+                fut.set_exception(e)
+            except BaseException as e:  # noqa: BLE001 - surfaced via Future
+                self.metrics.inc("serving.failed")
+                fut.set_exception(e)
+            else:
+                self.metrics.inc("serving.completed")
+                fut.set_result(result)
+            finally:
+                _tls.ticket = None
+                self.admission.on_finish(ticket)
+                if ticket.started_at is not None:
+                    self.metrics.observe(
+                        "serving.latency_ms",
+                        (time.monotonic() - ticket.admitted_at) * 1000.0)
+                self._release(ticket)
+
+    def _release(self, ticket: QueryTicket):
+        """Return a popped item's scheduling slot: frees the batch
+        running-cap and wakes workers blocked on it."""
+        with self._cv:
+            if ticket.priority_class == "batch":
+                self._batch_in_flight -= 1
+            self._cv.notify_all()
+
+    # ------------------------------------------------------------ lifecycle
+    def shutdown(self, wait: bool = False, timeout: float = 5.0) -> None:
+        with self._cv:
+            self._shutdown = True
+            self._cv.notify_all()
+        if wait:
+            for t in self._threads:
+                t.join(timeout)
+
+    def snapshot(self) -> Dict[str, object]:
+        adm = self.admission.snapshot()
+        return {
+            "workers": self.workers,
+            "batchMaxRunning": self.batch_max_running,
+            "queues": {c: len(self._queues[c]) for c in CLASSES},
+            "admission": adm,
+        }

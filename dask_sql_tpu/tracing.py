@@ -39,6 +39,14 @@ class Tracer:
         self._stack = [[]]
         self.root = None
 
+    def publish(self, registry) -> None:
+        """Fold the finished trace into a serving `MetricsRegistry` —
+        per-node-type wall-time histograms and row counters.  The registry
+        aggregates across queries; the trace tree itself stays per-query
+        (EXPLAIN ANALYZE / `Context.last_trace`)."""
+        if registry is not None and self.root is not None:
+            registry.observe_trace(self.root)
+
     def node(self, rel):
         tracer = self
 

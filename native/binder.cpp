@@ -436,6 +436,7 @@ enum AstKind : int32_t {
   K_SHOW_MODELS = 91, K_ANALYZE_TABLE = 92, K_CREATE_MODEL = 93,
   K_DROP_MODEL = 94, K_DESCRIBE_MODEL = 95, K_EXPORT_MODEL = 96,
   K_CREATE_EXPERIMENT = 97, K_KWARGS = 98, K_KV = 99, K_KWLIST = 100,
+  K_SHOW_METRICS = 101,
 };
 
 struct AstNode {
@@ -648,7 +649,7 @@ enum PKind : int32_t {
   P_SHOW_TABLES = 29, P_SHOW_COLUMNS = 30, P_SHOW_MODELS = 31,
   P_ANALYZE_TABLE = 32, P_CREATE_MODEL = 33, P_DROP_MODEL = 34,
   P_DESCRIBE_MODEL = 35, P_EXPORT_MODEL = 36, P_CREATE_EXPERIMENT = 37,
-  P_PREDICT_MODEL = 38,
+  P_PREDICT_MODEL = 38, P_SHOW_METRICS = 39,
   // aux
   P_FIELD = 50, P_SORTKEY = 51, P_ON_PAIR = 52, P_VALUES_ROW = 53,
   P_PART = 54, P_KWARGS = 55, P_KV = 56, P_KWLIST = 57, P_WINSPEC = 58,
@@ -3119,6 +3120,12 @@ class Binder {
       case K_SHOW_MODELS: {
         std::vector<BField> f{{"Model", TY_VARCHAR, true}};
         return b.add(P_SHOW_MODELS, mk_fields(f), a.has_s(n.s0) ? 1 : 0, 0,
+                     0.0, a.has_s(n.s0) ? b.intern(a.s(n.s0)) : -1);
+      }
+      case K_SHOW_METRICS: {
+        std::vector<BField> f{{"Metric", TY_VARCHAR, true},
+                              {"Value", TY_VARCHAR, true}};
+        return b.add(P_SHOW_METRICS, mk_fields(f), a.has_s(n.s0) ? 1 : 0, 0,
                      0.0, a.has_s(n.s0) ? b.intern(a.s(n.s0)) : -1);
       }
       case K_ANALYZE_TABLE: {

@@ -71,6 +71,7 @@ enum Kind : int32_t {
   K_SHOW_MODELS = 91, K_ANALYZE_TABLE = 92, K_CREATE_MODEL = 93,
   K_DROP_MODEL = 94, K_DESCRIBE_MODEL = 95, K_EXPORT_MODEL = 96,
   K_CREATE_EXPERIMENT = 97, K_KWARGS = 98, K_KV = 99, K_KWLIST = 100,
+  K_SHOW_METRICS = 101,
 };
 
 // statement flag bits
@@ -555,8 +556,14 @@ class Parser {
         schema = b_.intern(parse_identifier());
       return b_.add(K_SHOW_MODELS, {}, 0, 0, 0.0, schema);
     }
+    if (accept_keyword("METRICS")) {
+      int32_t like = -1;
+      if (accept_keyword("LIKE")) like = b_.intern(next().value);
+      return b_.add(K_SHOW_METRICS, {}, 0, 0, 0.0, like);
+    }
     throw ParseErr{peek().pos,
-                   "Expected SCHEMAS, TABLES, COLUMNS or MODELS after SHOW"};
+                   "Expected SCHEMAS, TABLES, COLUMNS, MODELS or METRICS "
+                   "after SHOW"};
   }
 
   int32_t parse_alter() {

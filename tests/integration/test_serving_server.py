@@ -1,0 +1,171 @@
+"""Serving runtime through the Presto server: admission rejection with
+retry-after, deadlines, cooperative cancel, /v1/metrics counters, and
+SHOW METRICS over the wire."""
+import json
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pandas as pd
+import pytest
+
+
+def _post(port, sql, headers=None):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/statement", data=sql.encode(),
+        method="POST")
+    for k, v in (headers or {}).items():
+        req.add_header(k, v)
+    try:
+        with urllib.request.urlopen(req) as resp:
+            return resp.status, json.loads(resp.read()), dict(resp.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read()), dict(e.headers)
+
+
+def _follow(port, payload, timeout=60):
+    deadline = time.time() + timeout
+    while "nextUri" in payload and time.time() < deadline:
+        time.sleep(0.05)
+        with urllib.request.urlopen(payload["nextUri"]) as resp:
+            payload = json.loads(resp.read())
+    return payload
+
+
+def _metrics(port):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}/v1/metrics") as resp:
+        return json.loads(resp.read())
+
+
+@pytest.fixture
+def server(c):
+    from dask_sql_tpu.server.app import run_server
+
+    srv = run_server(context=c, host="127.0.0.1", port=0, blocking=False)
+    yield srv
+    srv.shutdown()
+
+
+@pytest.fixture
+def tiny_server():
+    """1 worker, interactive queue bound 1 — trivially saturated."""
+    from dask_sql_tpu import Context
+    from dask_sql_tpu.server.app import run_server
+
+    c = Context()
+    c.create_table("sleepy", pd.DataFrame({"a": np.arange(4, dtype=np.int64)}))
+
+    def slow(row):
+        time.sleep(0.3)
+        return int(row["x"])
+
+    c.register_function(slow, "slowid", [("x", np.int64)], np.int64,
+                        row_udf=True)
+    with c.config.set({"serving.workers": 1,
+                       "serving.queue.interactive": 1,
+                       "serving.retry_after_s": 2.0}):
+        srv = run_server(context=c, host="127.0.0.1", port=0, blocking=False)
+    yield srv
+    srv.shutdown()
+
+
+def test_rejection_past_queue_bound(tiny_server):
+    port = tiny_server.port
+    sqls = [f"SELECT slowid(a) + {i} AS v FROM sleepy" for i in range(3)]
+    st1, p1, _ = _post(port, sqls[0])  # occupies the single worker
+    deadline = time.time() + 10  # wait until it RUNS so the queue is empty
+    while time.time() < deadline and _metrics(port)["running"] < 1:
+        time.sleep(0.02)
+    st2, p2, _ = _post(port, sqls[1])  # fills the queue (bound 1)
+    assert st1 == 200 and st2 == 200
+    st3, p3, h3 = _post(port, sqls[2])  # must shed, not queue unboundedly
+    assert st3 == 429
+    assert p3["error"]["errorName"] == "QUERY_QUEUE_FULL"
+    assert p3["error"]["errorType"] == "INSUFFICIENT_RESOURCES"
+    assert p3["error"]["retryAfterSeconds"] > 0
+    assert int(h3["Retry-After"]) >= 1
+    # the admitted queries still complete
+    assert _follow(port, p1)["stats"]["state"] == "FINISHED"
+    assert _follow(port, p2)["stats"]["state"] == "FINISHED"
+    m = _metrics(port)
+    assert m["rejected"] == 1
+    assert m["completed"] == 2
+    assert m["registry"]["counters"]["serving.rejected"] == 1
+
+
+def test_deadline_header_cancels(tiny_server):
+    port = tiny_server.port
+    st, p, _ = _post(port, "SELECT slowid(a) AS v FROM sleepy",
+                     headers={"X-Dsql-Deadline-Ms": "1"})
+    assert st == 200
+    payload = _follow(port, p)
+    assert "error" in payload
+    assert payload["error"]["errorName"] in ("EXCEEDED_TIME_LIMIT",
+                                             "DeadlineExceededError")
+
+
+def test_cancel_endpoint_cooperative(tiny_server):
+    port = tiny_server.port
+    st, p, _ = _post(port, "SELECT slowid(a) * 7 AS v FROM sleepy")
+    qid = p["id"]
+    time.sleep(0.1)
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/cancel/{qid}", method="DELETE")
+    with urllib.request.urlopen(req) as resp:
+        assert json.loads(resp.read())["cancelled"] is True
+    payload = _follow(port, p)
+    assert "error" in payload
+
+
+def test_concurrent_queries_update_metrics(server):
+    import concurrent.futures
+
+    port = server.port
+    before = _metrics(server.port)
+
+    def run(i):
+        payload = _follow(port, _post(
+            port, f"SELECT {i} * a AS v FROM df_simple ORDER BY v")[1])
+        assert payload["stats"]["state"] == "FINISHED", payload
+        return [row[0] for row in payload["data"]]
+
+    with concurrent.futures.ThreadPoolExecutor(max_workers=8) as pool:
+        results = list(pool.map(run, range(1, 9)))
+    for i, vals in enumerate(results, start=1):
+        assert vals == [i * 1, i * 2, i * 3]
+    m = _metrics(port)
+    assert m["completed"] >= before["completed"] + 8
+    assert m["queueDepth"] == 0 and m["running"] == 0
+    reg = m["registry"]["counters"]
+    assert reg["serving.admitted"] >= 8
+    assert reg["serving.completed"] >= 8
+    assert m["registry"]["histograms"]["serving.latency_ms"]["count"] >= 8
+    assert m["serving"]["admission"]["waiting"] == {"interactive": 0,
+                                                    "batch": 0}
+
+
+def test_repeated_query_hits_cache_via_server(server):
+    port = server.port
+    sql = "SELECT a + 41 AS v FROM df_simple"
+    r1 = _follow(port, _post(port, sql)[1])
+    r2 = _follow(port, _post(port, sql)[1])
+    assert r1["data"] == r2["data"]
+    hits = int(_metrics(port)["resultCache"]["hits"])
+    assert hits >= 1
+    # the counter is also visible through SQL, per the acceptance criteria
+    p = _follow(port, _post(port, "SHOW METRICS")[1])
+    rows = {row[0]: row[1] for row in p["data"]}
+    assert int(rows["query.cache.hit"]) >= 1
+    # server-attached runtime state shows up too
+    assert any(k.startswith("serving.runtime.") for k in rows)
+
+
+def test_batch_class_header(server):
+    port = server.port
+    st, p, _ = _post(port, "SELECT 1 + 1 AS x",
+                     headers={"X-Dsql-Class": "batch"})
+    assert st == 200
+    assert _follow(port, p)["data"][0][0] == 2
+    reg = _metrics(port)["registry"]["counters"]
+    assert reg.get("serving.admitted.batch", 0) >= 1

@@ -1,0 +1,441 @@
+"""Serving subsystem: result cache, admission control, metrics registry,
+SHOW METRICS, and executor cancellation checkpoints."""
+import threading
+import time
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from dask_sql_tpu import Context
+from dask_sql_tpu.serving import (
+    DeadlineExceededError,
+    Histogram,
+    MetricsRegistry,
+    QueryCancelledError,
+    QueryTicket,
+    QueueFullError,
+    ResultCache,
+    ServingRuntime,
+)
+
+
+# --------------------------------------------------------------- metrics
+def test_histogram_percentiles():
+    h = Histogram()
+    for v in range(1, 101):
+        h.observe(float(v))
+    snap = h.snapshot()
+    assert snap["count"] == 100
+    assert snap["max"] == 100.0
+    assert 45 <= snap["p50"] <= 55
+    assert 90 <= snap["p95"] <= 100
+    assert snap["avg"] == pytest.approx(50.5)
+
+
+def test_registry_counters_and_rows():
+    m = MetricsRegistry()
+    m.inc("a.b", 2)
+    m.observe("lat_ms", 5.0)
+    m.gauge("depth", 3)
+    snap = m.snapshot()
+    assert snap["counters"]["a.b"] == 2
+    assert snap["gauges"]["depth"] == 3
+    rows = dict(m.rows())
+    assert rows["a.b"] == "2"
+    assert "lat_ms.p99" in rows
+
+
+def test_registry_trace_aggregation():
+    from dask_sql_tpu.tracing import NodeTrace, Tracer
+
+    m = MetricsRegistry()
+    root = NodeTrace("Projection", "Projection: x", 2.0, 10,
+                     [NodeTrace("TableScan", "TableScan: t", 1.0, 10)])
+    m.observe_trace(root)
+    snap = m.snapshot()
+    assert snap["histograms"]["executor.node.Projection.ms"]["count"] == 1
+    assert snap["counters"]["executor.node.TableScan.rows"] == 10
+    # Tracer.publish is the executor-side entry to the same aggregation
+    t = Tracer()
+    t.root = root
+    t.publish(m)
+    assert m.snapshot()["histograms"]["executor.node.Projection.ms"]["count"] == 2
+
+
+# ----------------------------------------------------------- result cache
+def test_result_cache_lru_by_bytes():
+    c = ResultCache(max_bytes=100, max_entry_bytes=100, ttl_s=None)
+    c.put("a", "va", nbytes=40)
+    c.put("b", "vb", nbytes=40)
+    assert c.get("a") == "va"  # bumps a to MRU
+    c.put("c", "vc", nbytes=40)  # evicts b (LRU), not a
+    assert c.get("b") is None
+    assert c.get("a") == "va"
+    assert c.get("c") == "vc"
+    assert c.stats.evictions == 1
+    assert c.stats.bytes <= 100
+
+
+def test_result_cache_per_entry_cap():
+    c = ResultCache(max_bytes=1000, max_entry_bytes=50, ttl_s=None)
+    assert not c.put("big", "x", nbytes=51)
+    assert c.get("big") is None
+    assert c.stats.oversize_rejects == 1
+    assert c.put("ok", "y", nbytes=50)
+
+
+def test_result_cache_ttl():
+    now = [0.0]
+    c = ResultCache(max_bytes=100, max_entry_bytes=100, ttl_s=10.0,
+                    clock=lambda: now[0])
+    c.put("k", "v", nbytes=1)
+    now[0] = 5.0
+    assert c.get("k") == "v"
+    now[0] = 16.0
+    assert c.get("k") is None  # expired
+    assert c.stats.expirations == 1
+
+
+def test_result_cache_replace_accounting():
+    c = ResultCache(max_bytes=100, max_entry_bytes=100, ttl_s=None)
+    c.put("k", "v1", nbytes=30)
+    c.put("k", "v2", nbytes=60)
+    assert c.stats.bytes == 60 and c.stats.entries == 1
+    assert c.get("k") == "v2"
+
+
+def test_table_nbytes_counts_buffers():
+    from dask_sql_tpu.columnar.table import Table
+    from dask_sql_tpu.serving.cache import table_nbytes
+
+    t = Table.from_pandas(pd.DataFrame({
+        "i": np.arange(10, dtype=np.int64),
+        "s": ["abc"] * 10,
+    }))
+    n = table_nbytes(t)
+    assert n >= 10 * 8  # at least the int64 buffer
+
+
+# ------------------------------------------- context-level result caching
+def _ctx():
+    c = Context()
+    c.create_table("t", pd.DataFrame({"a": [1, 2, 3], "b": [1.5, 2.5, 3.5]}))
+    return c
+
+
+def test_repeated_query_hits_result_cache():
+    c = _ctx()
+    q = "SELECT SUM(a) AS s FROM t"
+    r1 = c.sql(q, return_futures=False)
+    assert c.metrics.counter("query.cache.hit") == 0
+    r2 = c.sql(q, return_futures=False)
+    assert c.metrics.counter("query.cache.hit") == 1
+    assert int(r1["s"][0]) == int(r2["s"][0]) == 6
+
+
+def test_ddl_invalidates_result_cache():
+    c = _ctx()
+    q = "SELECT SUM(a) AS s FROM t"
+    assert int(c.sql(q, return_futures=False)["s"][0]) == 6
+    c.create_table("t", pd.DataFrame({"a": [10, 20]}))  # replace = DDL
+    assert int(c.sql(q, return_futures=False)["s"][0]) == 30
+    # the replacement must NOT have been served from cache
+    assert c.metrics.counter("query.cache.hit") == 0
+
+
+def test_sql_ddl_invalidates_result_cache():
+    c = _ctx()
+    c.sql("CREATE VIEW v AS SELECT a FROM t")
+    r1 = c.sql("SELECT SUM(a) AS s FROM v", return_futures=False)
+    assert int(r1["s"][0]) == 6
+    c.sql("DROP VIEW v")
+    c.sql("CREATE VIEW v AS SELECT b FROM t")
+    r2 = c.sql("SELECT SUM(b) AS s FROM v", return_futures=False)
+    assert float(r2["s"][0]) == pytest.approx(7.5)
+
+
+def test_config_options_partition_result_cache():
+    c = _ctx()
+    q = "SELECT SUM(a) AS s FROM t"
+    c.sql(q, return_futures=False)
+    c.sql(q, config_options={"sql.compile": False}, return_futures=False)
+    # different config -> different key -> no hit
+    assert c.metrics.counter("query.cache.hit") == 0
+    c.sql(q, config_options={"sql.compile": False}, return_futures=False)
+    assert c.metrics.counter("query.cache.hit") == 1
+
+
+def test_result_cache_distinguishes_sort_null_order():
+    c = Context()
+    c.create_table("sn", pd.DataFrame({"a": [1.0, None, 3.0, None, 2.0]}))
+    r1 = c.sql("SELECT * FROM sn ORDER BY a", return_futures=False)
+    r2 = c.sql("SELECT * FROM sn ORDER BY a NULLS FIRST", return_futures=False)
+    assert list(r1["a"].fillna(-1)) == [1.0, 2.0, 3.0, -1, -1]
+    assert list(r2["a"].fillna(-1)) == [-1, -1, 1.0, 2.0, 3.0]
+
+
+def test_result_cache_disabled_by_config():
+    c = _ctx()
+    q = "SELECT SUM(a) AS s FROM t"
+    with c.config.set({"serving.cache.enabled": False}):
+        c.sql(q, return_futures=False)
+        c.sql(q, return_futures=False)
+    assert c.metrics.counter("query.cache.hit") == 0
+
+
+def test_volatile_functions_never_cached():
+    c = _ctx()
+    for q in ("SELECT RAND() AS r FROM t",
+              "SELECT CURRENT_TIMESTAMP AS ts FROM t",
+              # volatile call hiding inside a subquery plan
+              "SELECT a FROM t WHERE a > (SELECT RAND() FROM t LIMIT 1)"):
+        c.sql(q, return_futures=False)
+        c.sql(q, return_futures=False)
+    assert c.metrics.counter("query.cache.hit") == 0
+
+
+def test_udf_queries_never_cached():
+    c = _ctx()
+    calls = []
+
+    def sample(x):
+        calls.append(1)
+        return x
+
+    c.register_function(sample, "sample_udf", [("x", np.int64)], np.int64)
+    q = "SELECT sample_udf(a) AS v FROM t"
+    r1 = c.sql(q, return_futures=False)
+    r2 = c.sql(q, return_futures=False)
+    assert list(r1["v"]) == list(r2["v"])
+    assert c.metrics.counter("query.cache.hit") == 0
+    assert len(calls) == 2  # really re-executed
+
+
+def test_ddl_frees_cache_bytes():
+    c = _ctx()
+    q = "SELECT SUM(a) AS s FROM t"
+    c.sql(q, return_futures=False)
+    assert c._result_cache.stats.entries == 1
+    c.create_table("t2", pd.DataFrame({"z": [1]}))  # any DDL
+    # unreachable entries are reclaimed eagerly, not just unreferenced
+    assert c._result_cache.stats.entries == 0
+    assert c._result_cache.stats.bytes == 0
+
+
+# ---------------------------------------------------------- SHOW METRICS
+def test_show_metrics_statement():
+    c = _ctx()
+    q = "SELECT SUM(a) AS s FROM t"
+    c.sql(q, return_futures=False)
+    c.sql(q, return_futures=False)
+    df = c.sql("SHOW METRICS", return_futures=False)
+    assert list(df.columns) == ["Metric", "Value"]
+    rows = dict(zip(df["Metric"], df["Value"]))
+    assert rows["query.cache.hit"] == "1"
+    assert "result_cache.bytes" in rows
+    assert "plan_cache.entries" in rows
+
+
+def test_show_metrics_like_filter():
+    c = _ctx()
+    df = c.sql("SHOW METRICS LIKE 'result_cache'", return_futures=False)
+    assert len(df) > 0
+    assert all(m.startswith("result_cache") for m in df["Metric"])
+    # % switches to real SQL LIKE semantics
+    df = c.sql("SHOW METRICS LIKE 'result_cache.%'", return_futures=False)
+    assert len(df) > 0
+    assert all(m.startswith("result_cache.") for m in df["Metric"])
+    assert len(c.sql("SHOW METRICS LIKE 'nope.%'", return_futures=False)) == 0
+
+
+# ------------------------------------------------------------- admission
+def test_queue_full_rejection():
+    rt = ServingRuntime(workers=1, bounds={"interactive": 1, "batch": 1})
+    try:
+        gate = threading.Event()
+        started = threading.Event()
+
+        def blocker(t):
+            started.set()
+            return gate.wait(10)
+
+        _, f1, _ = rt.submit(blocker)
+        assert started.wait(10)  # f1 occupies the worker, queue is empty
+        _, f2, _ = rt.submit(lambda t: "queued")
+        with pytest.raises(QueueFullError) as ei:
+            rt.submit(lambda t: "shed")
+        assert ei.value.retry_after_s > 0
+        assert ei.value.priority_class == "interactive"
+        gate.set()
+        assert f2.result(10) == "queued"
+        assert rt.metrics.counter("serving.rejected") == 1
+        assert rt.metrics.counter("serving.admitted") == 2
+    finally:
+        rt.shutdown()
+
+
+def test_interactive_scheduled_before_batch():
+    rt = ServingRuntime(workers=1, bounds={"interactive": 8, "batch": 8})
+    try:
+        order = []
+        gate = threading.Event()
+        _, f0, _ = rt.submit(lambda t: gate.wait(10))  # occupy the worker
+        _, fb, _ = rt.submit(lambda t: order.append("batch"),
+                             priority_class="batch")
+        _, fi, _ = rt.submit(lambda t: order.append("interactive"))
+        gate.set()
+        fb.result(10), fi.result(10)
+        assert order == ["interactive", "batch"]
+    finally:
+        rt.shutdown()
+
+
+def test_batch_running_cap_enforced():
+    rt = ServingRuntime(workers=2, bounds={"interactive": 8, "batch": 8},
+                        batch_max_running=1)
+    try:
+        gate = threading.Event()
+        started = []
+
+        def blocker(name):
+            def fn(t):
+                started.append(name)
+                gate.wait(10)
+                return name
+            return fn
+
+        _, f1, _ = rt.submit(blocker("b1"), priority_class="batch")
+        _, f2, _ = rt.submit(blocker("b2"), priority_class="batch")
+        time.sleep(0.3)
+        assert started == ["b1"]  # cap 1: the burst must not overshoot
+        _, fi, _ = rt.submit(lambda t: "i1")  # capped worker stays free
+        assert fi.result(10) == "i1"
+        gate.set()
+        assert f1.result(10) == "b1" and f2.result(10) == "b2"
+    finally:
+        rt.shutdown()
+
+
+def test_batch_paused_sheds_instead_of_stranding():
+    rt = ServingRuntime(workers=2, bounds={"interactive": 8, "batch": 8},
+                        batch_max_running=0)
+    try:
+        with pytest.raises(QueueFullError):
+            rt.submit(lambda t: "never", priority_class="batch")
+        # interactive traffic unaffected
+        _, f, _ = rt.submit(lambda t: "ok")
+        assert f.result(10) == "ok"
+    finally:
+        rt.shutdown()
+
+
+def test_unknown_class_defaults_to_interactive():
+    rt = ServingRuntime(workers=1)
+    try:
+        _, f, ticket = rt.submit(lambda t: "done", priority_class="realtime")
+        assert ticket.priority_class == "interactive"
+        assert f.result(10) == "done"
+    finally:
+        rt.shutdown()
+
+
+def test_deadline_cancels_at_checkpoint():
+    rt = ServingRuntime(workers=1)
+    try:
+        def ticking(t):
+            for _ in range(200):
+                time.sleep(0.01)
+                t.checkpoint()
+            return "never"
+
+        _, f, _ = rt.submit(ticking, deadline_s=0.1)
+        with pytest.raises(DeadlineExceededError):
+            f.result(10)
+        assert rt.metrics.counter("serving.timeouts") == 1
+    finally:
+        rt.shutdown()
+
+
+def test_cooperative_cancel_mid_run():
+    rt = ServingRuntime(workers=1)
+    try:
+        started = threading.Event()
+
+        def spin(t):
+            started.set()
+            while True:
+                time.sleep(0.01)
+                t.checkpoint()
+
+        _, f, ticket = rt.submit(spin)
+        assert started.wait(10)
+        ticket.cancel()
+        with pytest.raises(QueryCancelledError):
+            f.result(10)
+        assert rt.metrics.counter("serving.cancelled") == 1
+    finally:
+        rt.shutdown()
+
+
+def test_expired_while_queued():
+    rt = ServingRuntime(workers=1)
+    try:
+        gate = threading.Event()
+        _, f1, _ = rt.submit(lambda t: gate.wait(10))
+        _, f2, _ = rt.submit(lambda t: "x", deadline_s=0.05)
+        time.sleep(0.2)
+        gate.set()
+        with pytest.raises(DeadlineExceededError):
+            f2.result(10)
+    finally:
+        rt.shutdown()
+
+
+def test_deadline_cancels_executor_mid_plan():
+    """The executor's per-node checkpoints observe the serving ticket."""
+    from dask_sql_tpu.serving import runtime as rt_mod
+
+    c = _ctx()
+    ticket = QueryTicket("q1", deadline=time.monotonic() - 1.0)  # already past
+    rt_mod._tls.ticket = ticket
+    try:
+        with pytest.raises(DeadlineExceededError):
+            c.sql("SELECT SUM(a) AS s FROM t ORDER BY s", return_futures=False)
+    finally:
+        rt_mod._tls.ticket = None
+
+
+# ------------------------------------------------- satellite: take_with_nulls
+def test_take_with_nulls_debug_assertion():
+    import jax.numpy as jnp
+
+    from dask_sql_tpu import config as config_module
+    from dask_sql_tpu.columnar.column import Column
+    from dask_sql_tpu.ops.join import take_with_nulls
+
+    col = Column.from_numpy(np.arange(4, dtype=np.int64))
+    bad = jnp.array([0, -1, 2], dtype=jnp.int64)
+    with config_module.set({"sql.debug.validate_take": True}):
+        with pytest.raises(AssertionError):
+            take_with_nulls(col, bad, may_pad=False)
+        out = take_with_nulls(col, bad, may_pad=True)  # contract respected
+        assert not bool(out.valid_mask()[1])
+    # flag off: trust-based fast path unchanged (no device sync)
+    out = take_with_nulls(col, jnp.array([0, 1], dtype=jnp.int64), may_pad=False)
+    assert out.validity is None
+
+
+# --------------------------------------- satellite: padded radix key bounds
+def test_padded_int_bounds_masks_pad_rows():
+    import jax.numpy as jnp
+
+    from dask_sql_tpu.physical.compiled import padded_int_bounds
+
+    # logical rows [100, 105, 103], pad rows are zero-filled
+    data = jnp.array([100, 105, 103, 0, 0], dtype=jnp.int64)
+    row_valid = jnp.array([True, True, True, False, False])
+    lo, hi = padded_int_bounds(data, row_valid)
+    assert int(lo) == 100 and int(hi) == 105  # pad zeros must not widen
+    lo2, hi2 = padded_int_bounds(data, None)
+    assert int(lo2) == 0  # unpadded: plain min/max
