@@ -120,7 +120,63 @@ def bench_q3_line(backend: str):
     }), flush=True)
 
 
+def run_inject_smoke():
+    """`bench.py --inject`: deterministic fault-injection smoke.
+
+    Proves on real hardware (or CPU) that a forced compile failure and a
+    forced device-OOM each complete the benchmark query via a lower ladder
+    rung with the SAME result as the clean run, and prints one JSON line
+    with the degradation counters.  Small and seed-pinned so CI can run it
+    on every change without slowing the normal bench path.
+    """
+    import jax
+
+    _ensure_backend()
+
+    from dask_sql_tpu import Context
+    from dask_sql_tpu import config as config_module
+    from dask_sql_tpu.resilience import faults
+
+    df = gen_lineitem(100_000, seed=0)
+    c = Context()
+    c.config.update({"serving.cache.enabled": False})
+    c.create_table("lineitem", df)
+    clean = c.sql(QUERY, return_futures=False)
+
+    degradations = {}
+    ok = True
+    for spec in ("compile:always", "oom:once"):
+        faults.reset()
+        ctx = Context()
+        ctx.config.update({"serving.cache.enabled": False})
+        ctx.create_table("lineitem", df)
+        with config_module.set({"resilience.inject": spec,
+                                "resilience.inject.seed": 0}):
+            hurt = ctx.sql(QUERY, return_futures=False)
+        degraded = ctx.metrics.counter("resilience.degraded")
+        degradations[spec] = degraded
+        same = (len(hurt) == len(clean) and np.allclose(
+            hurt["sum_qty"].to_numpy(np.float64),
+            clean["sum_qty"].to_numpy(np.float64), rtol=1e-9))
+        ok = ok and same and degraded >= 1
+    faults.reset()
+    print(json.dumps({
+        "metric": "fault_injection_smoke",
+        "backend": jax.default_backend(),
+        "ok": bool(ok),
+        "degradations": degradations,
+    }), flush=True)
+    if not ok:
+        raise SystemExit(1)
+
+
 def main():
+    import sys
+
+    if "--inject" in sys.argv:
+        run_inject_smoke()
+        return
+
     import jax
 
     _ensure_backend()

@@ -170,6 +170,12 @@ def save_state(context: "Context", location: str) -> dict:
 
     with open(os.path.join(snap_dir, "manifest.json"), "w") as f:
         json.dump(manifest, f, indent=1)
+    # fault-injection site (resilience/faults.py): a crash HERE — snapshot
+    # fully written but CURRENT not yet repointed — must leave the previous
+    # snapshot live and loadable (tests/unit/test_checkpoint.py proves it)
+    from .resilience import faults
+
+    faults.maybe_inject("checkpoint", context.config)
     # atomic publish: CURRENT flips only after the snapshot is complete
     tmp = os.path.join(location, f".CURRENT.tmp.{os.getpid()}")
     with open(tmp, "w") as f:

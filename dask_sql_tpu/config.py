@@ -64,6 +64,20 @@ DEFAULTS: Dict[str, Any] = {
     "serving.cache.max_entry_bytes": 64 << 20,  # per-entry cap (huge results bypass the cache)
     "serving.cache.ttl_s": 300.0,  # entry time-to-live, seconds (None = no TTL)
     "serving.metrics.node_traces": False,  # per-plan-node tracing folded into the registry
+    # Resilient execution (resilience/) — error taxonomy, degradation ladder,
+    # retry/backoff, circuit breaker, fault injection.  docs/resilience.md.
+    "resilience.ladder.enabled": True,  # degradable failures step down a rung instead of failing
+    "resilience.ladder.cpu_fallback": True,  # last rung: re-execute the plan on the CPU backend
+    "resilience.retry.max_attempts": 3,  # total tries per query at the serving worker (1 = no retry)
+    "resilience.retry.base_s": 0.05,  # first backoff delay, seconds
+    "resilience.retry.multiplier": 2.0,  # exponential backoff factor
+    "resilience.retry.max_s": 2.0,  # backoff ceiling, seconds
+    "resilience.retry.jitter": 0.5,  # +-fraction of jitter on each delay
+    "resilience.breaker.enabled": True,  # per-plan-fingerprint circuit breaker on ladder rungs
+    "resilience.breaker.threshold": 3,  # consecutive failures before a rung is skipped
+    "resilience.breaker.cooldown_s": 30.0,  # seconds before a half-open trial is admitted
+    "resilience.inject": None,  # fault-injection spec, e.g. "compile:0.5,oom:once" (tests only)
+    "resilience.inject.seed": 0,  # PRNG seed for probabilistic fault modes
 }
 
 

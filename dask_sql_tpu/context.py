@@ -91,7 +91,13 @@ class TpuFrame:
                                             False))
                 executor = Executor(ctx, trace=trace)
                 t0 = time.perf_counter()
-                self._result = executor.execute_root(self._plan)
+                # executor boundary: every failure leaves here as a taxonomy
+                # QueryError (code/retryable/degradable), never a raw
+                # device traceback (resilience/errors.py)
+                from .resilience.ladder import wrap_boundary
+
+                self._result = wrap_boundary(
+                    lambda: executor.execute_root(self._plan))
                 ctx.metrics.observe(
                     "query.execute_ms", (time.perf_counter() - t0) * 1000.0)
                 ctx.metrics.inc("query.executed")
@@ -176,6 +182,12 @@ class Context:
         #: the ServingRuntime when a server front-end attached one (so
         #: SHOW METRICS can surface admission/queue state)
         self.serving = None
+        from .resilience.retry import CircuitBreaker
+
+        #: per-(plan fingerprint, ladder rung) circuit breaker: a query
+        #: shape that repeatedly kills a compiled rung skips straight to
+        #: its known-good rung (resilience/ladder.py consults this)
+        self.breaker = CircuitBreaker.from_config(self.config)
         logging.basicConfig(level=logging_level)
 
     _PLAN_CACHE_CAP = 128

@@ -41,6 +41,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..columnar.column import Column
 from ..columnar.dtypes import SqlType, STRING_TYPES, sql_to_np
+from ..resilience.errors import ResourceExhaustedError
 from .bootstrap import host_read
 from .mesh import AXIS, default_mesh, pad_to_multiple, row_sharding
 
@@ -54,7 +55,11 @@ I64_MAX = np.iinfo(np.int64).max
 GROUP_CAPACITY_LADDER = (1024, 16384, 262144, 1 << 22)
 PEER_CAPACITY_LADDER = (2048, 16384, 131072, 1 << 20, 1 << 23)
 
-#: test/observability hooks: counts of kernel executions this process
+#: test/observability hooks: counts of kernel executions this process.
+#: Fallback/degradation events are NOT counted here anymore — they go to the
+#: per-context MetricsRegistry as ``resilience.fallback.*`` so SHOW METRICS
+#: and /v1/metrics see them (the old ad-hoc "agg_fallback" key is retained
+#: at 0 for callers that snapshot the dict).
 STATS = {"agg_kernel": 0, "join_kernel": 0, "agg_fallback": 0,
          "broadcast_join": 0, "broadcast_join_sorted": 0,
          "sharded_join_agg": 0, "sort_kernel": 0}
@@ -69,7 +74,11 @@ def array_is_sharded(arr) -> bool:
         return False
     try:
         return len(sh.device_set) > 1 and not sh.is_fully_replicated
-    except Exception:
+    except Exception as e:  # deleted buffer / backend teardown mid-query
+        # treated as unsharded (single-program path still computes the right
+        # answer) — but say so instead of silently swallowing the probe
+        logger.debug("sharding probe failed on %r: %s; treating as "
+                     "unsharded", type(arr).__name__, e)
         return False
 
 
@@ -560,7 +569,8 @@ def _ladder_next_or_none(ladder, v):
     """Next rung, or None at the top (caller falls back instead of dying)."""
     try:
         return _ladder_next(ladder, v)
-    except Exception:
+    except ResourceExhaustedError as e:
+        logger.debug("capacity ladder topped out at %d: %s", v, e)
         return None
 
 
@@ -649,11 +659,22 @@ def _encode_payload(col: Column):
 
 
 def dist_sort_table(mesh: Mesh, table, sort_cols: List[Column],
-                    ascendings: List[bool], nulls_firsts: List[bool]):
+                    ascendings: List[bool], nulls_firsts: List[bool],
+                    metrics=None):
     """Sort a mesh-sharded Table globally; output stays row-sharded.
 
     Sample-based splitters + the two-exchange kernel above.  Returns the
-    sorted Table (device order IS the sort order) or None when ineligible."""
+    sorted Table (device order IS the sort order) or None when ineligible
+    or when the capacity ladder tops out (recorded in `metrics` as a
+    ``resilience.fallback`` so the step-down is observable)."""
+
+    def _fallback(why: str):
+        logger.debug("dist sort falling back to single-program path: %s", why)
+        if metrics is not None:
+            metrics.inc("resilience.fallback")
+            metrics.inc("resilience.fallback.dist_sort")
+        return None
+
     n = table.num_rows
     ndev = mesh.devices.size
     if n == 0 or ndev <= 1:
@@ -715,17 +736,17 @@ def dist_sort_table(mesh: Mesh, table, sort_cols: List[Column],
         if bool(host_read(of1).any()):
             cpeer = _ladder_next_or_none(PEER_CAPACITY_LADDER, cpeer)
             if cpeer is None:
-                return None  # fall back to the single-program sort
+                return _fallback("exchange-1 capacity ladder exhausted")
             grew = True
         if bool(host_read(of2).any()):
             cpeer2 = _ladder_next_or_none(PEER_CAPACITY_LADDER, cpeer2)
             if cpeer2 is None:
-                return None
+                return _fallback("exchange-2 capacity ladder exhausted")
             grew = True
         if not grew:
             break
     else:
-        return None  # pathological skew: keep the single-program sort
+        return _fallback("pathological skew: retries exhausted")
 
     # out [nc, ndev, rows_out] sharded on the device axis; flatten to global
     # row order and slice the padding off (stays sharded, like shard_table)
@@ -861,7 +882,8 @@ def dist_inner_pairs(mesh: Mesh, lgid: jnp.ndarray, lvalid: jnp.ndarray,
         cpeer = _ladder_next(PEER_CAPACITY_LADDER, cpeer)
         out_cap = _ladder_next(PEER_CAPACITY_LADDER, out_cap)
     else:
-        raise RuntimeError("distributed join exceeded capacity ladder")
+        raise ResourceExhaustedError(
+            "distributed join exceeded capacity ladder")
 
     ov = np.asarray(ovalid).reshape(-1)
     li_h = np.asarray(li).reshape(-1)[ov]
@@ -885,7 +907,10 @@ def _ladder_next(ladder, cur):
     for v in ladder:
         if v > cur:
             return v
-    raise RuntimeError("capacity ladder exhausted")
+    # taxonomy-degradable: the resilience ladder (resilience/ladder.py)
+    # catches this and steps the query down to the single-program path
+    raise ResourceExhaustedError(
+        f"capacity ladder exhausted at {cur} (top {ladder[-1]})")
 
 
 # ---------------------------------------------------------------------------
@@ -912,7 +937,10 @@ def try_dist_aggregate(rel, executor, inp) -> Optional[object]:
         return None  # global aggregates reduce fine under GSPMD psum
     for agg in rel.agg_exprs:
         if agg.func not in _DECOMPOSABLE or agg.distinct:
-            STATS["agg_fallback"] += 1
+            logger.debug("dist aggregate declining %s%s: single-program "
+                         "path", agg.func, " DISTINCT" if agg.distinct else "")
+            executor.context.metrics.inc("resilience.fallback")
+            executor.context.metrics.inc("resilience.fallback.dist_aggregate")
             return None
 
     group_cols = [executor.eval_expr(e, inp) for e in rel.group_exprs]
@@ -1004,7 +1032,8 @@ def try_dist_aggregate(rel, executor, inp) -> Optional[object]:
             break
         cap = _ladder_next(GROUP_CAPACITY_LADDER, cap)
     else:
-        raise RuntimeError("distributed aggregate exceeded capacity ladder")
+        raise ResourceExhaustedError(
+            "distributed aggregate exceeded capacity ladder")
 
     # host finalize: concat per-device owned tables (keys are disjoint);
     # host_read all-gathers first when the mesh spans processes

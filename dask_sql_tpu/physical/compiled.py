@@ -955,6 +955,9 @@ def try_compiled_aggregate(rel: p.Aggregate, executor) -> Optional[Table]:
             _cache.move_to_end(key)
             compiled.table = table
         try:
+            from ..resilience import faults
+
+            faults.maybe_inject("oom", executor.config)
             return compiled.run()
         finally:
             compiled.table = None

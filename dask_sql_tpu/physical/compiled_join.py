@@ -632,6 +632,9 @@ def try_compiled_join_aggregate(rel: p.Aggregate, executor) -> Optional[Table]:
             compiled.probe_table = probe_table
             compiled.build_tables = build_tables
         try:
+            from ..resilience import faults
+
+            faults.maybe_inject("oom", executor.config)
             return compiled.run()
         finally:
             # the LUTs/dictionaries stay warm; the (large) table refs do not

@@ -42,15 +42,37 @@ class Executor:
     def execute_root(self, rel: LogicalPlan) -> Table:
         """Entry for the plan ROOT: the result goes straight to the host, so
         root select chains compile to one kernel + one packed transfer
-        (physical/compiled_select.py) before the recursive converter runs."""
+        (physical/compiled_select.py) before the recursive converter runs.
+
+        Resilience (resilience/ladder.py): the compiled fast path is a
+        degradation-ladder rung — a compile failure or device OOM inside it
+        steps down to the interpreted walk (recorded in the metrics registry
+        and gated by the per-plan circuit breaker) instead of failing the
+        query; the interpreted walk itself carries one CPU-backend rung
+        under it.  The `execute` fault-injection site fires here so the
+        ServingRuntime's retry/backoff path is testable end to end."""
+        from ..resilience import faults, ladder
         from .compiled_select import try_compiled_select
 
         ticket = current_ticket()
         if ticket is not None:  # checkpoint before the one-kernel fast path
             ticket.checkpoint()
+        faults.maybe_inject("execute", self.config)
+        if self.config.get("resilience.ladder.enabled", True):
+            out = ladder.attempt(
+                self, "compiled_select",
+                lambda: try_compiled_select(rel, self),
+                rel=rel, inject_site="compile")
+            if out is not None:
+                return out
+            return ladder.execute_interpreted(self, rel)
+        # ladder disabled: injection sites still fire (a forced compile
+        # fault must propagate here — that is what disabling proves)
+        faults.maybe_inject("compile", self.config)
         out = try_compiled_select(rel, self)
         if out is not None:
             return out
+        faults.maybe_inject("exec_oom", self.config)
         return self.execute(rel)
 
     def execute(self, rel: LogicalPlan) -> Table:

@@ -245,6 +245,9 @@ class ShowMetricsPlugin(BaseRelPlugin):
         rows.extend(_flatten_metrics("result_cache",
                                      ctx._result_cache.snapshot()))
         rows.append(("plan_cache.entries", str(len(ctx._plan_cache))))
+        if getattr(ctx, "breaker", None) is not None:
+            rows.extend(_flatten_metrics("resilience.breaker",
+                                         ctx.breaker.snapshot()))
         if getattr(ctx, "serving", None) is not None:
             rows.extend(_flatten_metrics("serving.runtime",
                                          ctx.serving.snapshot()))

@@ -35,10 +35,21 @@ class AggregatePlugin(BaseRelPlugin):
 
     def convert(self, rel: p.Aggregate, executor) -> Table:
         from ....parallel import dist_plan
+        from ....resilience import ladder
         from ...compiled import try_compiled_aggregate
         from ...streaming import try_streaming_aggregate
 
         from ...compiled_join import try_compiled_join_aggregate
+
+        # Each fast path below is a degradation-ladder rung
+        # (resilience/ladder.py): a rung that *declines* returns None as
+        # before, and a rung that *fails degradably* (compile crash, device
+        # OOM, capacity-ladder exhaustion) now also steps down — recorded as
+        # resilience.degraded.<rung> and circuit-broken per plan fingerprint
+        # — instead of sinking the query.
+        def rung(name, fn, inject=None):
+            return ladder.attempt(executor, name, fn, rel=rel,
+                                  inject_site=inject)
 
         # mesh-sharded inputs: the one-jit join->aggregate pipeline runs
         # SPMD over the sharded probe (GSPMD turns its segment reductions
@@ -50,7 +61,9 @@ class AggregatePlugin(BaseRelPlugin):
         tried_join_pipeline = False
         tried_compiled = False
         if dist_plan.plan_has_sharded_scan(rel.input, executor.context):
-            joined = try_compiled_join_aggregate(rel, executor)
+            joined = rung("compiled_join_aggregate",
+                          lambda: try_compiled_join_aggregate(rel, executor),
+                          inject="compile")
             tried_join_pipeline = True
             if joined is not None:
                 return joined
@@ -58,23 +71,34 @@ class AggregatePlugin(BaseRelPlugin):
             # sharded scan with the filter deferred as a mask — eagerly
             # compacting a sharded table first costs per-column resharding
             # gathers (measured ~1s/query on the Q1 shape, vs ~4ms fused)
-            compiled = try_compiled_aggregate(rel, executor)
+            compiled = rung("compiled_aggregate",
+                            lambda: try_compiled_aggregate(rel, executor),
+                            inject="compile")
             if compiled is not None:
                 return compiled
             tried_compiled = True
             (inp,) = self.assert_inputs(rel, 1, executor)
-            dist = dist_plan.try_dist_aggregate(rel, executor, inp)
+            # sharded -> single-device step-down: the collectives engine
+            # raising ResourceExhaustedError (capacity ladder topped out)
+            # falls through to the single-program path below
+            dist = rung("dist_aggregate",
+                        lambda: dist_plan.try_dist_aggregate(
+                            rel, executor, inp))
             if dist is not None:
                 return dist
         streamed = try_streaming_aggregate(rel, executor)
         if streamed is not None:
             return streamed
         if not tried_join_pipeline:
-            joined = try_compiled_join_aggregate(rel, executor)
+            joined = rung("compiled_join_aggregate",
+                          lambda: try_compiled_join_aggregate(rel, executor),
+                          inject="compile")
             if joined is not None:
                 return joined
         if not tried_compiled:
-            compiled = try_compiled_aggregate(rel, executor)
+            compiled = rung("compiled_aggregate",
+                            lambda: try_compiled_aggregate(rel, executor),
+                            inject="compile")
             if compiled is not None:
                 return compiled
         (inp,) = self.assert_inputs(rel, 1, executor)

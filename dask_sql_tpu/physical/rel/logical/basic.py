@@ -131,14 +131,22 @@ class SortPlugin(BaseRelPlugin):
         # tiny regardless of sharding.
         if rel.fetch is None and cols:
             from ....parallel import dist_plan
+            from ....resilience import ladder
 
             mesh = dist_plan.should_distribute(
                 executor, "sql.distributed.sort", inp)
             if mesh is not None:
-                sorted_t = dist_plan.dist_sort_table(
-                    mesh, inp, cols,
-                    [k.ascending for k in rel.keys],
-                    [k.nulls_first_resolved() for k in rel.keys])
+                # ladder rung: a capacity overflow inside the collectives
+                # sort degrades to the single-program sort below (recorded
+                # as resilience.degraded.dist_sort / resilience.fallback)
+                sorted_t = ladder.attempt(
+                    executor, "dist_sort",
+                    lambda: dist_plan.dist_sort_table(
+                        mesh, inp, cols,
+                        [k.ascending for k in rel.keys],
+                        [k.nulls_first_resolved() for k in rel.keys],
+                        metrics=executor.context.metrics),
+                    rel=rel)
                 if sorted_t is not None:
                     return self.fix_column_to_row_type(sorted_t, rel.schema)
         limit = executor.config.get("sql.sort.topk-nelem-limit", 1_000_000)

@@ -56,11 +56,13 @@ from .functions import (
     WINDOW_FUNCTIONS,
     resolve_type,
 )
+from ..resilience.errors import BindingError
 from .parser import ParsingException
 
 
-class BindError(ValueError):
-    pass
+class BindError(BindingError):
+    """Name/type resolution failure; taxonomy code BIND_ERROR (USER_ERROR),
+    still a ValueError through BindingError for historical callers."""
 
 
 _CMP_OPS = {"=": "eq", "<>": "ne", "<": "lt", "<=": "le", ">": "gt", ">=": "ge"}

@@ -10,6 +10,8 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import List
 
+from ..resilience.errors import ParseError
+
 
 class TokenType:
     IDENT = "IDENT"
@@ -36,8 +38,9 @@ class Token:
         return f"Token({self.type},{self.value!r})"
 
 
-class LexError(ValueError):
-    pass
+class LexError(ParseError):
+    """Tokenizer rejection; shares ParseError's taxonomy slot (PARSE_ERROR,
+    USER_ERROR) and remains a ValueError through it."""
 
 
 _TWO_CHAR_OPS = {"<=", ">=", "<>", "!=", "||", "::", "->"}

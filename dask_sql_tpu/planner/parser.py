@@ -14,13 +14,17 @@ import logging
 from typing import Any, Dict, List, Optional, Tuple
 
 from . import sqlast as a
+from ..resilience.errors import ParseError
 from .lexer import Token, TokenType, tokenize
 
 logger = logging.getLogger(__name__)
 
 
-class ParsingException(ValueError):
-    """Parity: reference DFParsingException (src/error.rs)."""
+class ParsingException(ParseError):
+    """Parity: reference DFParsingException (src/error.rs).  Based on the
+    resilience taxonomy (code PARSE_ERROR, USER_ERROR, never retryable) so
+    the server emits a structured wire payload; still a ValueError through
+    ParseError for historical callers."""
 
 
 RESERVED_STOP = {

@@ -1,0 +1,66 @@
+"""Resilient execution: error taxonomy, degradation ladder, retry/backoff,
+circuit breaker, and deterministic fault injection.
+
+The reference engine delegates all fault tolerance to dask.distributed; the
+TPU-native rewrite replaced that scheduler with direct XLA execution and so
+needs its own policy layer (TQP arXiv:2203.01877 / Flare arXiv:1703.08219
+both call this out for compiled paths).  Four cooperating parts:
+
+- :mod:`.errors`  — the taxonomy every failure crossing the executor
+  boundary is wrapped into (``code`` / ``retryable`` / ``degradable``);
+- :mod:`.ladder`  — compiled -> interpreted -> CPU degradation, observable
+  via ``SHOW METRICS LIKE 'resilience.%'``;
+- :mod:`.retry`   — bounded backoff retry at the ServingRuntime worker and
+  the per-plan-fingerprint circuit breaker the ladder consults;
+- :mod:`.faults`  — config-keyed deterministic fault injection
+  (``resilience.inject = "compile:0.5,oom:once"``) so every rung, the retry
+  policy and the breaker are provable in tests.
+"""
+from .errors import (
+    BindingError,
+    CancelledError,
+    CompileError,
+    DeadlineError,
+    ExecutionError,
+    InjectedFault,
+    ParseError,
+    PlanError,
+    QueryError,
+    ResourceExhaustedError,
+    ShutdownError,
+    TransientExecutionError,
+    classify,
+    is_degradable,
+    is_retryable,
+)
+from .faults import FaultInjector, get_injector, maybe_inject
+from .ladder import attempt, execute_interpreted, plan_fingerprint, wrap_boundary
+from .retry import BackoffPolicy, CircuitBreaker, retry_call
+
+__all__ = [
+    "BackoffPolicy",
+    "BindingError",
+    "CancelledError",
+    "CircuitBreaker",
+    "CompileError",
+    "DeadlineError",
+    "ExecutionError",
+    "FaultInjector",
+    "InjectedFault",
+    "ParseError",
+    "PlanError",
+    "QueryError",
+    "ResourceExhaustedError",
+    "ShutdownError",
+    "TransientExecutionError",
+    "attempt",
+    "classify",
+    "execute_interpreted",
+    "get_injector",
+    "is_degradable",
+    "is_retryable",
+    "maybe_inject",
+    "plan_fingerprint",
+    "retry_call",
+    "wrap_boundary",
+]

@@ -1,0 +1,184 @@
+"""Graceful-degradation ladder: compiled -> interpreted -> CPU backend.
+
+TQP (arXiv:2203.01877) and Flare (arXiv:1703.08219) both observe that a
+compiled/native execution path needs an explicit fallback ladder to stay as
+robust as the interpreted engine it replaced.  This engine already had the
+*shape* of a ladder — every compiled planner returns None to decline — but a
+compile crash or device OOM inside a rung surfaced as a raw traceback.  This
+module makes stepping down an explicit, observable policy:
+
+- `attempt` wraps one rung (compiled select/aggregate/join pipeline, the
+  distributed collectives engine): a *degradable* taxonomy error steps down
+  to the next rung instead of failing the query, and the step is recorded in
+  the MetricsRegistry (``resilience.degraded.<rung>``) and the executor's
+  tracer, so `SHOW METRICS LIKE 'resilience.%'` and EXPLAIN ANALYZE show
+  every degradation.
+- A per-(plan-fingerprint, rung) circuit breaker (resilience/retry.py) skips
+  a rung that repeatedly fails for the same query shape — the next
+  submission goes straight to its known-good rung instead of re-failing.
+- `execute_interpreted` is the bottom of the device ladder: if even the
+  per-op interpreted path hits a degradable failure (device OOM), it
+  re-executes the plan on the CPU backend — host DRAM instead of HBM —
+  before giving up.
+
+Rung names wired through the engine:
+
+    compiled_select         physical/compiled_select.py one-kernel root chain
+    compiled_join_aggregate physical/compiled_join.py scan->joins->aggregate
+    compiled_aggregate      physical/compiled.py whole-pipeline aggregate jit
+    dist_aggregate          parallel/dist_plan.py collectives engine
+    dist_sort               parallel/dist_plan.py range-partition sort
+    interpreted             the eager per-op converter walk
+    cpu                     the same walk under jax.default_device(cpu)
+"""
+from __future__ import annotations
+
+import hashlib
+import logging
+from typing import Callable, Optional, TypeVar
+
+from .errors import QueryError, classify
+from . import faults
+
+logger = logging.getLogger(__name__)
+
+T = TypeVar("T")
+
+
+def plan_fingerprint(rel) -> str:
+    """Stable identity of a plan shape for breaker keys: dataclass reprs
+    include every semantic field recursively (same property the result
+    cache relies on), hashed down to 16 hex chars."""
+    return hashlib.sha1(repr(rel).encode()).hexdigest()[:16]
+
+
+def _fingerprint_of(executor, rel) -> str:
+    fp = getattr(executor, "_resilience_fp", None)
+    if fp is None:
+        fp = plan_fingerprint(rel)
+        executor._resilience_fp = fp
+    return fp
+
+
+def _breaker_of(executor):
+    if not executor.config.get("resilience.breaker.enabled", True):
+        return None
+    return getattr(executor.context, "breaker", None)
+
+
+def attempt(executor, rung: str, fn: Callable[[], Optional[T]],
+            rel=None, inject_site: Optional[str] = None) -> Optional[T]:
+    """Run one ladder rung; None means "step down to the next rung".
+
+    The rung callable keeps the engine's existing convention: return None to
+    decline (ineligible shape — not an error, not recorded).  What this
+    wrapper adds: a *degradable* failure inside the rung also steps down —
+    recorded as ``resilience.degraded.<rung>`` and fed to the breaker — and
+    a breaker already open for (plan fingerprint, rung) skips the rung
+    without paying the failure again.  Non-degradable errors propagate."""
+    if not executor.config.get("resilience.ladder.enabled", True):
+        if inject_site is not None:
+            faults.maybe_inject(inject_site, executor.config)
+        return fn()
+    metrics = executor.context.metrics
+    breaker = _breaker_of(executor)
+    key = None
+    if breaker is not None and rel is not None:
+        key = (_fingerprint_of(executor, rel), rung)
+        if not breaker.allow(key):
+            metrics.inc("resilience.breaker.skip")
+            metrics.inc(f"resilience.breaker.skip.{rung}")
+            logger.debug("breaker open for rung %s: skipping", rung)
+            return None
+    try:
+        if inject_site is not None:
+            faults.maybe_inject(inject_site, executor.config)
+        out = fn()
+    except (KeyboardInterrupt, SystemExit):
+        raise
+    except BaseException as exc:
+        # classify() maps raw runtime failures (e.g. an XlaRuntimeError whose
+        # message leads with RESOURCE_EXHAUSTED) into the taxonomy; only
+        # *degradable* results step down — everything else re-raises as-is so
+        # non-ladder failure behavior is unchanged
+        err = classify(exc)
+        if not err.degradable:
+            raise
+        metrics.inc("resilience.degraded")
+        metrics.inc(f"resilience.degraded.{rung}")
+        if executor.tracer.enabled:
+            executor.tracer.event(f"degraded: {rung} [{err.code}]")
+        if key is not None and breaker.record_failure(key):
+            metrics.inc("resilience.breaker.trip")
+            logger.warning(
+                "breaker tripped for rung %s (plan %s): %s",
+                rung, key[0], err)
+        logger.info("rung %s degraded (%s); stepping down", rung, err.code)
+        return None
+    if out is not None:
+        metrics.inc(f"resilience.rung.{rung}")
+        if key is not None:
+            breaker.record_success(key)
+    return out
+
+
+def execute_interpreted(executor, rel):
+    """The bottom of the device ladder: the eager per-op walk, with one
+    last CPU-backend rung under it for degradable failures.
+
+    The CPU rung re-runs the *whole* plan with jax steering NEW array
+    placement to host devices and every distributed/compiled path disabled
+    (should_distribute would otherwise pick the same mesh off the sharded
+    inputs and re-fail identically) — slower, but host DRAM is orders of
+    magnitude larger than HBM.  Honest limitation: operands already
+    committed to device HBM still execute their ops there (jax does not
+    migrate committed buffers on default_device), so the rung fully
+    rescues capacity-ladder/compile-shape failures and partially rescues
+    allocation OOMs; if the rerun fails again, that failure propagates."""
+    try:
+        faults.maybe_inject("exec_oom", executor.config)
+        return executor.execute(rel)
+    except (KeyboardInterrupt, SystemExit):
+        raise
+    except BaseException as exc:
+        err = classify(exc)
+        if not err.degradable or not executor.config.get(
+                "resilience.ladder.cpu_fallback", True):
+            raise
+        import jax
+
+        try:
+            cpu = jax.devices("cpu")[0]
+        except RuntimeError:
+            raise  # no CPU backend registered: out of rungs, no step taken
+        # only now is the step-down real — count it (degraded == steps
+        # actually taken; a failure with no rung left must not inflate it)
+        metrics = executor.context.metrics
+        metrics.inc("resilience.degraded")
+        metrics.inc("resilience.degraded.interpreted")
+        if executor.tracer.enabled:
+            executor.tracer.event(f"degraded: interpreted [{err.code}]")
+        logger.warning("interpreted path failed degradably (%s); "
+                       "re-executing on the CPU backend", err.code)
+        executor._memo.clear()  # drop partial results of the failed walk
+        with executor.config.set({
+                "sql.distributed.aggregate": "off",
+                "sql.distributed.join": "off",
+                "sql.distributed.sort": "off",
+                "sql.compile": False}), jax.default_device(cpu):
+            out = executor.execute(rel)
+        metrics.inc("resilience.rung.cpu")
+        return out
+
+
+def wrap_boundary(fn: Callable[[], T], query_id: Optional[str] = None) -> T:
+    """Run `fn` and re-raise any failure as a taxonomy QueryError — the
+    executor-boundary contract TpuFrame.execute and the server rely on."""
+    try:
+        return fn()
+    except QueryError:
+        raise
+    except (KeyboardInterrupt, SystemExit):
+        raise
+    except BaseException as exc:
+        raise classify(exc, query_id=query_id) from exc

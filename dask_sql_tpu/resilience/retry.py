@@ -1,0 +1,190 @@
+"""Bounded retry with exponential backoff + jitter, and a per-plan-
+fingerprint circuit breaker.
+
+Retry runs at the ServingRuntime worker level (serving/runtime.py wraps each
+admitted query in `retry_call`): only errors the taxonomy marks `retryable`
+are retried, the backoff respects the ticket's deadline (never sleeps past
+it) and its cancellation flag (a cancel during backoff aborts immediately).
+Jitter is deterministic given (seed, attempt) so test runs reproduce.
+
+The breaker protects the degradation ladder (resilience/ladder.py): a plan
+fingerprint whose compiled rung failed `threshold` consecutive times skips
+that rung for `cooldown_s` and goes straight to its known-good rung, instead
+of paying the failure again on every submission.
+"""
+from __future__ import annotations
+
+import logging
+import random
+import threading
+import time
+from typing import Callable, Optional, Tuple, TypeVar
+
+from .errors import classify
+
+logger = logging.getLogger(__name__)
+
+T = TypeVar("T")
+
+
+class BackoffPolicy:
+    """Exponential backoff: base * multiplier^attempt, capped, jittered.
+
+    `max_attempts` counts total tries (1 = no retry).  Jitter multiplies
+    each delay by a factor drawn uniformly from [1-jitter, 1+jitter] using
+    a PRNG seeded per-policy, so retries desynchronize across workers while
+    a fixed seed reproduces the exact schedule."""
+
+    def __init__(self, max_attempts: int = 3, base_s: float = 0.05,
+                 multiplier: float = 2.0, max_s: float = 2.0,
+                 jitter: float = 0.5, seed: Optional[int] = None):
+        self.max_attempts = max(1, int(max_attempts))
+        self.base_s = float(base_s)
+        self.multiplier = float(multiplier)
+        self.max_s = float(max_s)
+        self.jitter = min(1.0, max(0.0, float(jitter)))
+        self._rng = random.Random(seed)
+
+    @classmethod
+    def from_config(cls, config) -> "BackoffPolicy":
+        # the jitter PRNG is pinned to the inject seed ONLY while fault
+        # injection is active (reproducible tests); in production it must
+        # stay unseeded, or every replica would draw the identical jitter
+        # sequence and retries would re-synchronize instead of spreading
+        seed = config.get("resilience.inject.seed") \
+            if config.get("resilience.inject") else None
+        return cls(
+            max_attempts=int(config.get("resilience.retry.max_attempts", 3)),
+            base_s=float(config.get("resilience.retry.base_s", 0.05)),
+            multiplier=float(config.get("resilience.retry.multiplier", 2.0)),
+            max_s=float(config.get("resilience.retry.max_s", 2.0)),
+            jitter=float(config.get("resilience.retry.jitter", 0.5)),
+            seed=seed,
+        )
+
+    def delay_s(self, attempt: int) -> float:
+        """Backoff before retry number `attempt` (1-based)."""
+        raw = min(self.max_s, self.base_s * (self.multiplier ** (attempt - 1)))
+        if self.jitter:
+            raw *= 1.0 + self.jitter * (2.0 * self._rng.random() - 1.0)
+        return max(0.0, raw)
+
+
+def retry_call(fn: Callable[[], T], policy: BackoffPolicy,
+               ticket=None, metrics=None,
+               sleep: Callable[[float], None] = time.sleep) -> T:
+    """Run `fn`, retrying taxonomy-retryable failures with backoff.
+
+    Non-retryable errors (user errors, cancels, deadline expiry, permanent
+    execution failures) propagate on the first throw.  A retryable error is
+    re-raised once attempts are exhausted or the ticket's deadline cannot
+    absorb the next backoff sleep."""
+    attempt = 1
+    while True:
+        try:
+            result = fn()
+        except BaseException as exc:  # noqa: BLE001 - classified below
+            err = classify(exc)
+            if not err.retryable or attempt >= policy.max_attempts:
+                raise
+            delay = policy.delay_s(attempt)
+            if ticket is not None:
+                remaining = ticket.remaining_s()
+                if remaining is not None and delay >= remaining:
+                    # the backoff alone would blow the deadline: surface the
+                    # original failure now, with time left to report it
+                    if metrics is not None:
+                        metrics.inc("resilience.retry.deadline_abort")
+                    raise
+            if metrics is not None:
+                metrics.inc("resilience.retry.attempts")
+                metrics.observe("resilience.retry.backoff_ms", delay * 1000.0)
+            logger.debug("retrying after %s (attempt %d/%d, backoff %.3fs)",
+                         err.code, attempt, policy.max_attempts, delay)
+            sleep(delay)
+            if ticket is not None:
+                ticket.checkpoint()  # cancel/deadline during backoff
+            attempt += 1
+            continue
+        if attempt > 1 and metrics is not None:
+            metrics.inc("resilience.retry.recovered")
+        return result
+
+
+class CircuitBreaker:
+    """Per-key consecutive-failure breaker with cooldown.
+
+    Keys are (plan fingerprint, rung name) tuples from the degradation
+    ladder.  After `threshold` consecutive failures `allow` returns False
+    until `cooldown_s` has elapsed, after which ONE trial is admitted
+    (half-open); its outcome closes or re-opens the circuit.  Admitting the
+    trial re-arms the cooldown clock rather than setting a sticky flag, so
+    a trial that never settles (the rung *declines* instead of succeeding
+    or failing) costs one more cooldown, not a permanently-open circuit."""
+
+    def __init__(self, threshold: int = 3, cooldown_s: float = 30.0,
+                 max_keys: int = 1024,
+                 clock: Callable[[], float] = time.monotonic):
+        self.threshold = max(1, int(threshold))
+        self.cooldown_s = float(cooldown_s)
+        self.max_keys = int(max_keys)
+        self._clock = clock
+        self._lock = threading.Lock()
+        #: key -> [consecutive_failures, opened_at or None]
+        self._state: dict = {}
+
+    @classmethod
+    def from_config(cls, config) -> "CircuitBreaker":
+        return cls(
+            threshold=int(config.get("resilience.breaker.threshold", 3)),
+            cooldown_s=float(config.get("resilience.breaker.cooldown_s", 30.0)),
+        )
+
+    def allow(self, key: Tuple) -> bool:
+        with self._lock:
+            st = self._state.get(key)
+            if st is None or st[1] is None:
+                return True
+            if self._clock() - st[1] >= self.cooldown_s:
+                # admit one half-open trial and re-arm the cooldown: peers
+                # stay blocked for another window, and a trial that never
+                # settles (rung declined) simply waits out one more cooldown
+                st[1] = self._clock()
+                return True
+            return False
+
+    def record_failure(self, key: Tuple) -> bool:
+        """Count a failure; returns True when this call TRIPS the breaker
+        (transition closed -> open), so callers can emit the trip metric
+        exactly once."""
+        with self._lock:
+            st = self._state.setdefault(key, [0, None])
+            st[0] += 1
+            tripped = st[1] is None and st[0] >= self.threshold
+            if st[0] >= self.threshold:
+                st[1] = self._clock()
+            self._evict_locked()
+            return tripped
+
+    def record_success(self, key: Tuple) -> None:
+        with self._lock:
+            self._state.pop(key, None)
+
+    def is_open(self, key: Tuple) -> bool:
+        with self._lock:
+            st = self._state.get(key)
+            return bool(st and st[1] is not None)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            open_keys = sum(1 for st in self._state.values()
+                            if st[1] is not None)
+            return {"keys": len(self._state), "open": open_keys,
+                    "threshold": self.threshold,
+                    "cooldownSeconds": self.cooldown_s}
+
+    def _evict_locked(self) -> None:
+        # bounded memory: drop oldest entries past the cap (dict preserves
+        # insertion order; breaker state is advisory, losing one is safe)
+        while len(self._state) > self.max_keys:
+            self._state.pop(next(iter(self._state)))

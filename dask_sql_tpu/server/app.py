@@ -30,7 +30,6 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional
 
 from ..serving.admission import (
-    DeadlineExceededError,
     QueryCancelledError,
     QueryTicket,
     QueueFullError,
@@ -120,9 +119,13 @@ class _QueryRegistry:
                     # missing entry means a bookkeeping bug upstream — fail
                     # the query rather than report FINISHED with no data
                     raise QueryCancelledError(f"query {qid} entry lost")
-                entry.started = time.monotonic()
-                self.n_queued -= 1
-                self.n_running += 1
+                if entry.started is None:
+                    # idempotent: the serving runtime re-invokes run() when
+                    # it retries a transient failure; the queued->running
+                    # gauge transition must count once
+                    entry.started = time.monotonic()
+                    self.n_queued -= 1
+                    self.n_running += 1
             return fn(lambda: self._mark_planned(qid))
 
         with self.lock:
@@ -353,20 +356,13 @@ def _make_handler(context, registry: _QueryRegistry, jdbc_meta: bool):
                 df = entry.future.result()
             except CancelledError:
                 self._send(responses.error_results(
-                    qid, None, QueryCancelledError(f"query {qid} cancelled"),
-                    error_name="USER_CANCELED"))
-                return
-            except QueryCancelledError as e:
-                # cancelled mid-run at an executor checkpoint: same wire
-                # error as a queued-state cancel
-                self._send(responses.error_results(
-                    qid, None, e, error_name="USER_CANCELED"))
-                return
-            except DeadlineExceededError as e:
-                self._send(responses.error_results(
-                    qid, None, e, error_name="EXCEEDED_TIME_LIMIT"))
+                    qid, None, QueryCancelledError(f"query {qid} cancelled")))
                 return
             except Exception as e:  # noqa: BLE001 - surfaced to the client
+                # taxonomy QueryErrors (cancel mid-run, deadline expiry,
+                # shutdown shed, compile/execute failures) carry their own
+                # wire code + retryable flag; anything else is classified
+                # by error_results, so the client always sees structure
                 self._send(responses.error_results(qid, None, e))
                 return
             payload = {

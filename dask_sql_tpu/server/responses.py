@@ -126,8 +126,27 @@ def data_from_frame(df) -> List[List[Any]]:
 
 def error_results(query_id: str, next_uri: Optional[str], error: Exception,
                   error_name: Optional[str] = None,
-                  error_type: str = "USER_ERROR") -> Dict[str, Any]:
-    # parity: reference responses.py:128-141 ErrorResults formatting
+                  error_type: Optional[str] = None) -> Dict[str, Any]:
+    """Presto ErrorResults (parity: reference responses.py:128-141).
+
+    Taxonomy-aware: a resilience `QueryError` carries its own stable
+    ``code`` (-> errorName), wire ``error_type`` and the retryable /
+    degradable flags, so drivers and load balancers can back off or reroute
+    without string-matching messages.  Non-taxonomy exceptions are
+    classified first, so every failure leaves the server structured."""
+    from ..resilience.errors import QueryError, classify
+
+    if not isinstance(error, QueryError) and error_name is None \
+            and error_type is None:
+        error = classify(error)
+    payload = {
+        "code": type(error).__name__,
+        "errorType": error_type or "USER_ERROR",
+        "retryable": False,
+        "degradable": False,
+    }
+    if isinstance(error, QueryError):
+        payload.update(error.payload())
     return {
         "id": query_id,
         "infoUri": "",
@@ -135,8 +154,10 @@ def error_results(query_id: str, next_uri: Optional[str], error: Exception,
         "error": {
             "message": str(error),
             "errorCode": 1,
-            "errorName": error_name or type(error).__name__,
-            "errorType": error_type,
+            "errorName": error_name or payload["code"],
+            "errorType": error_type or payload["errorType"],
+            "retryable": payload["retryable"],
+            "degradable": payload["degradable"],
             "failureInfo": {
                 "type": type(error).__name__,
                 "message": str(error),
@@ -152,9 +173,9 @@ def queue_full_results(query_id: str, error) -> Dict[str, Any]:
     like a Presto ErrorResults with QUERY_QUEUE_FULL / INSUFFICIENT_RESOURCES
     so drivers surface it as retryable, plus a machine-readable
     ``retryAfterSeconds`` (also sent as the HTTP Retry-After header)."""
-    payload = error_results(query_id, None, error,
-                            error_name="QUERY_QUEUE_FULL",
-                            error_type="INSUFFICIENT_RESOURCES")
+    # QueueFullError carries code=QUERY_QUEUE_FULL / INSUFFICIENT_RESOURCES /
+    # retryable=True through the taxonomy; error_results reads them off
+    payload = error_results(query_id, None, error)
     payload["error"]["retryAfterSeconds"] = float(
         getattr(error, "retry_after_s", 1.0))
     payload["error"]["priorityClass"] = getattr(error, "priority_class", "")

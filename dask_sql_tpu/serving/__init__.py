@@ -16,6 +16,7 @@ arXiv:1703.08219 makes the same point for compiled Spark):
 :mod:`.runtime` ties them together into the worker pool the Presto server
 runs queries on.
 """
+from ..resilience.errors import ShutdownError
 from .admission import (
     AdmissionController,
     DeadlineExceededError,
@@ -37,6 +38,7 @@ __all__ = [
     "QueueFullError",
     "ResultCache",
     "ServingRuntime",
+    "ShutdownError",
     "current_ticket",
     "table_nbytes",
 ]

@@ -20,12 +20,24 @@ import threading
 import time
 from typing import Dict, Optional
 
+from ..resilience.errors import (
+    INSUFFICIENT_RESOURCES,
+    CancelledError,
+    DeadlineError,
+    QueryError,
+)
+
 #: scheduling order — lower runs first
 CLASSES = ("interactive", "batch")
 
 
-class QueueFullError(RuntimeError):
-    """Load shed: the class queue is at its bound; retry after a delay."""
+class QueueFullError(QueryError):
+    """Load shed: the class queue is at its bound; retry after a delay.
+    Taxonomy: retryable (the hint says when), INSUFFICIENT_RESOURCES."""
+
+    code = "QUERY_QUEUE_FULL"
+    error_type = INSUFFICIENT_RESOURCES
+    retryable = True
 
     def __init__(self, priority_class: str, bound: int, retry_after_s: float):
         super().__init__(
@@ -36,11 +48,11 @@ class QueueFullError(RuntimeError):
         self.retry_after_s = retry_after_s
 
 
-class DeadlineExceededError(RuntimeError):
+class DeadlineExceededError(DeadlineError):
     """The query ran past its deadline and was cancelled at a checkpoint."""
 
 
-class QueryCancelledError(RuntimeError):
+class QueryCancelledError(CancelledError):
     """The client cancelled the query; raised at the next checkpoint."""
 
 

@@ -27,6 +27,8 @@ from collections import deque
 from concurrent.futures import Future
 from typing import Callable, Dict, Optional, Tuple
 
+from ..resilience.errors import ShutdownError
+from ..resilience.retry import BackoffPolicy, retry_call
 from .admission import (
     CLASSES,
     AdmissionController,
@@ -53,9 +55,13 @@ class ServingRuntime:
                  batch_max_running: Optional[int] = None,
                  retry_after_s: float = 1.0,
                  default_deadline_s: Optional[float] = None,
-                 metrics: Optional[MetricsRegistry] = None):
+                 metrics: Optional[MetricsRegistry] = None,
+                 retry_policy: Optional[BackoffPolicy] = None):
         self.workers = max(1, int(workers))
         self.metrics = metrics if metrics is not None else MetricsRegistry()
+        #: backoff policy for taxonomy-retryable failures (resilience/retry.py)
+        self.retry_policy = retry_policy if retry_policy is not None \
+            else BackoffPolicy()
         self.admission = AdmissionController(
             bounds or {"interactive": 32, "batch": 64}, self.workers,
             retry_after_s=retry_after_s, metrics=self.metrics)
@@ -92,6 +98,7 @@ class ServingRuntime:
             retry_after_s=float(config.get("serving.retry_after_s", 1.0)),
             default_deadline_s=config.get("serving.deadline_s"),
             metrics=metrics,
+            retry_policy=BackoffPolicy.from_config(config),
         )
 
     # -------------------------------------------------------------- submit
@@ -103,7 +110,7 @@ class ServingRuntime:
         """Admit and enqueue `fn(ticket)`; raises `QueueFullError` when the
         class queue is at its bound (load shedding, never blocks)."""
         if self._shutdown:
-            raise RuntimeError("serving runtime is shut down")
+            raise ShutdownError("serving runtime is shut down")
         if priority_class == "batch" and self.batch_max_running == 0:
             # batch is paused: shed immediately instead of admitting work
             # that no worker would ever pop (client would hang in QUEUED)
@@ -118,6 +125,11 @@ class ServingRuntime:
         ticket = self.admission.admit(qid, priority_class, deadline_s)
         fut: Future = Future()
         with self._cv:
+            if self._shutdown:
+                # lost the race with a concurrent shutdown(): enqueueing now
+                # would strand the future (the drain already ran)
+                self.admission.on_finish(ticket, started=False)
+                raise ShutdownError("serving runtime is shut down")
             self._queues[ticket.priority_class].append((ticket, fn, fut))
             self._cv.notify()
         return qid, fut, ticket
@@ -164,7 +176,11 @@ class ServingRuntime:
             self.admission.on_start(ticket)
             _tls.ticket = ticket
             try:
-                result = fn(ticket)
+                # taxonomy-retryable failures (transient device/runtime
+                # errors) are retried here with backoff, bounded by the
+                # ticket's deadline; everything else surfaces on first throw
+                result = retry_call(lambda: fn(ticket), self.retry_policy,
+                                    ticket=ticket, metrics=self.metrics)
             except QueryCancelledError as e:
                 self.metrics.inc("serving.cancelled")
                 fut.set_exception(e)
@@ -196,9 +212,27 @@ class ServingRuntime:
 
     # ------------------------------------------------------------ lifecycle
     def shutdown(self, wait: bool = False, timeout: float = 5.0) -> None:
+        """Stop accepting work and drain deterministically.
+
+        Queued-but-not-started queries fail immediately with a structured
+        (retryable) `ShutdownError` — another replica or a restart can take
+        them — instead of hanging on futures no worker will ever pop.
+        In-flight queries run to completion; ``wait=True`` joins the worker
+        threads (bounded by `timeout` each)."""
+        drained = []
         with self._cv:
             self._shutdown = True
+            for cls in CLASSES:
+                q = self._queues[cls]
+                while q:
+                    drained.append(q.popleft())
             self._cv.notify_all()
+        for ticket, _fn, fut in drained:
+            self.admission.on_finish(ticket, started=False)
+            self.metrics.inc("serving.shutdown_shed")
+            if fut.set_running_or_notify_cancel():
+                fut.set_exception(ShutdownError(
+                    f"query {ticket.qid} shed: serving runtime shutting down"))
         if wait:
             for t in self._threads:
                 t.join(timeout)

@@ -47,6 +47,13 @@ class Tracer:
         if registry is not None and self.root is not None:
             registry.observe_trace(self.root)
 
+    def event(self, label: str) -> None:
+        """Record a zero-duration marker (e.g. a resilience-ladder
+        degradation step) at the current tree position, so EXPLAIN ANALYZE
+        shows *where* the engine stepped down a rung."""
+        if self.enabled:
+            self._stack[-1].append(NodeTrace("Resilience", label, 0.0, -1))
+
     def node(self, rel):
         tracer = self
 

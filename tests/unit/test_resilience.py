@@ -1,0 +1,471 @@
+"""Resilience subsystem: error taxonomy, retry/backoff, circuit breaker,
+degradation ladder, and the fault-injection harness that proves each of
+them actually fires (ISSUE 3 acceptance criteria)."""
+import threading
+import time
+
+import pandas as pd
+import pytest
+
+from dask_sql_tpu import Context
+from dask_sql_tpu import config as config_module
+from dask_sql_tpu.resilience import faults
+from dask_sql_tpu.resilience.errors import (
+    CompileError,
+    DeadlineError,
+    ExecutionError,
+    ParseError,
+    QueryError,
+    ResourceExhaustedError,
+    ShutdownError,
+    TransientExecutionError,
+    classify,
+)
+from dask_sql_tpu.resilience.faults import FaultInjector
+from dask_sql_tpu.resilience.ladder import plan_fingerprint
+from dask_sql_tpu.resilience.retry import BackoffPolicy, CircuitBreaker, retry_call
+
+
+@pytest.fixture(autouse=True)
+def _fresh_injector():
+    """Every test starts with no armed faults and leaves none behind; the
+    tests that must mutate the *global* config (serving worker threads do
+    not see thread-local overlays) get it restored here."""
+    saved = dict(config_module.config._values)
+    faults.reset()
+    yield
+    config_module.config._values = saved
+    faults.reset()
+
+
+def _ctx():
+    c = Context()
+    c.create_table("t", pd.DataFrame({"a": [1, 2, 3], "b": [1.5, 2.5, 3.5]}))
+    return c
+
+
+# ---------------------------------------------------------------- taxonomy
+def test_taxonomy_flags_and_codes():
+    assert CompileError("x").degradable and not CompileError("x").retryable
+    assert ResourceExhaustedError("x").degradable
+    assert TransientExecutionError("x").retryable
+    assert not DeadlineError("x").retryable
+    assert ShutdownError("x").retryable
+    p = ResourceExhaustedError("x").payload()
+    assert p["code"] == "RESOURCE_EXHAUSTED"
+    assert p["errorType"] == "INSUFFICIENT_RESOURCES"
+    # instance overrides beat class defaults
+    e = CompileError("known-permanent", degradable=False)
+    assert not e.degradable
+
+
+def test_taxonomy_exceptions_module_aliases():
+    from dask_sql_tpu.exceptions import (
+        BindError,
+        LexError,
+        OptimizationException,
+        ParsingException,
+    )
+
+    # historical contracts: still ValueErrors / RuntimeErrors
+    assert issubclass(ParsingException, ValueError)
+    assert issubclass(BindError, ValueError)
+    assert issubclass(LexError, ValueError)
+    assert issubclass(OptimizationException, RuntimeError)
+    # and now taxonomy members with stable codes
+    assert issubclass(ParsingException, QueryError)
+    assert ParsingException("x").code == "PARSE_ERROR"
+    assert BindError("x").code == "BIND_ERROR"
+    assert OptimizationException("x").code == "OPTIMIZATION_ERROR"
+
+
+def test_parse_error_is_taxonomy_through_sql():
+    c = _ctx()
+    with pytest.raises(ParseError) as ei:
+        c.sql("SELEC nope")
+    assert ei.value.payload()["errorType"] == "USER_ERROR"
+
+
+def test_classify_maps_oom_and_transients():
+    assert isinstance(classify(RuntimeError("RESOURCE_EXHAUSTED: out of "
+                                            "memory allocating 1GB")),
+                      ResourceExhaustedError)
+    assert isinstance(classify(MemoryError()), ResourceExhaustedError)
+    assert isinstance(classify(ConnectionError("reset")),
+                      TransientExecutionError)
+    wrapped = classify(KeyError("ghost"))
+    assert isinstance(wrapped, ExecutionError) and not wrapped.retryable
+    # OOM matching is word-bounded: ROOM/ZOOM must not look like device OOM
+    assert classify(KeyError("ROOM_ID")).code == "EXECUTION_ERROR"
+    assert isinstance(classify(RuntimeError("device OOM")),
+                      ResourceExhaustedError)
+    # permanent filesystem errors are NOT retryable transients
+    assert not classify(FileNotFoundError("gone.parquet")).retryable
+    assert not classify(PermissionError("denied")).retryable
+    # idempotent on taxonomy members
+    e = CompileError("x")
+    assert classify(e) is e
+
+
+def test_executor_boundary_wraps_raw_failures():
+    """A non-taxonomy crash inside execution leaves TpuFrame.execute as a
+    structured QueryError (still a RuntimeError for old callers)."""
+    c = _ctx()
+
+    def boom(x):
+        raise ValueError("kernel exploded")
+
+    import numpy as np
+
+    c.register_function(boom, "boom_udf", [("x", np.int64)], np.int64)
+    with pytest.raises(QueryError) as ei:
+        c.sql("SELECT boom_udf(a) AS v FROM t", return_futures=False)
+    assert ei.value.code == "EXECUTION_ERROR"
+
+
+# ------------------------------------------------------------------ faults
+def test_fault_spec_parsing_and_budgets():
+    inj = FaultInjector("compile:once,oom:2,execute:always")
+    assert inj.arm("compile") and not inj.arm("compile")
+    assert inj.arm("oom") and inj.arm("oom") and not inj.arm("oom")
+    assert all(inj.arm("execute") for _ in range(5))
+    assert not inj.arm("checkpoint")  # unlisted site never fires
+
+
+def test_fault_probability_deterministic():
+    i1 = FaultInjector("compile:0.5", seed=7)
+    i2 = FaultInjector("compile:0.5", seed=7)
+    seq = [i1.arm("compile") for _ in range(32)]
+    assert seq == [i2.arm("compile") for _ in range(32)]
+    assert any(seq) and not all(seq)  # p=0.5 really mixes outcomes
+
+
+def test_fault_unknown_site_rejected():
+    with pytest.raises(ValueError):
+        FaultInjector("warpcore:once")
+
+
+def test_fault_injector_keyed_on_spec_and_seed():
+    with config_module.set({"resilience.inject": "compile:once"}):
+        inj1 = faults.get_injector(config_module.config)
+        assert inj1.arm("compile")
+        assert faults.get_injector(config_module.config) is inj1  # state kept
+    with config_module.set({"resilience.inject": "oom:once"}):
+        inj2 = faults.get_injector(config_module.config)
+        assert inj2 is not inj1
+    # same spec, different seed -> fresh injector (fresh PRNG + budgets)
+    with config_module.set({"resilience.inject": "compile:once",
+                            "resilience.inject.seed": 9}):
+        inj3 = faults.get_injector(config_module.config)
+        assert inj3 is not inj1 and inj3.arm("compile")
+    # alternating scopes do NOT reset each other's budgets
+    with config_module.set({"resilience.inject": "compile:once"}):
+        assert faults.get_injector(config_module.config) is inj1
+        assert not inj1.arm("compile")  # still spent
+    with config_module.set({"resilience.inject": None}):
+        assert faults.get_injector(config_module.config) is None
+
+
+# ----------------------------------------------------------------- retry
+def test_backoff_schedule_deterministic_and_capped():
+    p = BackoffPolicy(max_attempts=5, base_s=0.1, multiplier=2.0, max_s=0.3,
+                      jitter=0.0, seed=0)
+    assert p.delay_s(1) == pytest.approx(0.1)
+    assert p.delay_s(2) == pytest.approx(0.2)
+    assert p.delay_s(3) == pytest.approx(0.3)  # capped
+    assert p.delay_s(4) == pytest.approx(0.3)
+    j1 = BackoffPolicy(jitter=0.5, seed=42)
+    j2 = BackoffPolicy(jitter=0.5, seed=42)
+    assert [j1.delay_s(i) for i in (1, 2, 3)] == \
+        [j2.delay_s(i) for i in (1, 2, 3)]
+
+
+def test_retry_call_recovers_transient():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise TransientExecutionError("hiccup")
+        return "ok"
+
+    slept = []
+    out = retry_call(flaky, BackoffPolicy(max_attempts=3, base_s=0.01,
+                                          jitter=0.0),
+                     sleep=slept.append)
+    assert out == "ok" and len(calls) == 3 and len(slept) == 2
+
+
+def test_retry_call_gives_up_after_max_attempts():
+    def always_bad():
+        raise TransientExecutionError("hiccup")
+
+    with pytest.raises(TransientExecutionError):
+        retry_call(always_bad, BackoffPolicy(max_attempts=2, base_s=0.0),
+                   sleep=lambda s: None)
+
+
+def test_retry_call_never_retries_permanent():
+    calls = []
+
+    def bad():
+        calls.append(1)
+        raise ExecutionError("broken plan")
+
+    with pytest.raises(ExecutionError):
+        retry_call(bad, BackoffPolicy(max_attempts=5, base_s=0.0),
+                   sleep=lambda s: None)
+    assert len(calls) == 1
+
+
+def test_retry_call_respects_deadline():
+    """A backoff sleep that would blow the deadline aborts immediately."""
+    from dask_sql_tpu.serving import QueryTicket
+
+    ticket = QueryTicket("q", deadline=time.monotonic() + 0.05)
+
+    def flaky():
+        raise TransientExecutionError("hiccup")
+
+    t0 = time.monotonic()
+    with pytest.raises(TransientExecutionError):
+        retry_call(flaky, BackoffPolicy(max_attempts=10, base_s=5.0,
+                                        jitter=0.0), ticket=ticket)
+    assert time.monotonic() - t0 < 1.0  # did NOT sleep the 5s backoff
+
+
+# ----------------------------------------------------------------- breaker
+def test_breaker_trips_and_cools_down():
+    now = [0.0]
+    b = CircuitBreaker(threshold=2, cooldown_s=10.0, clock=lambda: now[0])
+    key = ("fp", "compiled")
+    assert b.allow(key)
+    assert not b.record_failure(key)
+    assert b.allow(key)  # one failure: still closed
+    assert b.record_failure(key)  # trips now
+    assert not b.allow(key)
+    now[0] = 11.0
+    assert b.allow(key)        # half-open trial admitted
+    assert not b.allow(key)    # ...but only one
+    b.record_success(key)
+    assert b.allow(key)        # closed again
+
+
+def test_breaker_unsettled_trial_does_not_stick_open():
+    """A half-open trial that never settles (the rung *declined* — neither
+    success nor failure recorded) must not leave the circuit open forever:
+    the next cooldown admits another trial."""
+    now = [0.0]
+    b = CircuitBreaker(threshold=1, cooldown_s=10.0, clock=lambda: now[0])
+    key = ("fp", "compiled")
+    b.record_failure(key)  # trips (threshold 1)
+    now[0] = 11.0
+    assert b.allow(key)  # half-open trial; rung declines, nothing recorded
+    assert not b.allow(key)
+    now[0] = 22.0
+    assert b.allow(key)  # another cooldown elapsed: trial re-admitted
+    b.record_success(key)
+    assert b.allow(key) and b.allow(key)  # fully closed
+
+
+def test_breaker_success_resets_counter():
+    b = CircuitBreaker(threshold=2, cooldown_s=10.0)
+    key = ("fp", "r")
+    b.record_failure(key)
+    b.record_success(key)
+    b.record_failure(key)
+    assert b.allow(key)  # 1 consecutive failure, not 2
+
+
+def test_plan_fingerprint_stable():
+    c = _ctx()
+    p1 = c.sql("SELECT SUM(a) AS s FROM t").plan
+    p2 = c.sql("SELECT SUM(a) AS s FROM t").plan
+    p3 = c.sql("SELECT SUM(b) AS s FROM t").plan
+    assert plan_fingerprint(p1) == plan_fingerprint(p2)
+    assert plan_fingerprint(p1) != plan_fingerprint(p3)
+
+
+# ------------------------------------------------- ladder (fault-injected)
+@pytest.mark.faults
+def test_forced_compile_failure_degrades_and_matches():
+    """Acceptance: a forced compile failure completes the query via a lower
+    rung, the result matches the non-injected run, and resilience.* metrics
+    recorded the degradation."""
+    clean = _ctx().sql("SELECT SUM(a) AS s FROM t GROUP BY a > 1 "
+                       "ORDER BY s", return_futures=False)
+    c = _ctx()
+    with config_module.set({"resilience.inject": "compile:always",
+                            "serving.cache.enabled": False}):
+        hurt = c.sql("SELECT SUM(a) AS s FROM t GROUP BY a > 1 "
+                     "ORDER BY s", return_futures=False)
+    pd.testing.assert_frame_equal(hurt, clean)
+    assert c.metrics.counter("resilience.degraded") >= 1
+    df = c.sql("SHOW METRICS LIKE 'resilience.%'", return_futures=False)
+    rows = dict(zip(df["Metric"], df["Value"]))
+    assert int(rows["resilience.degraded"]) >= 1
+
+
+@pytest.mark.faults
+def test_forced_oom_degrades_and_matches():
+    """Acceptance: a forced device-OOM inside the compiled rung completes
+    via the interpreted rung with an identical result."""
+    clean = _ctx().sql("SELECT SUM(a) AS s FROM t", return_futures=False)
+    c = _ctx()
+    with config_module.set({"resilience.inject": "oom:once",
+                            "serving.cache.enabled": False}):
+        hurt = c.sql("SELECT SUM(a) AS s FROM t", return_futures=False)
+    pd.testing.assert_frame_equal(hurt, clean)
+    assert c.metrics.counter("resilience.degraded") == 1
+
+
+@pytest.mark.faults
+def test_forced_exec_oom_takes_cpu_rung():
+    """Device ladder bottom: interpreted-path OOM re-executes on the CPU
+    backend instead of failing."""
+    c = _ctx()
+    with config_module.set({"resilience.inject": "exec_oom:once",
+                            "serving.cache.enabled": False,
+                            "sql.compile": False}):
+        out = c.sql("SELECT SUM(a) AS s FROM t", return_futures=False)
+    assert int(out["s"][0]) == 6
+    assert c.metrics.counter("resilience.rung.cpu") == 1
+    assert c.metrics.counter("resilience.degraded.interpreted") == 1
+
+
+@pytest.mark.faults
+def test_ladder_disabled_propagates_failure():
+    c = _ctx()
+    with config_module.set({"resilience.inject": "compile:always",
+                            "resilience.ladder.enabled": False,
+                            "serving.cache.enabled": False}):
+        with pytest.raises(CompileError):
+            c.sql("SELECT SUM(a) AS s FROM t", return_futures=False)
+
+
+@pytest.mark.faults
+def test_breaker_skips_failing_rung_on_next_submission():
+    """Acceptance: a repeatedly-failing plan fingerprint trips the breaker
+    and the next submission skips the failing rung instead of re-failing."""
+    c = _ctx()
+    c.breaker.threshold = 2
+    q = "SELECT SUM(a) AS s FROM t"
+    with config_module.set({"resilience.inject": "compile:always",
+                            "serving.cache.enabled": False}):
+        c.sql(q, return_futures=False)
+        c.sql(q, return_futures=False)
+        assert c.metrics.counter("resilience.breaker.trip") >= 1
+        degraded_before = c.metrics.counter(
+            "resilience.degraded.compiled_select")
+        out = c.sql(q, return_futures=False)
+    assert int(out["s"][0]) == 6
+    # third run skipped the compiled_select rung (breaker open): no new
+    # degradation was paid for it
+    assert c.metrics.counter("resilience.breaker.skip") >= 1
+    assert c.metrics.counter(
+        "resilience.degraded.compiled_select") == degraded_before
+
+
+@pytest.mark.faults
+def test_transient_execute_fault_retried_within_deadline():
+    """Acceptance: a forced transient execute fault is retried with backoff
+    at the serving worker and succeeds within the ticket deadline."""
+    from dask_sql_tpu.resilience.retry import BackoffPolicy
+    from dask_sql_tpu.serving import ServingRuntime
+
+    c = _ctx()
+    config_module.config.update({"resilience.inject": "execute:2",
+                                 "serving.cache.enabled": False})
+    rt = ServingRuntime(
+        workers=1,
+        retry_policy=BackoffPolicy(max_attempts=3, base_s=0.01, jitter=0.0))
+    try:
+        _, fut, _ = rt.submit(
+            lambda t: c.sql("SELECT SUM(a) AS s FROM t",
+                            return_futures=False),
+            deadline_s=30.0)
+        out = fut.result(30)
+        assert int(out["s"][0]) == 6
+        assert rt.metrics.counter("resilience.retry.attempts") == 2
+        assert rt.metrics.counter("resilience.retry.recovered") == 1
+        assert rt.metrics.counter("serving.completed") == 1
+    finally:
+        rt.shutdown(wait=True)
+        config_module.config.update({"resilience.inject": None})
+
+
+@pytest.mark.faults
+def test_transient_fault_exhausts_attempts_surfaces_structured():
+    from dask_sql_tpu.resilience.retry import BackoffPolicy
+    from dask_sql_tpu.serving import ServingRuntime
+
+    c = _ctx()
+    config_module.config.update({"resilience.inject": "execute:always",
+                                 "serving.cache.enabled": False})
+    rt = ServingRuntime(
+        workers=1,
+        retry_policy=BackoffPolicy(max_attempts=2, base_s=0.0, jitter=0.0))
+    try:
+        _, fut, _ = rt.submit(
+            lambda t: c.sql("SELECT SUM(a) AS s FROM t",
+                            return_futures=False))
+        with pytest.raises(TransientExecutionError):
+            fut.result(30)
+        assert rt.metrics.counter("resilience.retry.attempts") == 1
+        assert rt.metrics.counter("serving.failed") == 1
+    finally:
+        rt.shutdown(wait=True)
+        config_module.config.update({"resilience.inject": None})
+
+
+# -------------------------------------------------------- wire integration
+@pytest.mark.faults
+def test_server_reports_structured_taxonomy_error():
+    """The Presto wire payload carries the taxonomy code and retryable
+    flag for an injected failure with the ladder disabled."""
+    import json
+    import urllib.request
+
+    from dask_sql_tpu.server.app import run_server
+
+    c = _ctx()
+    server = run_server(context=c, host="127.0.0.1", port=0, blocking=False)
+    try:
+        config_module.config.update({"resilience.inject": "compile:always",
+                                     "resilience.ladder.enabled": False,
+                                     "serving.cache.enabled": False})
+        base = f"http://127.0.0.1:{server.port}"
+        req = urllib.request.Request(
+            f"{base}/v1/statement",
+            data=b"SELECT SUM(a) AS s FROM t", method="POST")
+        with urllib.request.urlopen(req) as resp:
+            submitted = json.loads(resp.read())
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            with urllib.request.urlopen(submitted["nextUri"]) as resp:
+                status = json.loads(resp.read())
+            if status.get("error") or "data" in status \
+                    or status["stats"]["state"] == "FINISHED":
+                break
+            time.sleep(0.05)
+        err = status["error"]
+        assert err["errorName"] == "INJECTED_COMPILE_ERROR"
+        assert err["retryable"] is False and err["degradable"] is True
+    finally:
+        config_module.config.update({"resilience.inject": None,
+                                     "resilience.ladder.enabled": True})
+        server.shutdown()
+
+
+def test_error_results_payload_for_taxonomy_member():
+    from dask_sql_tpu.server import responses
+
+    payload = responses.error_results("q1", None, ResourceExhaustedError(
+        "device OOM"))
+    err = payload["error"]
+    assert err["errorName"] == "RESOURCE_EXHAUSTED"
+    assert err["errorType"] == "INSUFFICIENT_RESOURCES"
+    assert err["degradable"] is True
+    # raw exceptions get classified, not passed through unstructured
+    payload = responses.error_results("q2", None, KeyError("ghost"))
+    assert payload["error"]["errorName"] == "EXECUTION_ERROR"

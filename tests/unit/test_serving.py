@@ -392,6 +392,48 @@ def test_expired_while_queued():
         rt.shutdown()
 
 
+def test_shutdown_drains_inflight_and_fails_queued():
+    """shutdown(wait=True) regression: the in-flight query finishes, every
+    queued future fails promptly with a structured ShutdownError (instead of
+    hanging forever on futures no worker will pop), and later submits are
+    rejected with the same error."""
+    from dask_sql_tpu.serving import ShutdownError
+
+    rt = ServingRuntime(workers=1, bounds={"interactive": 8, "batch": 8})
+    gate = threading.Event()
+    started = threading.Event()
+
+    def inflight(t):
+        started.set()
+        gate.wait(10)
+        return "inflight-done"
+
+    _, f1, _ = rt.submit(inflight)
+    assert started.wait(10)
+    _, f2, _ = rt.submit(lambda t: "queued-1")
+    _, f3, _ = rt.submit(lambda t: "queued-2", priority_class="batch")
+
+    release = threading.Timer(0.2, gate.set)
+    release.start()
+    try:
+        rt.shutdown(wait=True, timeout=10)
+    finally:
+        release.cancel()
+        gate.set()
+    assert f1.result(10) == "inflight-done"
+    for fut in (f2, f3):
+        with pytest.raises(ShutdownError) as ei:
+            fut.result(1)  # already resolved: must not block
+        assert ei.value.retryable  # clients may resubmit elsewhere
+    with pytest.raises(ShutdownError):
+        rt.submit(lambda t: "too-late")
+    assert rt.metrics.counter("serving.shutdown_shed") == 2
+    # admission gauges drained back to zero (no leaked waiting counts)
+    snap = rt.admission.snapshot()
+    assert snap["waiting"] == {"interactive": 0, "batch": 0}
+    assert snap["running"] == {"interactive": 0, "batch": 0}
+
+
 def test_deadline_cancels_executor_mid_plan():
     """The executor's per-node checkpoints observe the serving ticket."""
     from dask_sql_tpu.serving import runtime as rt_mod
