@@ -170,9 +170,44 @@ def run_inject_smoke():
         raise SystemExit(1)
 
 
+def run_lint_smoke():
+    """`bench.py --lint`: static-analysis smoke.
+
+    Runs the engine self-lint (must be clean) and an `EXPLAIN LINT` of the
+    benchmark query (must verify with zero errors), printing one JSON line.
+    Pure host work — safe to run on every change without touching devices.
+    """
+    from dask_sql_tpu.analysis import self_lint
+
+    findings = self_lint()
+    for f in findings:
+        print(f.format(), flush=True)
+
+    _ensure_backend()
+    from dask_sql_tpu import Context
+
+    c = Context()
+    c.create_table("lineitem", gen_lineitem(10_000, seed=0))
+    rows = list(c.sql("EXPLAIN LINT " + QUERY, return_futures=False)["LINT"])
+    errors = sum(1 for r in rows if r.startswith("error["))
+    ok = not findings and errors == 0
+    print(json.dumps({
+        "metric": "static_analysis_smoke",
+        "ok": bool(ok),
+        "self_lint_findings": len(findings),
+        "explain_lint_errors": errors,
+        "explain_lint_rows": len(rows),
+    }), flush=True)
+    if not ok:
+        raise SystemExit(1)
+
+
 def main():
     import sys
 
+    if "--lint" in sys.argv:
+        run_lint_smoke()
+        return
     if "--inject" in sys.argv:
         run_inject_smoke()
         return

@@ -1,0 +1,47 @@
+"""Static analysis: plan verifier + engine self-lint.
+
+Two halves (see docs/analysis.md):
+
+- **Plan verifier** (`verifier.py`): an independent re-inference of every
+  plan node's output schema (names, dtype categories, nullability, shape
+  buckets) cross-checked against what the bound plan *declares* and what
+  the physical layer will emit.  Inconsistencies raise a taxonomy
+  ``PlanError`` at bind time instead of surfacing as a mid-execution
+  compile failure; statically-doomed compiled rungs (radix-domain
+  overflow of the ``1 << 22`` gate in `physical/compiled*.py`) are
+  marked on the plan so the degradation ladder skips them without
+  attempting, and recompilation hazards (shapes outside the power-of-two
+  bucketing scheme) are reported by ``EXPLAIN LINT``.
+
+- **Engine self-lint** (`selflint.py`): an AST analyzer over the engine's
+  own source (``python -m dask_sql_tpu.analysis --self``) with rules for
+  broad exception handlers that can swallow taxonomy errors (DSQL101),
+  lock-coverage gaps on the serving path (DSQL201), and host-sync calls
+  inside jit-traced code (DSQL301).  Run as a tier-1 test so regressions
+  fail CI.
+"""
+from .findings import Finding, SEV_ERROR, SEV_INFO, SEV_WARN
+from .selflint import LintFinding, RULES, lint_paths, self_lint
+from .verifier import (
+    PlanVerdict,
+    RADIX_DOMAIN_LIMIT,
+    check_plan,
+    verify_and_apply,
+    verify_plan,
+)
+
+__all__ = [
+    "Finding",
+    "LintFinding",
+    "PlanVerdict",
+    "RADIX_DOMAIN_LIMIT",
+    "RULES",
+    "SEV_ERROR",
+    "SEV_INFO",
+    "SEV_WARN",
+    "check_plan",
+    "lint_paths",
+    "self_lint",
+    "verify_and_apply",
+    "verify_plan",
+]

@@ -1,0 +1,48 @@
+"""CLI: ``python -m dask_sql_tpu.analysis --self`` (engine self-lint) or
+``python -m dask_sql_tpu.analysis path.py ...`` (lint specific files).
+
+Exit code 0 = clean, 1 = findings, 2 = bad invocation.  CI runs ``--self``
+(also wired as a tier-1 test in tests/unit/test_analysis.py and the
+``bench.py --lint`` smoke mode).
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+
+from .selflint import RULES, lint_paths, package_files, self_lint
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m dask_sql_tpu.analysis",
+        description="Static self-lint for the dask_sql_tpu engine")
+    parser.add_argument("--self", dest="self_mode", action="store_true",
+                        help="lint the installed engine package")
+    parser.add_argument("--rules", action="store_true",
+                        help="list rule ids and exit")
+    parser.add_argument("paths", nargs="*", help="python files to lint")
+    args = parser.parse_args(argv)
+
+    if args.rules:
+        for rule, doc in sorted(RULES.items()):
+            print(f"{rule}: {doc}")
+        return 0
+    if not args.self_mode and not args.paths:
+        parser.print_usage(sys.stderr)
+        return 2
+
+    if args.self_mode:
+        findings = self_lint()
+        n_files = len(package_files())
+    else:
+        findings = lint_paths(args.paths)
+        n_files = len(args.paths)
+    for f in findings:
+        print(f.format())
+    print(f"self-lint: {len(findings)} finding(s) in {n_files} file(s)")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,406 @@
+"""Engine self-lint: AST rules for the hazards the serving path introduced.
+
+The serving runtime (PR 1) made the engine multi-threaded and the
+resilience layer (PR 2) made exception *types* load-bearing — a swallowed
+taxonomy error or an off-lock mutation is now a correctness bug, not a
+style issue.  These rules encode the three hazard families as static
+checks run by CI (``python -m dask_sql_tpu.analysis --self`` and the
+tier-1 test in tests/unit/test_analysis.py):
+
+DSQL101  broad-except
+    ``except Exception`` / ``except BaseException`` / bare ``except:``
+    can swallow taxonomy ``QueryError``s (deadline expiry, cancellation,
+    resource exhaustion) that policy layers upstream must see.  A handler
+    passes if an earlier clause of the same ``try`` re-raises the
+    taxonomy (``except QueryError: raise``), if the broad handler itself
+    unconditionally re-raises, or if the site carries a
+    ``# dsql: allow-broad-except`` suppression with its reason.
+
+DSQL201  lock-coverage
+    In a class that owns a ``threading.Lock``/``RLock``/``Condition``,
+    an attribute mutated under ``with self.<lock>:`` somewhere must be
+    mutated under it *everywhere* (outside ``__init__``): one unguarded
+    site re-introduces the race the lock exists to prevent.  Methods
+    named ``*_locked`` are exempt by convention (the caller holds the
+    lock); suppress any other deliberate site with
+    ``# dsql: allow-unlocked``.
+
+DSQL301  host-sync
+    ``.item()`` / ``np.asarray`` / ``np.array`` / ``jax.device_get`` /
+    ``.block_until_ready()`` inside jit-traced code either fails to
+    trace or silently forces a device round-trip per call.  Trace scope
+    is detected structurally: functions whose name is passed to
+    ``jax.jit(...)`` / ``pallas_call`` / ``shard_map`` in the same
+    module, functions decorated with a jit, and closure factories'
+    returned inner functions in the compiled modules.  Suppress
+    plan-time metadata pulls with ``# dsql: allow-host-sync``.
+
+Suppression comments live on the offending line or the line above it, so
+``git blame`` keeps the reason next to the decision.
+"""
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+RULES: Dict[str, str] = {
+    "DSQL101": "broad exception handler can swallow taxonomy QueryErrors",
+    "DSQL201": "lock-guarded attribute mutated outside its lock",
+    "DSQL301": "host-sync call inside jit-traced code",
+}
+
+_SUPPRESS = {
+    "DSQL101": "dsql: allow-broad-except",
+    "DSQL201": "dsql: allow-unlocked",
+    "DSQL301": "dsql: allow-host-sync",
+}
+
+#: modules whose closure factories build jit-traced kernels: a nested def
+#: returned by its parent there is trace-scoped even without a visible
+#: jax.jit(<name>) call site (the jit wraps the factory's return value)
+_TRACE_FACTORY_SUFFIXES = (
+    os.path.join("physical", "compiled.py"),
+    os.path.join("physical", "compiled_join.py"),
+    os.path.join("physical", "compiled_select.py"),
+    os.path.join("physical", "streaming.py"),
+)
+
+_JIT_CALL_NAMES = {"jit", "pallas_call", "shard_map", "pmap", "checkpoint",
+                   "remat", "custom_vjp", "vmap"}
+_MUTATOR_METHODS = {
+    "append", "appendleft", "add", "extend", "insert", "remove", "discard",
+    "pop", "popleft", "popitem", "clear", "update", "setdefault",
+    "move_to_end", "rotate",
+}
+#: exception class names that mean "taxonomy error" in a re-raise clause
+#: (resilience/errors.py roots + the planner exceptions rebased under them)
+_TAXONOMY_NAMES = {"QueryError", "ParseError", "ParsingException", "LexError",
+                   "BindError", "BindingError", "PlanError"}
+
+
+@dataclass(frozen=True)
+class LintFinding:
+    rule: str
+    path: str
+    line: int
+    message: str
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} {self.message}"
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+def _suppressed(lines: Sequence[str], lineno: int, rule: str) -> bool:
+    token = _SUPPRESS[rule]
+    for ln in (lineno, lineno - 1):
+        if 1 <= ln <= len(lines) and token in lines[ln - 1]:
+            return True
+    return False
+
+
+def _name_of(node: ast.expr) -> Optional[str]:
+    """Dotted name of an expression, e.g. ``jax.jit`` -> "jax.jit"."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = _name_of(node.value)
+        return f"{base}.{node.attr}" if base else None
+    if isinstance(node, ast.Call):
+        # functools.partial(jax.jit, ...) — look through to the target
+        return _name_of(node.func)
+    return None
+
+
+def _is_jitlike(name: Optional[str]) -> bool:
+    return name is not None and name.split(".")[-1] in _JIT_CALL_NAMES
+
+
+def _self_attr(node: ast.expr) -> Optional[str]:
+    """'x' for expressions rooted at ``self.x`` (any depth), else None."""
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        if (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self"):
+            return node.attr
+        node = node.value
+    return None
+
+
+# ---------------------------------------------------------------------------
+# DSQL101 — broad-except
+# ---------------------------------------------------------------------------
+def _broad_names(h: ast.ExceptHandler) -> bool:
+    if h.type is None:
+        return True
+    exprs = h.type.elts if isinstance(h.type, ast.Tuple) else [h.type]
+    for e in exprs:
+        name = _name_of(e)
+        if name and name.split(".")[-1] in ("Exception", "BaseException"):
+            return True
+    return False
+
+
+def _reraises_taxonomy(try_node: ast.Try, handler: ast.ExceptHandler) -> bool:
+    """True when the broad handler cannot swallow a taxonomy error: an
+    earlier clause catches QueryError and re-raises, or the broad handler
+    body itself ends in a taxonomy-preserving ``raise`` — bare, a taxonomy
+    class, or ``classify(...)`` (the idempotent taxonomy wrapper).  A
+    ``raise SomeOtherError(...)`` does NOT pass: re-wrapping strips the
+    error's code/retryable/degradable semantics, which is the hazard."""
+    for h in try_node.handlers:
+        if h is handler:
+            break
+        exprs = (h.type.elts if isinstance(h.type, ast.Tuple)
+                 else [h.type] if h.type is not None else [])
+        for e in exprs:
+            name = _name_of(e)
+            if name and name.split(".")[-1] in _TAXONOMY_NAMES:
+                if any(isinstance(s, ast.Raise) for s in h.body):
+                    return True
+    last = handler.body[-1] if handler.body else None
+    if not isinstance(last, ast.Raise):
+        return False
+    if last.exc is None:
+        return True  # bare re-raise
+    name = _name_of(last.exc)  # looks through Call to its target
+    return name is not None and name.split(".")[-1] in (
+        _TAXONOMY_NAMES | {"classify"})
+
+
+def _check_broad_except(tree: ast.AST, path: str,
+                        lines: Sequence[str]) -> List[LintFinding]:
+    out: List[LintFinding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Try):
+            continue
+        for h in node.handlers:
+            if not _broad_names(h):
+                continue
+            if _reraises_taxonomy(node, h):
+                continue
+            if _suppressed(lines, h.lineno, "DSQL101"):
+                continue
+            caught = ("bare except" if h.type is None
+                      else f"except {_name_of(h.type) or '...'}")
+            out.append(LintFinding(
+                "DSQL101", path, h.lineno,
+                f"{caught} can swallow taxonomy QueryErrors; re-raise "
+                f"them first (`except QueryError: raise`) or annotate "
+                f"`# {_SUPPRESS['DSQL101']}` with the reason"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# DSQL201 — lock coverage
+# ---------------------------------------------------------------------------
+def _lock_attrs(cls: ast.ClassDef) -> Set[str]:
+    """Attributes assigned a threading lock anywhere in the class."""
+    locks: Set[str] = set()
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Assign):
+            continue
+        name = _name_of(node.value.func) if isinstance(
+            node.value, ast.Call) else None
+        if name is None or name.split(".")[-1] not in (
+                "Lock", "RLock", "Condition"):
+            continue
+        for t in node.targets:
+            attr = _self_attr(t)
+            if attr is not None:
+                locks.add(attr)
+    return locks
+
+
+def _mutations(fn: ast.AST, locks: Set[str]):
+    """Yield (attr, lineno, guarded) for every ``self.<attr>`` mutation in
+    one function body, tracking enclosing ``with self.<lock>:`` blocks.
+    Nested defs are skipped — a closure runs on its own schedule and is
+    judged where it mutates, not where it is defined."""
+
+    def visit(node: ast.AST, guarded: bool):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)) and node is not fn:
+            return
+        if isinstance(node, ast.With):
+            has_lock = any(
+                _self_attr(item.context_expr) in locks
+                for item in node.items)
+            for child in node.body:
+                visit(child, guarded or has_lock)
+            return
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                if isinstance(t, (ast.Attribute, ast.Subscript)):
+                    attr = _self_attr(t)
+                    if attr is not None and attr not in locks:
+                        yield_list.append((attr, node.lineno, guarded))
+        if isinstance(node, ast.Call):
+            f = node.func
+            if (isinstance(f, ast.Attribute)
+                    and f.attr in _MUTATOR_METHODS):
+                attr = _self_attr(f.value)
+                if attr is not None and attr not in locks:
+                    yield_list.append((attr, node.lineno, guarded))
+        for child in ast.iter_child_nodes(node):
+            visit(child, guarded)
+
+    yield_list: List[Tuple[str, int, bool]] = []
+    for stmt in getattr(fn, "body", []):
+        visit(stmt, False)
+    return yield_list
+
+
+def _check_lock_coverage(tree: ast.AST, path: str,
+                         lines: Sequence[str]) -> List[LintFinding]:
+    out: List[LintFinding] = []
+    for cls in ast.walk(tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        locks = _lock_attrs(cls)
+        if not locks:
+            continue
+        per_method: List[Tuple[str, List[Tuple[str, int, bool]]]] = []
+        for item in cls.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                per_method.append((item.name, _mutations(item, locks)))
+        guarded_attrs = {
+            attr
+            for name, muts in per_method if name != "__init__"
+            for attr, _, guarded in muts if guarded
+        }
+        for name, muts in per_method:
+            if name == "__init__" or name.endswith("_locked"):
+                continue
+            for attr, lineno, guarded in muts:
+                if guarded or attr not in guarded_attrs:
+                    continue
+                if _suppressed(lines, lineno, "DSQL201"):
+                    continue
+                out.append(LintFinding(
+                    "DSQL201", path, lineno,
+                    f"self.{attr} is mutated under a lock elsewhere in "
+                    f"{cls.name} but off-lock here; guard it or annotate "
+                    f"`# {_SUPPRESS['DSQL201']}` (e.g. caller holds the "
+                    f"lock)"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# DSQL301 — host sync inside traced code
+# ---------------------------------------------------------------------------
+def _traced_functions(tree: ast.AST, path: str) -> List[ast.AST]:
+    """Functions whose bodies run under jax tracing."""
+    jit_targets: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and _is_jitlike(_name_of(node.func)):
+            for arg in node.args[:1]:
+                name = _name_of(arg)
+                if name and "." not in name:
+                    jit_targets.add(name)
+    traced: List[ast.AST] = []
+    factory_module = path.endswith(_TRACE_FACTORY_SUFFIXES)
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if node.name in jit_targets:
+            traced.append(node)
+            continue
+        if any(_is_jitlike(_name_of(d)) for d in node.decorator_list):
+            traced.append(node)
+            continue
+        if factory_module:
+            # closure-factory convention: `fn = ...; return fn` with the
+            # caller jitting the returned closure (CompiledAggregate._build)
+            for parent in ast.walk(tree):
+                if (isinstance(parent, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef))
+                        and node in ast.walk(parent) and node is not parent
+                        and any(isinstance(s, ast.Return)
+                                and _name_of(s.value) == node.name
+                                for s in parent.body)):
+                    traced.append(node)
+                    break
+    return traced
+
+
+_HOST_SYNC_CALLS = {"np.asarray", "numpy.asarray", "np.array",
+                    "numpy.array", "jax.device_get", "device_get"}
+_HOST_SYNC_METHODS = {"item", "block_until_ready", "tolist"}
+
+
+def _check_host_sync(tree: ast.AST, path: str,
+                     lines: Sequence[str]) -> List[LintFinding]:
+    out: List[LintFinding] = []
+    seen: Set[int] = set()
+    for fn in _traced_functions(tree, path):
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call) or id(node) in seen:
+                continue
+            name = _name_of(node.func)
+            hit = None
+            if name in _HOST_SYNC_CALLS:
+                hit = name
+            elif (isinstance(node.func, ast.Attribute)
+                  and node.func.attr in _HOST_SYNC_METHODS
+                  and not node.args):
+                hit = f".{node.func.attr}()"
+            if hit is None:
+                continue
+            seen.add(id(node))
+            if _suppressed(lines, node.lineno, "DSQL301"):
+                continue
+            out.append(LintFinding(
+                "DSQL301", path, node.lineno,
+                f"{hit} forces a host sync inside jit-traced code; hoist "
+                f"it to plan/compile time or annotate "
+                f"`# {_SUPPRESS['DSQL301']}`"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+def lint_source(source: str, path: str) -> List[LintFinding]:
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:
+        return [LintFinding("DSQL000", path, e.lineno or 0,
+                            f"syntax error: {e.msg}")]
+    lines = source.splitlines()
+    out: List[LintFinding] = []
+    out += _check_broad_except(tree, path, lines)
+    out += _check_lock_coverage(tree, path, lines)
+    out += _check_host_sync(tree, path, lines)
+    return sorted(out, key=lambda f: (f.path, f.line, f.rule))
+
+
+def lint_paths(paths: Iterable[str]) -> List[LintFinding]:
+    findings: List[LintFinding] = []
+    for path in paths:
+        with open(path, "r", encoding="utf-8") as f:
+            findings.extend(lint_source(f.read(), path))
+    return findings
+
+
+def package_files(root: Optional[str] = None) -> List[str]:
+    """Every .py file of the engine package (the --self target)."""
+    if root is None:
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out: List[str] = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                out.append(os.path.join(dirpath, fn))
+    return sorted(out)
+
+
+def self_lint(root: Optional[str] = None) -> List[LintFinding]:
+    """Lint the engine's own source tree; [] means CI-clean."""
+    return lint_paths(package_files(root))

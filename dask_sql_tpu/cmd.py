@@ -75,7 +75,7 @@ def _run_query(context, sql: str):
             print(result.compute())
         elapsed = time.perf_counter() - t0
         print(f"({elapsed:.3f}s)")
-    except Exception as e:  # noqa: BLE001 - REPL surfaces all errors
+    except Exception as e:  # dsql: allow-broad-except — REPL surfaces all errors
         print(f"ERROR: {e}", file=sys.stderr)
 
 

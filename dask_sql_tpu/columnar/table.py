@@ -195,7 +195,7 @@ class Table:
                 bufs.append(c.validity)
         try:
             host = packed_host_arrays(bufs)
-        except Exception:  # noqa: BLE001 - backend pack quirk -> per-column
+        except Exception:  # dsql: allow-broad-except — backend pack quirk -> per-column
             host = None
         if host is None:
             return {n: c.to_numpy() for n, c in cols.items()}

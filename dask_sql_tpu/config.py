@@ -49,6 +49,13 @@ DEFAULTS: Dict[str, Any] = {
     "sql.distributed.join": "auto",
     "sql.distributed.sort": "auto",  # range-partition sort over the mesh
     "sql.debug.validate_take": False,  # assert gather-index invariants (host sync per gather)
+    # Static plan verification (analysis/verifier.py, docs/analysis.md):
+    #   "on"     cross-check every bound plan; error findings raise a
+    #            taxonomy PlanError at bind time, doomed compiled rungs are
+    #            skipped by the ladder (analysis.rung_skip.* metrics)
+    #   "strict" warn findings (e.g. radix-domain overflow) also raise
+    #   "off"    no verification
+    "analysis.verify": "on",
     # Serving runtime (serving/) — admission control, result cache, metrics.
     # See docs/serving.md for semantics; all keys are read when the runtime
     # or Context is constructed (per-query config_options do not re-size

@@ -16,6 +16,7 @@ jax/XLA kernels through the physical plugin registries.
 from __future__ import annotations
 
 import logging
+import threading
 import time
 from collections import OrderedDict
 from typing import Any, Callable, Dict, List, Optional, Tuple, Union
@@ -162,6 +163,11 @@ class Context:
         #: bound+optimized plans for repeated SQL text (keyed on the catalog
         #: signature, so any table/view/function/config change re-plans)
         self._plan_cache: "OrderedDict[Tuple, List[Any]]" = OrderedDict()
+        #: guards _plan_cache and _catalog_buf_cache: one Context serves
+        #: every worker thread of the Presto server, and an unguarded
+        #: OrderedDict move_to_end/popitem pair racing across threads
+        #: corrupts the LRU order or KeyErrors (self-lint rule DSQL201)
+        self._plan_lock = threading.Lock()
         #: bumped on every view/function (re)definition or drop
         self._catalog_serial = 0
         from .serving.cache import ResultCache
@@ -251,11 +257,19 @@ class Context:
             # DDL / ML statements: side effects or model-object state that
             # the catalog signature does not fully version
             return None
+        if isinstance(plan, plan_nodes.Explain) and plan.analyze:
+            # EXPLAIN ANALYZE must re-execute and re-profile every time —
+            # serving a cached trace would report a run that never happened
+            return None
         from .datacontainer import LazyParquetContainer
 
         stack = [plan]
         while stack:
             node = stack.pop()
+            if isinstance(node, plan_nodes.Sample) and node.seed is None:
+                # unseeded TABLESAMPLE draws fresh randomness per execution;
+                # caching it would freeze the first draw for the TTL window
+                return None
             if isinstance(node, plan_nodes.TableScan):
                 dc = self.schema.get(node.schema_name, SchemaContainer(
                     node.schema_name)).tables.get(node.table_name)
@@ -285,7 +299,8 @@ class Context:
             key = tuple(parts)
             hash(key)
             return key
-        except Exception:  # unhashable config / unprintable plan
+        except Exception:  # dsql: allow-broad-except — unhashable config /
+            # unprintable plan just means this result is uncacheable
             return None
 
     # ------------------------------------------------------------ tables
@@ -480,10 +495,14 @@ class Context:
             if not isinstance(sql, str):
                 raise ValueError("sql must be a string (plans are internal here)")
             key = self._plan_cache_key(sql, config_options)
-            plans = self._plan_cache.get(key) if key is not None else None
+            plans = None
+            if key is not None:
+                with self._plan_lock:
+                    plans = self._plan_cache.get(key)
+                    if plans is not None:
+                        self._plan_cache.move_to_end(key)
             result = None
             if plans is not None:
-                self._plan_cache.move_to_end(key)
                 self.metrics.inc("query.plan_cache.hit")
                 for plan in plans:
                     result = self._run_plan(plan, config_options)
@@ -501,9 +520,10 @@ class Context:
                 # only single-statement texts are cacheable — a script's later
                 # plans were bound against mid-script catalog state
                 if key is not None and len(plans) == 1:
-                    self._plan_cache[key] = plans
-                    while len(self._plan_cache) > self._PLAN_CACHE_CAP:
-                        self._plan_cache.popitem(last=False)
+                    with self._plan_lock:
+                        self._plan_cache[key] = plans
+                        while len(self._plan_cache) > self._PLAN_CACHE_CAP:
+                            self._plan_cache.popitem(last=False)
             if result is None:
                 return None
             if return_futures:
@@ -550,7 +570,8 @@ class Context:
             plan = plan.input
         try:
             self._render_plan_png(plan, filename)
-        except Exception:  # no matplotlib / headless issues: text fallback
+        except Exception:  # dsql: allow-broad-except — no matplotlib /
+            # headless issues: text fallback below renders instead
             logger.warning("plan image rendering unavailable; writing text",
                            exc_info=True)
             path = filename if filename.endswith(".txt") else filename + ".txt"
@@ -650,17 +671,41 @@ class Context:
             plan = binder.bind_statement(stmt)
         if want_opt:
             from .planner.optimizer.driver import optimize_core, optimize_post
+            from .resilience.errors import QueryError
 
             try:
                 if not core_optimized:
                     plan = optimize_core(plan, self.config, catalog)
                 plan = optimize_post(plan, self.config, catalog, context=self,
                                      skip_reorder=core_optimized)
+            except QueryError:
+                # taxonomy errors (deadline expiry at a checkpoint, resource
+                # exhaustion in a plan-time data read) carry policy upstream
+                # layers act on — they must cross this boundary, not vanish
+                # into a silent unoptimized-plan fallback
+                raise
             except Exception:
                 # parity: optimizer failure falls back to the unoptimized plan
-                # (context.py:857-864)
+                # (context.py:857-864), metric-counted so a lived-with
+                # planner bug shows up in SHOW METRICS instead of only logs
+                self.metrics.inc("planner.optimize.fallback")
                 logger.warning("Optimization failed; using unoptimized plan",
                                exc_info=True)
+        verify_mode = str(self.config.get("analysis.verify", "on")).lower()
+        # plain EXPLAIN / EXPLAIN LINT never execute their input (the LINT
+        # plugin runs its own verification walk), so only executing plans —
+        # including EXPLAIN ANALYZE — pay the bind-time check
+        wants_verify = not (isinstance(plan, plan_nodes.Explain)
+                            and not plan.analyze)
+        if wants_verify and verify_mode not in ("off", "false", "0", "none"):
+            from . import analysis
+
+            # static plan verification (docs/analysis.md): schema/dtype
+            # cross-check raises taxonomy PlanError here — at bind time —
+            # and statically-doomed compiled rungs are marked on the plan
+            # so the degradation ladder never attempts them
+            analysis.verify_and_apply(plan, self,
+                                      strict=(verify_mode == "strict"))
         return plan
 
     def _encoded_catalog(self, catalog) -> Optional[bytes]:
@@ -676,9 +721,11 @@ class Context:
                         getattr(cont.statistics.get(tname), "row_count", None))
                        for sname, cont in sorted(self.schema.items())
                        for tname, dc in sorted(cont.tables.items())))
-        except Exception:
+        except Exception:  # dsql: allow-broad-except — unhashable/odd stats
+            # only disable caching for this call; encoding still runs
             key = None
-        cached = getattr(self, "_catalog_buf_cache", None)
+        with self._plan_lock:
+            cached = getattr(self, "_catalog_buf_cache", None)
         if key is not None and cached is not None and cached[0] == key:
             return cached[1]
         from .planner.native_bridge import encode_catalog
@@ -688,7 +735,8 @@ class Context:
         except KeyError:
             buf = None
         if key is not None:
-            self._catalog_buf_cache = (key, buf)
+            with self._plan_lock:
+                self._catalog_buf_cache = (key, buf)
         return buf
 
     def _prepare_catalog(self) -> Catalog:
@@ -850,8 +898,8 @@ def _to_sql_type(t) -> SqlType:
         return parse_sql_type(t)
     try:
         return np_to_sql(np.dtype(t))
-    except Exception:
-        pass
+    except (TypeError, ValueError, KeyError):
+        pass  # not a numpy dtype spec: try the python scalar mapping
     mapping = {int: SqlType.BIGINT, float: SqlType.DOUBLE, str: SqlType.VARCHAR,
                bool: SqlType.BOOLEAN}
     if t in mapping:

@@ -40,7 +40,8 @@ class InputUtil:
         for plugin in cls._plugins:
             try:
                 matches = plugin.is_correct_input(input_item, table_name, format=format, **kwargs)
-            except Exception:
+            except Exception:  # dsql: allow-broad-except — a plugin probe
+                # declining (or crashing) just means "not my input type"
                 matches = False
             if matches:
                 dc = plugin.to_dc(input_item, table_name, format=format,

@@ -186,7 +186,8 @@ class HiveInputPlugin(BaseInputPlugin):
         try:
             cursor.execute(f"SHOW PARTITIONS {schema}.{hive_table}")
             partitions = [r[0] for r in cursor.fetchall()]
-        except Exception:
+        except Exception:  # dsql: allow-broad-except — hive metastore
+            # without partition support: treat as unpartitioned
             partitions = []
         plugin = LocationInputPlugin()
         if not partitions:

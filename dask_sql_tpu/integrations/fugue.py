@@ -65,7 +65,7 @@ if _HAS_FUGUE:  # pragma: no cover - optional dependency
 
     try:  # auto-register like the reference's @run_at_def
         register_engines()
-    except Exception:  # pragma: no cover - registration best-effort
+    except Exception:  # dsql: allow-broad-except — registration best-effort
         pass
 
 else:

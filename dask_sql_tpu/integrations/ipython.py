@@ -35,5 +35,6 @@ def ipython_integration(context, auto_include: bool = False,
                 "if (window.IPython && IPython.CodeCell) {"
                 "IPython.CodeCell.options_default.highlight_modes"
                 "['magic_text/x-sql'] = {'reg': [/^%%sql/]};}"))
-        except Exception:
+        except Exception:  # dsql: allow-broad-except — notebook JS
+            # injection is cosmetic; failing it must not break the magic
             pass

@@ -51,7 +51,14 @@ def key_arrays(cols: Sequence[Column]) -> List[jnp.ndarray]:
     return out
 
 
-def radix_gid(cols: Sequence[Column], max_domain: int = 1 << 22):
+#: mixed-radix group-id domain gate shared by every radix planner
+#: (CompiledAggregate, compiled-join _plan_radix, radix_gid) and the
+#: static plan verifier (analysis/verifier.py) — one constant so the
+#: bind-time verdict and the compile-time gate can never drift
+RADIX_DOMAIN_LIMIT = 1 << 22
+
+
+def radix_gid(cols: Sequence[Column], max_domain: int = RADIX_DOMAIN_LIMIT):
     """Sort-free group ids for small-domain keys (dictionary codes / bools).
 
     When every key column is dictionary-encoded (or boolean), group ids are a

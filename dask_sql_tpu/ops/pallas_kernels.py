@@ -183,7 +183,7 @@ def pallas_available() -> bool:
             out = segsum_pallas(jnp.zeros(16, jnp.int32),
                                 jnp.ones((16, 1), jnp.float32), 4)
             _PALLAS_OK = bool(abs(float(out[0, 0]) - 16.0) < 1e-6)
-        except Exception as e:  # noqa: BLE001 - any lowering failure fences it
+        except Exception as e:  # dsql: allow-broad-except — any lowering failure fences it
             logger.warning("pallas segsum unavailable on this backend: %s", e)
             _PALLAS_OK = False
     return _PALLAS_OK

@@ -74,7 +74,8 @@ def array_is_sharded(arr) -> bool:
         return False
     try:
         return len(sh.device_set) > 1 and not sh.is_fully_replicated
-    except Exception as e:  # deleted buffer / backend teardown mid-query
+    except Exception as e:  # dsql: allow-broad-except — deleted buffer /
+        # backend teardown mid-query; metric-counted fallback below
         # treated as unsharded (single-program path still computes the right
         # answer) — but say so instead of silently swallowing the probe
         logger.debug("sharding probe failed on %r: %s; treating as "

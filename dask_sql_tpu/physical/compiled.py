@@ -764,9 +764,9 @@ class CompiledAggregate:
             else:
                 raise _Unsupported("non-dictionary group key")
             gcols.append(c)
-        from ..ops.grouping import resolve_int_bounds
+        from ..ops.grouping import RADIX_DOMAIN_LIMIT, resolve_int_bounds
 
-        spans = resolve_int_bounds(pending, 1 << 22)
+        spans = resolve_int_bounds(pending, RADIX_DOMAIN_LIMIT)
         if spans is None:
             raise _Unsupported("integer key range too large")
         for slot, (span, lo) in spans.items():
@@ -775,7 +775,7 @@ class CompiledAggregate:
         domain = 1
         for r in radices:
             domain *= r
-        if domain > (1 << 22):
+        if domain > RADIX_DOMAIN_LIMIT:
             raise _Unsupported("group domain too large")
         self.domain = max(domain, 1)
         self.radices = radices

@@ -329,9 +329,9 @@ class CompiledJoinAggregate:
                              "off": None, "col": col})
             else:
                 raise _Unsupported("group key not radix-encodable")
-        from ..ops.grouping import resolve_int_bounds
+        from ..ops.grouping import RADIX_DOMAIN_LIMIT, resolve_int_bounds
 
-        spans = resolve_int_bounds(pending, 1 << 22)
+        spans = resolve_int_bounds(pending, RADIX_DOMAIN_LIMIT)
         if spans is None:
             raise _Unsupported("integer key range too large")
         for slot, (span, lo) in spans.items():
@@ -339,7 +339,7 @@ class CompiledJoinAggregate:
             spec[slot]["off"] = lo
         for entry in spec:
             domain *= entry["r"]
-            if domain > (1 << 22):
+            if domain > RADIX_DOMAIN_LIMIT:
                 raise _Unsupported("group domain too large")
         return spec
 

@@ -357,14 +357,26 @@ class ExplainPlugin(BaseRelPlugin):
     class_name = "Explain"
 
     def convert(self, rel: p.Explain, executor) -> Table:
-        if rel.analyze:
+        if getattr(rel, "lint", False):
+            # EXPLAIN LINT: static plan verifier findings (analysis/),
+            # errors and doomed-rung warnings first, then shape/recompile
+            # advisories — nothing executes
+            from ....analysis import verify_plan
+
+            verdict = verify_plan(rel.input, context=executor.context,
+                                  collect_info=True)
+            executor.context.metrics.inc("analysis.explain_lint")
+            rows = verdict.format_rows()
+            lines = np.array(rows, dtype=object)
+        elif rel.analyze:
             # EXPLAIN ANALYZE: run the plan with per-node tracing
             from ...executor import Executor
 
             traced = Executor(executor.context, trace=True)
             traced.execute(rel.input)
             text = traced.tracer.root.format() if traced.tracer.root else ""
+            lines = np.array(text.split("\n"), dtype=object)
         else:
-            text = rel.input.explain()
-        lines = np.array(text.split("\n"), dtype=object)
-        return Table({"PLAN": Column.from_numpy(lines)}, len(lines))
+            lines = np.array(rel.input.explain().split("\n"), dtype=object)
+        col = rel.schema[0].name if rel.schema else "PLAN"
+        return Table({col: Column.from_numpy(lines)}, len(lines))

@@ -135,7 +135,10 @@ class Binder:
             return plan
         if isinstance(stmt, a.ExplainStatement):
             plan, _ = self.bind_query(stmt.query)
-            return p.Explain(plan, [Field("PLAN", SqlType.VARCHAR)], stmt.analyze)
+            lint = getattr(stmt, "lint", False)
+            col = "LINT" if lint else "PLAN"
+            return p.Explain(plan, [Field(col, SqlType.VARCHAR)],
+                             stmt.analyze, lint)
         if isinstance(stmt, a.CreateTableWith):
             return p.CreateTableNode([], stmt.name, stmt.kwargs, stmt.if_not_exists, stmt.or_replace)
         if isinstance(stmt, a.CreateTableAs):

@@ -33,7 +33,7 @@ def _build() -> bool:
         subprocess.run(["make", "-s"], cwd=_NATIVE_DIR, check=True,
                        capture_output=True, timeout=120)
         return os.path.exists(_LIB_PATH)
-    except Exception as e:  # noqa: BLE001 - any failure means fallback
+    except Exception as e:  # dsql: allow-broad-except — any failure means fallback
         logger.debug("native build failed: %s", e)
         return False
 
@@ -150,7 +150,9 @@ def _get_parser_lib():
             ]
             lib.dsql_buf_free.argtypes = [ctypes.POINTER(ctypes.c_uint8)]
             lib.dsql_parser_abi_version.restype = ctypes.c_int32
-            _parser_ok = lib.dsql_parser_abi_version() == 1
+            # grammar version 2 = EXPLAIN LINT; a stale .so predating it
+            # is rejected here so the Python parser handles the syntax
+            _parser_ok = lib.dsql_parser_abi_version() == 2
         except AttributeError:
             _parser_ok = False
     return lib if _parser_ok else None
@@ -467,7 +469,7 @@ def native_parse(sql: str):
         raise ParsingException(f"{msg} at position {pos} (near {ctx!r})")
     try:
         f = _FlatAst(buf)
-    except Exception:  # noqa: BLE001 - corrupt buffer -> Python fallback
+    except Exception:  # dsql: allow-broad-except — corrupt buffer -> Python fallback
         logger.debug("native AST decode failed", exc_info=True)
         return None
     from . import sqlast as a
@@ -524,7 +526,8 @@ def _decode_statement(f: "_FlatAst", sid: int):
     if kind == _K_QUERY_STMT:
         return a.QueryStatement(_decode_select(f, kids[0]))
     if kind == _K_EXPLAIN_STMT:
-        return a.ExplainStatement(_decode_select(f, kids[0]), bool(flags & 1))
+        return a.ExplainStatement(_decode_select(f, kids[0]), bool(flags & 1),
+                                  bool(flags & 2))
     if kind == _K_CREATE_TABLE_WITH:
         return a.CreateTableWith(_decode_qname(f, kids[0]),
                                  _decode_kwargs(f, kids[1]), ine, orr)
@@ -634,7 +637,7 @@ def _get_binder_lib():
                 ctypes.POINTER(ctypes.c_int64),
             ]
             lib.dsql_binder_abi_version.restype = ctypes.c_int32
-            _binder_ok = lib.dsql_binder_abi_version() == 2
+            _binder_ok = lib.dsql_binder_abi_version() == 3
         except AttributeError:
             _binder_ok = False
     return lib if _binder_ok else None
@@ -951,7 +954,7 @@ class _PlanDecoder:
                                   self.fields(kids[1:1 + nf]))
         if kind == _P_EXPLAIN:
             return p.Explain(self.plan(kids[0]), self.fields(kids[1:]),
-                             bool(flags & 1))
+                             bool(flags & 1), bool(flags & 2))
         # ---- DDL / ML custom nodes ----
         ine = bool(flags & 1)
         orr = bool(flags & 2)
@@ -1074,7 +1077,7 @@ def native_bind(sql: str, catalog, cat_buf: Optional[bytes] = None,
     try:
         f = _FlatPlan(buf)
         return _PlanDecoder(f).plan(f.root)
-    except Exception:  # noqa: BLE001 - corrupt buffer -> Python fallback
+    except Exception:  # dsql: allow-broad-except — corrupt buffer -> Python fallback
         logger.debug("native plan decode failed", exc_info=True)
         return None
 
@@ -1104,7 +1107,7 @@ def _get_planner_lib():
                 ctypes.POINTER(ctypes.c_int64),
             ]
             lib.dsql_optimizer_abi_version.restype = ctypes.c_int32
-            _planner_ok = lib.dsql_optimizer_abi_version() == 2
+            _planner_ok = lib.dsql_optimizer_abi_version() == 3
         except AttributeError:
             _planner_ok = False
     return lib if _planner_ok else None
@@ -1168,6 +1171,6 @@ def native_plan(sql: str, catalog, cat_buf: Optional[bytes] = None,
     try:
         f = _FlatPlan(buf)
         return _PlanDecoder(f).plan(f.root)
-    except Exception:  # noqa: BLE001 - corrupt buffer -> Python fallback
+    except Exception:  # dsql: allow-broad-except — corrupt buffer -> Python fallback
         logger.debug("native plan decode failed", exc_info=True)
         return None

@@ -131,7 +131,7 @@ def _inject(fact_side, fact_scan: p.TableScan, fact_key: ColumnRef,
                                fact_scan.schema, fact_scan.projection,
                                list(fact_scan.filters) + [in_filter])
         return _replace_scan(fact_side, fact_scan, new_scan)
-    except Exception as e:  # noqa: BLE001 - DPP must never break planning
+    except Exception as e:  # dsql: allow-broad-except — DPP must never break planning
         logger.debug("DPP skipped: %s", e)
         return None
 

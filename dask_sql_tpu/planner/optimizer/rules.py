@@ -95,8 +95,8 @@ def simplify_expr(e: Expr) -> Expr:
                 try:
                     val = _FOLDABLE[x.op](args[0].value, args[1].value)
                     return Literal(val, x.sql_type)
-                except Exception:
-                    return x
+                except (ArithmeticError, ValueError, TypeError):
+                    return x  # unfoldable literal pair: leave for runtime
         if isinstance(x, Cast) and isinstance(x.arg, Literal):
             from ..binder import _cast_literal
 
@@ -105,8 +105,8 @@ def simplify_expr(e: Expr) -> Expr:
                     return Literal(None, x.sql_type)
                 lit = _cast_literal(Literal(x.arg.value, x.arg.sql_type), x.sql_type)
                 return Literal(lit.value, x.sql_type)
-            except Exception:
-                return x
+            except (ArithmeticError, ValueError, TypeError, KeyError):
+                return x  # uncastable literal: leave the CAST for runtime
         if isinstance(x, Cast) and x.arg.sql_type == x.sql_type:
             return x.arg
         return x
@@ -1012,7 +1012,7 @@ def _try_unwrap_cast(op: str, cast: Cast, lit: Literal):
     try:
         down = _cast_literal(Literal(lit.value, lit.sql_type), src_type)
         back = _cast_literal(Literal(down.value, src_type), lit.sql_type)
-    except Exception:
+    except (ArithmeticError, ValueError, TypeError, KeyError):
         return None
     if back.value != lit.value:
         return None  # lossy literal: e.g. 3.5 compared against an INT column

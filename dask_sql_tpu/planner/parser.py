@@ -59,7 +59,7 @@ class Parser:
             tokens = native_tokenize(sql)
             if tokens is not None:
                 return tokens
-        except Exception:  # noqa: BLE001 - fall back on any native issue
+        except Exception:  # dsql: allow-broad-except — fall back on any native issue
             pass
         return tokenize(sql)
 
@@ -135,8 +135,9 @@ class Parser:
         if self.at_keyword("EXPLAIN"):
             self.next()
             analyze = self.accept_keyword("ANALYZE")
+            lint = False if analyze else self.accept_keyword("LINT")
             self.accept_keyword("VERBOSE")
-            return a.ExplainStatement(self.parse_query(), analyze)
+            return a.ExplainStatement(self.parse_query(), analyze, lint)
         if self.at_keyword("CREATE"):
             return self.parse_create()
         if self.at_keyword("DROP"):

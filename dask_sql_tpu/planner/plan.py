@@ -335,12 +335,13 @@ class Explain(LogicalPlan):
     input: LogicalPlan
     schema: Schema
     analyze: bool = False
+    lint: bool = False  # EXPLAIN LINT: static verifier findings as rows
 
     def inputs(self):
         return [self.input]
 
     def with_inputs(self, inputs):
-        return Explain(inputs[0], self.schema, self.analyze)
+        return Explain(inputs[0], self.schema, self.analyze, self.lint)
 
 
 # ---------------------------------------------------------------------------

@@ -312,8 +312,12 @@ class QueryStatement(Statement):
 
 @dataclass
 class ExplainStatement(Statement):
+    """EXPLAIN [ANALYZE|LINT] <query> — LINT runs the static plan verifier
+    (analysis/verifier.py) and returns its findings as a result set."""
+
     query: Select
     analyze: bool = False
+    lint: bool = False
 
 
 @dataclass

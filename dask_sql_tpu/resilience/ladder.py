@@ -81,6 +81,15 @@ def attempt(executor, rung: str, fn: Callable[[], Optional[T]],
             faults.maybe_inject(inject_site, executor.config)
         return fn()
     metrics = executor.context.metrics
+    # static plan-verifier verdict (analysis/verifier.py): a rung proven
+    # doomed at bind time (e.g. radix-domain overflow of the 1<<22 gate) is
+    # skipped outright — no trace attempt, no breaker charge, no recompile
+    skip_rungs = getattr(rel, "_dsql_skip_rungs", None)
+    if skip_rungs and rung in skip_rungs:
+        metrics.inc("analysis.rung_skip")
+        metrics.inc(f"analysis.rung_skip.{rung}")
+        logger.debug("plan verifier marked rung %s doomed: skipping", rung)
+        return None
     breaker = _breaker_of(executor)
     key = None
     if breaker is not None and rel is not None:
@@ -96,7 +105,9 @@ def attempt(executor, rung: str, fn: Callable[[], Optional[T]],
         out = fn()
     except (KeyboardInterrupt, SystemExit):
         raise
-    except BaseException as exc:
+    except BaseException as exc:  # dsql: allow-broad-except — degradable
+        # taxonomy errors are MEANT to be absorbed here (that is the ladder);
+        # classify() re-raises everything non-degradable below
         # classify() maps raw runtime failures (e.g. an XlaRuntimeError whose
         # message leads with RESOURCE_EXHAUSTED) into the taxonomy; only
         # *degradable* results step down — everything else re-raises as-is so
@@ -140,7 +151,9 @@ def execute_interpreted(executor, rel):
         return executor.execute(rel)
     except (KeyboardInterrupt, SystemExit):
         raise
-    except BaseException as exc:
+    except BaseException as exc:  # dsql: allow-broad-except — only
+        # degradable taxonomy errors are absorbed (CPU re-run); the rest
+        # re-raises right below
         err = classify(exc)
         if not err.degradable or not executor.config.get(
                 "resilience.ladder.cpu_fallback", True):

@@ -83,7 +83,7 @@ def retry_call(fn: Callable[[], T], policy: BackoffPolicy,
     while True:
         try:
             result = fn()
-        except BaseException as exc:  # noqa: BLE001 - classified below
+        except BaseException as exc:  # dsql: allow-broad-except — classified below
             err = classify(exc)
             if not err.retryable or attempt >= policy.max_attempts:
                 raise

@@ -358,7 +358,7 @@ def _make_handler(context, registry: _QueryRegistry, jdbc_meta: bool):
                 self._send(responses.error_results(
                     qid, None, QueryCancelledError(f"query {qid} cancelled")))
                 return
-            except Exception as e:  # noqa: BLE001 - surfaced to the client
+            except Exception as e:  # dsql: allow-broad-except — surfaced to the client
                 # taxonomy QueryErrors (cancel mid-run, deadline expiry,
                 # shutdown shed, compile/execute failures) carry their own
                 # wire code + retryable flag; anything else is classified

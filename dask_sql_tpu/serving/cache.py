@@ -95,7 +95,7 @@ class ResultCache:
             entry = self._entries.get(key)
             if entry is not None and self.ttl_s is not None \
                     and now - entry.created > self.ttl_s:
-                self._drop(key, entry)
+                self._drop_locked(key, entry)
                 self.stats.expirations += 1
                 entry = None
             if entry is None:
@@ -131,7 +131,7 @@ class ResultCache:
             self.stats.inserts += 1
             while self.stats.bytes > self.max_bytes and len(self._entries) > 1:
                 k, e = next(iter(self._entries.items()))
-                self._drop(k, e)
+                self._drop_locked(k, e)
                 self.stats.evictions += 1
                 self._mark("query.cache.evicted")
         return True
@@ -145,8 +145,8 @@ class ResultCache:
         return n
 
     # ------------------------------------------------------------- helpers
-    def _drop(self, key, entry) -> None:
-        # caller holds the lock
+    def _drop_locked(self, key, entry) -> None:
+        # caller holds the lock (self-lint DSQL201 *_locked convention)
         self._entries.pop(key, None)
         self.stats.bytes -= entry.nbytes
         self.stats.entries -= 1

@@ -187,7 +187,7 @@ class ServingRuntime:
             except DeadlineExceededError as e:
                 self.metrics.inc("serving.timeouts")
                 fut.set_exception(e)
-            except BaseException as e:  # noqa: BLE001 - surfaced via Future
+            except BaseException as e:  # dsql: allow-broad-except — surfaced via Future
                 self.metrics.inc("serving.failed")
                 fut.set_exception(e)
             else:

@@ -3066,9 +3066,11 @@ class Binder {
       case K_EXPLAIN_STMT: {
         auto [plan, fields] = bind_query(ks[0], nullptr);
         (void)fields;
-        std::vector<BField> efields{{"PLAN", TY_VARCHAR, true}};
+        // EXPLAIN LINT (flag bit 2) returns verifier findings in a LINT column
+        std::vector<BField> efields{
+            {(n.flags & 2) ? "LINT" : "PLAN", TY_VARCHAR, true}};
         return b.add(P_EXPLAIN, concat({plan}, mk_fields(efields)),
-                     (n.flags & 1) ? 1 : 0, 1);
+                     ((n.flags & 1) ? 1 : 0) | ((n.flags & 2) ? 2 : 0), 1);
       }
       case K_CREATE_TABLE_WITH:
         return b.add(P_CREATE_TABLE,
@@ -5658,7 +5660,8 @@ int32_t dsql_bind(const char* sql, int64_t n, const uint8_t* catalog_buf,
   }
 }
 
-int32_t dsql_binder_abi_version() { return 2; }
+// version 3: EXPLAIN LINT (flag bit 2 + LINT field name on P_EXPLAIN)
+int32_t dsql_binder_abi_version() { return 3; }
 
 // Parse + bind + run the structural optimizer rule loop, all native.
 // Same rc codes as dsql_bind; `predicate_pushdown` mirrors the
@@ -5722,6 +5725,6 @@ int32_t dsql_plan(const char* sql, int64_t n, const uint8_t* catalog_buf,
   }
 }
 
-int32_t dsql_optimizer_abi_version() { return 2; }
+int32_t dsql_optimizer_abi_version() { return 3; }
 
 }  // extern "C"

@@ -392,8 +392,10 @@ class Parser {
     if (at_keyword("EXPLAIN")) {
       next();
       bool analyze = accept_keyword("ANALYZE");
+      bool lint = analyze ? false : accept_keyword("LINT");
       accept_keyword("VERBOSE");
-      return b_.add(K_EXPLAIN_STMT, {parse_query()}, analyze ? 1 : 0);
+      return b_.add(K_EXPLAIN_STMT, {parse_query()},
+                    (analyze ? 1 : 0) | (lint ? 2 : 0));
     }
     if (at_keyword("CREATE")) return parse_create();
     if (at_keyword("DROP")) return parse_drop();
@@ -1671,6 +1673,8 @@ int32_t dsql_parse(const char* sql, int64_t n, uint8_t** out,
 
 void dsql_buf_free(uint8_t* p) { std::free(p); }
 
-int32_t dsql_parser_abi_version() { return 1; }
+// version 2: EXPLAIN LINT (flag bit 2 on K_EXPLAIN_STMT) — bumped so a
+// stale prebuilt .so is rejected and the Python parser handles the syntax
+int32_t dsql_parser_abi_version() { return 2; }
 
 }  // extern "C"

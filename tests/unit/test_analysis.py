@@ -1,0 +1,301 @@
+"""Static analysis subsystem: plan verifier, EXPLAIN LINT, engine self-lint.
+
+The self-lint test IS the CI gate for the analysis rules: a regression that
+introduces an unguarded broad except, an off-lock mutation of lock-guarded
+state, or a host sync inside traced code fails tier-1 here.
+"""
+import threading
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from dask_sql_tpu import Context
+from dask_sql_tpu.analysis import (
+    RADIX_DOMAIN_LIMIT,
+    check_plan,
+    self_lint,
+    verify_plan,
+)
+from dask_sql_tpu.analysis.selflint import lint_source
+from dask_sql_tpu.columnar.dtypes import SqlType
+from dask_sql_tpu.planner import plan as p
+from dask_sql_tpu.planner.expressions import (
+    ColumnRef,
+    Field,
+    InArrayExpr,
+    Literal,
+    ScalarFunc,
+)
+from dask_sql_tpu.resilience.errors import PlanError
+
+pytestmark = pytest.mark.analysis
+
+
+@pytest.fixture
+def ctx():
+    c = Context()
+    c.create_table("t", pd.DataFrame({
+        "a": np.array([1, 2, 3, 2], dtype=np.int64),
+        "b": ["x", "y", "x", "z"],
+        "v": [1.0, 2.0, 3.0, 4.0],
+    }))
+    return c
+
+
+@pytest.fixture
+def wide_ctx():
+    """Two string group keys whose dictionary product provably exceeds the
+    1<<22 radix gate (2501 * 2501 uniques incl. NULL sentinel)."""
+    c = Context()
+    n = 5000
+    c.create_table("big", pd.DataFrame({
+        "k1": [f"a{i % 2500}" for i in range(n)],
+        "k2": [f"b{i % 2500}" for i in range(n)],
+        "v": np.arange(n, dtype=np.float64),
+    }))
+    return c
+
+
+# ------------------------------------------------------------- self-lint
+def test_self_lint_runs_clean_on_engine():
+    findings = self_lint()
+    assert findings == [], "\n".join(f.format() for f in findings)
+
+
+def test_lint_flags_broad_except():
+    src = "try:\n    x = 1\nexcept Exception:\n    pass\n"
+    findings = lint_source(src, "f.py")
+    assert [f.rule for f in findings] == ["DSQL101"]
+
+
+def test_lint_broad_except_suppression_comment():
+    src = ("try:\n    x = 1\n"
+           "except Exception:  # dsql: allow-broad-except — reason\n"
+           "    pass\n")
+    assert lint_source(src, "f.py") == []
+
+
+def test_lint_broad_except_taxonomy_transparent():
+    # an earlier `except QueryError: raise` clause makes the broad handler
+    # unable to swallow taxonomy errors — no finding
+    src = ("try:\n    x = 1\n"
+           "except QueryError:\n    raise\n"
+           "except Exception:\n    pass\n")
+    assert lint_source(src, "f.py") == []
+    # so does a handler that re-raises through the taxonomy wrapper
+    src2 = ("try:\n    x = 1\n"
+            "except Exception as e:\n    raise classify(e)\n")
+    assert lint_source(src2, "f.py") == []
+    # but re-wrapping in a NON-taxonomy error strips the code/retryable
+    # semantics — still flagged
+    src3 = ("try:\n    x = 1\n"
+            "except Exception as e:\n    raise RuntimeError(str(e))\n")
+    assert [f.rule for f in lint_source(src3, "f.py")] == ["DSQL101"]
+
+
+def test_lint_flags_off_lock_mutation():
+    src = (
+        "import threading\n"
+        "class R:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.items = {}\n"
+        "    def put(self, k, v):\n"
+        "        with self._lock:\n"
+        "            self.items[k] = v\n"
+        "    def drop(self, k):\n"
+        "        self.items.pop(k)\n"
+    )
+    findings = lint_source(src, "f.py")
+    assert [f.rule for f in findings] == ["DSQL201"]
+    assert findings[0].line == 10
+    # the *_locked naming convention documents caller-holds-the-lock
+    fixed = src.replace("def drop", "def drop_locked")
+    assert lint_source(fixed, "f.py") == []
+
+
+def test_lint_flags_host_sync_in_jitted_fn():
+    src = (
+        "import jax\n"
+        "def fn(x):\n"
+        "    return float(np.asarray(x).sum())\n"
+        "g = jax.jit(fn)\n"
+    )
+    findings = lint_source(src, "f.py")
+    assert [f.rule for f in findings] == ["DSQL301"]
+    # same code not passed to jit: silent
+    assert lint_source(src.replace("jax.jit(fn)", "fn"), "f.py") == []
+
+
+# ------------------------------------------------------- plan verifier
+def test_verifier_clean_plan(ctx):
+    out = ctx.sql("EXPLAIN LINT SELECT 1 AS x", return_futures=False)
+    lines = list(out["LINT"])
+    assert any("ok: plan verified clean" in ln for ln in lines)
+
+
+def test_explain_lint_reports_shape_buckets(ctx):
+    out = ctx.sql("EXPLAIN LINT SELECT b, SUM(v) FROM t GROUP BY b",
+                  return_futures=False)
+    text = "\n".join(out["LINT"])
+    assert "shape-bucket" in text and "bucket=4" in text
+    assert "0 error(s), 0 warning(s)" in text
+
+
+def test_explain_lint_native_binder_path(ctx):
+    # strict native mode proves the C++ parser/binder carries the LINT flag
+    out = ctx.sql("EXPLAIN LINT SELECT b, SUM(v) FROM t GROUP BY b",
+                  return_futures=False,
+                  config_options={"sql.native.binder": "on"})
+    assert "LINT" in out.columns
+    assert "summary:" in "\n".join(out["LINT"])
+
+
+def test_dtype_mismatch_raises_plan_error(ctx):
+    # a projection that declares VARCHAR while its expression emits DOUBLE:
+    # the inconsistency the verifier exists to stop at bind time
+    scan = p.TableScan("root", "t",
+                       [Field("a", SqlType.BIGINT), Field("v", SqlType.DOUBLE)],
+                       projection=["a", "v"])
+    bad = p.Projection(scan,
+                       [ColumnRef(1, "v", SqlType.DOUBLE)],
+                       [Field("v", SqlType.VARCHAR)])
+    verdict = verify_plan(bad, context=ctx)
+    assert any(f.rule == "dtype-mismatch" for f in verdict.errors)
+    with pytest.raises(PlanError) as ei:
+        check_plan(bad, context=ctx)
+    assert ei.value.code == "PLAN_VERIFY_ERROR"
+    assert ei.value.payload()["errorType"] == "INTERNAL_ERROR"
+
+
+def test_column_out_of_range_and_unknown_op(ctx):
+    scan = p.TableScan("root", "t", [Field("a", SqlType.BIGINT)],
+                       projection=["a"])
+    oob = p.Projection(scan, [ColumnRef(7, "zz", SqlType.BIGINT)],
+                       [Field("zz", SqlType.BIGINT)])
+    assert any(f.rule == "column-out-of-range"
+               for f in verify_plan(oob, context=ctx).errors)
+    ghost = p.Projection(
+        scan,
+        [ScalarFunc("no_such_kernel", (ColumnRef(0, "a", SqlType.BIGINT),),
+                    SqlType.BIGINT)],
+        [Field("x", SqlType.BIGINT)])
+    assert any(f.rule == "unknown-op"
+               for f in verify_plan(ghost, context=ctx).errors)
+
+
+def test_explain_lint_radix_overflow(wide_ctx):
+    out = wide_ctx.sql(
+        "EXPLAIN LINT SELECT k1, k2, SUM(v) FROM big GROUP BY k1, k2",
+        return_futures=False)
+    text = "\n".join(out["LINT"])
+    assert "radix-overflow" in text
+    assert "compiled_aggregate" in text
+    assert str(RADIX_DOMAIN_LIMIT) not in text  # message says 1<<22
+
+
+def test_radix_overflow_skips_rungs_and_still_answers(wide_ctx):
+    out = wide_ctx.sql("SELECT k1, k2, SUM(v) AS s FROM big GROUP BY k1, k2",
+                       return_futures=False)
+    assert len(out) == 2500
+    counters = wide_ctx.metrics.snapshot()["counters"]
+    assert counters.get("analysis.rung_skip.compiled_aggregate", 0) >= 1
+    assert counters.get("analysis.findings.radix-overflow", 0) >= 1
+    # the doomed rung was skipped, not attempted-and-degraded
+    assert counters.get("resilience.degraded", 0) == 0
+
+
+def test_radix_overflow_raises_at_bind_time_under_strict(wide_ctx):
+    with pytest.raises(PlanError):
+        wide_ctx.sql("SELECT k1, k2, SUM(v) FROM big GROUP BY k1, k2",
+                     return_futures=False,
+                     config_options={"analysis.verify": "strict"})
+    # verification can be disabled outright
+    out = wide_ctx.sql("SELECT k1, k2, SUM(v) FROM big GROUP BY k1, k2",
+                       return_futures=False,
+                       config_options={"analysis.verify": "off"})
+    assert len(out) == 2500
+
+
+def test_explain_lint_recompile_hazard_limit(ctx):
+    out = ctx.sql("EXPLAIN LINT SELECT a FROM t ORDER BY a LIMIT 1000",
+                  return_futures=False)
+    text = "\n".join(out["LINT"])
+    assert "recompile-hazard" in text and "1000" in text
+    # a power-of-two window stays quiet
+    out2 = ctx.sql("EXPLAIN LINT SELECT a FROM t ORDER BY a LIMIT 1024",
+                   return_futures=False)
+    assert "recompile-hazard" not in "\n".join(out2["LINT"])
+
+
+def test_in_array_hazard_direct():
+    scan = p.TableScan("root", "t", [Field("a", SqlType.BIGINT)],
+                       projection=["a"])
+    pred = InArrayExpr(ColumnRef(0, "a", SqlType.BIGINT),
+                       np.array([1, 2, 3], dtype=np.int64))
+    filt = p.Filter(scan, pred, scan.schema)
+    verdict = verify_plan(filt)
+    assert any(f.rule == "recompile-hazard" for f in verdict.findings)
+
+
+def test_explain_plain_still_works(ctx):
+    out = ctx.sql("EXPLAIN SELECT a FROM t", return_futures=False)
+    assert "PLAN" in out.columns
+    assert "TableScan" in "\n".join(out["PLAN"])
+
+
+def test_setop_arity_error():
+    one = p.Values([[Literal(1, SqlType.BIGINT)]],
+                   [Field("x", SqlType.BIGINT)])
+    two = p.Values([[Literal(1, SqlType.BIGINT), Literal(2, SqlType.BIGINT)]],
+                   [Field("x", SqlType.BIGINT), Field("y", SqlType.BIGINT)])
+    bad = p.Union([one, two], all=True, schema=[Field("x", SqlType.BIGINT)])
+    assert any(f.rule == "schema-arity" for f in verify_plan(bad).errors)
+
+
+# ----------------------------------------------- serving-path lock coverage
+def test_plan_cache_concurrent_access_regression(ctx):
+    """Concurrent Context.sql from server worker threads used to race the
+    unguarded plan-cache OrderedDict (move_to_end vs popitem eviction).
+    With _plan_lock this hammers clean; without it, KeyErrors/corruption."""
+    errors = []
+
+    def worker(seed):
+        try:
+            for i in range(40):
+                q = f"SELECT a + {(seed * 40 + i) % 200} AS x FROM t LIMIT 1"
+                ctx.sql(q)  # futures: plan+cache churn without device work
+        except Exception as e:  # dsql: allow-broad-except — test harness
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(s,)) for s in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert errors == []
+    assert len(ctx._plan_cache) <= ctx._PLAN_CACHE_CAP
+
+
+def test_volatile_plans_are_not_result_cached(ctx):
+    """Audit findings: unseeded TABLESAMPLE (fresh randomness per run) and
+    EXPLAIN ANALYZE (must re-execute to profile) may never be served from
+    the result cache."""
+    from dask_sql_tpu.planner.parser import parse_sql
+
+    for sql in ("SELECT * FROM t TABLESAMPLE BERNOULLI (50)",
+                "EXPLAIN ANALYZE SELECT a FROM t"):
+        plan = ctx._get_ral(parse_sql(sql)[0], sql_text=sql)
+        assert ctx._result_cache_key(plan, None) is None, sql
+    # a seeded sample is deterministic and stays cacheable
+    sql = "SELECT * FROM t TABLESAMPLE BERNOULLI (50) REPEATABLE (7)"
+    plan = ctx._get_ral(parse_sql(sql)[0], sql_text=sql)
+    assert ctx._result_cache_key(plan, None) is not None
+
+
+def test_cli_self_mode_exit_code():
+    from dask_sql_tpu.analysis.__main__ import main
+
+    assert main(["--rules"]) == 0
+    assert main(["--self"]) == 0
