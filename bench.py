@@ -170,6 +170,83 @@ def run_inject_smoke():
         raise SystemExit(1)
 
 
+def run_estimate_smoke():
+    """`bench.py --estimate`: estimate-vs-actual bytes for the bench queries.
+
+    Prints one JSON line per bench query with the estimator's
+    (rows_lo, rows_hi, bytes_lo, bytes_hi) next to the measured resident
+    bytes and result rows, and fails when a bound is violated (upper bound
+    below measured, or measured rows outside the cardinality interval).
+    Host + small-device work only — safe to run on every change.
+    """
+    _ensure_backend()
+
+    from dask_sql_tpu import Context
+    from dask_sql_tpu.analysis import estimator
+    from dask_sql_tpu.planner.parser import parse_sql
+    from dask_sql_tpu.serving.cache import table_nbytes
+
+    # tests/ is a package and the script dir rides sys.path, so this works
+    # from any cwd (the cwd-relative "tests" path hack would not)
+    from tests.tpch import QUERIES, generate
+
+    ok = True
+    # q1 shape on synthetic lineitem; q3 shape on the tpch toolkit tables
+    cases = []
+    c1 = Context()
+    c1.config.update({"serving.cache.enabled": False})
+    c1.create_table("lineitem", gen_lineitem(100_000, seed=0))
+    cases.append(("q1", c1, QUERY))
+    c3 = Context()
+    c3.config.update({"serving.cache.enabled": False})
+    for name, frame in generate(scale_rows=100_000).items():
+        c3.create_table(name, frame)
+    cases.append(("q3", c3, QUERIES[3]))
+
+    from dask_sql_tpu.planner import plan as plan_nodes
+
+    def scanned_tables(node, seen):
+        if isinstance(node, plan_nodes.TableScan):
+            seen.add(node.table_name)
+        for child in node.inputs():
+            scanned_tables(child, seen)
+        return seen
+
+    for label, c, sql in cases:
+        plan = c._get_ral(parse_sql(sql)[0], sql_text=sql)
+        est = estimator.estimate_plan(plan, context=c)
+        frame = c.sql(sql)
+        result_table = frame.execute()
+        result = frame.compute()
+        # a true peak lower bound the hi bound must dominate: the tables
+        # the PLAN references (plan-scoped — unreferenced catalog tables
+        # are not its claim) plus the materialized result, both resident
+        # simultaneously at query end.  Intermediate/scratch peaks are not
+        # observable from the host here, so this check is partial.
+        measured = sum(table_nbytes(c.schema["root"].tables[t].table)
+                       for t in scanned_tables(plan, set()))
+        measured += table_nbytes(result_table)
+        rows_ok = (est.rows.lo <= len(result)
+                   and (est.rows.hi is None or len(result) <= est.rows.hi))
+        bytes_ok = est.peak_bytes.hi is None or est.peak_bytes.hi >= measured
+        # the lower bound is what admission SHEDS on: it claims exactly
+        # "resident scanned tables + materialized root", both of which
+        # `measured` observes, so lo <= measured is a hard invariant
+        lo_ok = est.peak_bytes.lo <= measured
+        ok = ok and rows_ok and bytes_ok and lo_ok
+        print(json.dumps({
+            "metric": f"estimate_vs_actual_{label}",
+            "rows_lo": est.rows.lo, "rows_hi": est.rows.hi,
+            "bytes_lo": est.peak_bytes.lo, "bytes_hi": est.peak_bytes.hi,
+            "measured_resident_bytes": measured,
+            "actual_rows": len(result),
+            "rows_ok": bool(rows_ok), "bytes_ok": bool(bytes_ok),
+            "bytes_lo_ok": bool(lo_ok),
+        }), flush=True)
+    if not ok:
+        raise SystemExit(1)
+
+
 def run_lint_smoke():
     """`bench.py --lint`: static-analysis smoke.
 
@@ -210,6 +287,9 @@ def main():
         return
     if "--inject" in sys.argv:
         run_inject_smoke()
+        return
+    if "--estimate" in sys.argv:
+        run_estimate_smoke()
         return
 
     import jax

@@ -1,6 +1,6 @@
-"""Static analysis: plan verifier + engine self-lint.
+"""Static analysis: plan verifier + cost/memory estimator + engine self-lint.
 
-Two halves (see docs/analysis.md):
+Three parts (see docs/analysis.md):
 
 - **Plan verifier** (`verifier.py`): an independent re-inference of every
   plan node's output schema (names, dtype categories, nullability, shape
@@ -13,6 +13,14 @@ Two halves (see docs/analysis.md):
   attempting, and recompilation hazards (shapes outside the power-of-two
   bucketing scheme) are reported by ``EXPLAIN LINT``.
 
+- **Cost & memory estimator** (`estimator.py`): a bottom-up abstract
+  interpreter propagating cardinality and byte-footprint intervals per
+  plan node, yielding a provable lower bound and a conservative upper
+  bound on peak device bytes.  Surfaced as ``EXPLAIN ESTIMATE``, consumed
+  by the pre-compile admission byte gate
+  (``serving.admission.max_estimated_bytes``), result-cache admission,
+  and proof-driven ladder rung pre-skips.
+
 - **Engine self-lint** (`selflint.py`): an AST analyzer over the engine's
   own source (``python -m dask_sql_tpu.analysis --self``) with rules for
   broad exception handlers that can swallow taxonomy errors (DSQL101),
@@ -20,6 +28,12 @@ Two halves (see docs/analysis.md):
   inside jit-traced code (DSQL301).  Run as a tier-1 test so regressions
   fail CI.
 """
+from .estimator import (
+    Interval,
+    PlanEstimate,
+    estimate_and_apply,
+    estimate_plan,
+)
 from .findings import Finding, SEV_ERROR, SEV_INFO, SEV_WARN
 from .selflint import LintFinding, RULES, lint_paths, self_lint
 from .verifier import (
@@ -32,7 +46,9 @@ from .verifier import (
 
 __all__ = [
     "Finding",
+    "Interval",
     "LintFinding",
+    "PlanEstimate",
     "PlanVerdict",
     "RADIX_DOMAIN_LIMIT",
     "RULES",
@@ -40,6 +56,8 @@ __all__ = [
     "SEV_INFO",
     "SEV_WARN",
     "check_plan",
+    "estimate_and_apply",
+    "estimate_plan",
     "lint_paths",
     "self_lint",
     "verify_and_apply",

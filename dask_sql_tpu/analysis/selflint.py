@@ -35,6 +35,16 @@ DSQL301  host-sync
     returned inner functions in the compiled modules.  Suppress
     plan-time metadata pulls with ``# dsql: allow-host-sync``.
 
+DSQL401  metric-registry coverage
+    Every string-literal metric name passed to ``metrics.inc`` /
+    ``metrics.observe`` (and the cache's ``self._mark`` forwarder) must
+    appear in the documented registry
+    (``serving/metrics.py DOCUMENTED_METRICS`` /
+    ``DOCUMENTED_METRIC_PREFIXES`` for f-string families) — a typo'd name
+    silently splits a time series and dashboards go dark.  Dynamic names
+    (plain variables) make no claim; suppress deliberate one-offs with
+    ``# dsql: allow-metric-name``.
+
 Suppression comments live on the offending line or the line above it, so
 ``git blame`` keeps the reason next to the decision.
 """
@@ -49,12 +59,14 @@ RULES: Dict[str, str] = {
     "DSQL101": "broad exception handler can swallow taxonomy QueryErrors",
     "DSQL201": "lock-guarded attribute mutated outside its lock",
     "DSQL301": "host-sync call inside jit-traced code",
+    "DSQL401": "metric name not in the documented metric registry",
 }
 
 _SUPPRESS = {
     "DSQL101": "dsql: allow-broad-except",
     "DSQL201": "dsql: allow-unlocked",
     "DSQL301": "dsql: allow-host-sync",
+    "DSQL401": "dsql: allow-metric-name",
 }
 
 #: modules whose closure factories build jit-traced kernels: a nested def
@@ -364,6 +376,68 @@ def _check_host_sync(tree: ast.AST, path: str,
 
 
 # ---------------------------------------------------------------------------
+# DSQL401 — metric-name registry coverage
+# ---------------------------------------------------------------------------
+#: receiver attribute names that mean "a MetricsRegistry" at a call site
+#: (``metrics.inc(...)``, ``self.metrics.observe(...)``,
+#: ``executor.context.metrics.inc(...)``, the cache's ``self._mark(...)``)
+_METRIC_RECEIVERS = {"metrics", "_metrics"}
+_METRIC_METHODS = {"inc", "observe"}
+_METRIC_WRAPPERS = {"_mark"}  # helpers that forward a name to metrics.inc
+
+
+def _metric_name_of(arg: ast.expr) -> Tuple[Optional[str], bool]:
+    """``(name, is_prefix)`` of a call's first argument: the full literal
+    for str constants (is_prefix False), the leading literal run for
+    f-strings (is_prefix True — the dynamic tail is unknown), ``(None,
+    False)`` (no claim) for anything dynamic."""
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return arg.value, False
+    if isinstance(arg, ast.JoinedStr):
+        prefix = []
+        for part in arg.values:
+            if isinstance(part, ast.Constant) and isinstance(part.value, str):
+                prefix.append(part.value)
+            else:
+                break
+        return ("".join(prefix), True) if prefix else (None, False)
+    return None, False
+
+
+def _check_metric_names(tree: ast.AST, path: str,
+                        lines: Sequence[str]) -> List[LintFinding]:
+    from ..serving.metrics import is_documented_metric
+
+    out: List[LintFinding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        f = node.func
+        if not isinstance(f, ast.Attribute):
+            continue
+        if f.attr in _METRIC_METHODS:
+            recv = _name_of(f.value)
+            if recv is None or recv.split(".")[-1] not in _METRIC_RECEIVERS:
+                continue
+        elif not (f.attr in _METRIC_WRAPPERS
+                  and isinstance(f.value, ast.Name)
+                  and f.value.id == "self"):
+            continue
+        name, is_prefix = _metric_name_of(node.args[0])
+        if name is None or is_documented_metric(name, prefix_only=is_prefix):
+            continue
+        if _suppressed(lines, node.lineno, "DSQL401"):
+            continue
+        out.append(LintFinding(
+            "DSQL401", path, node.lineno,
+            f"metric name {name!r} is not in the documented registry "
+            f"(serving/metrics.py DOCUMENTED_METRICS); a typo here "
+            f"silently splits a time series — register the name or "
+            f"annotate `# {_SUPPRESS['DSQL401']}`"))
+    return out
+
+
+# ---------------------------------------------------------------------------
 # driver
 # ---------------------------------------------------------------------------
 def lint_source(source: str, path: str) -> List[LintFinding]:
@@ -377,6 +451,7 @@ def lint_source(source: str, path: str) -> List[LintFinding]:
     out += _check_broad_except(tree, path, lines)
     out += _check_lock_coverage(tree, path, lines)
     out += _check_host_sync(tree, path, lines)
+    out += _check_metric_names(tree, path, lines)
     return sorted(out, key=lambda f: (f.path, f.line, f.rule))
 
 

@@ -14,6 +14,7 @@ carries an inline doc comment; docs/serving.md has the full semantics.
 from __future__ import annotations
 
 import contextlib
+import logging
 import threading
 from typing import Any, Dict, Optional
 
@@ -56,6 +57,17 @@ DEFAULTS: Dict[str, Any] = {
     #   "strict" warn findings (e.g. radix-domain overflow) also raise
     #   "off"    no verification
     "analysis.verify": "on",
+    # Static cost & memory estimation (analysis/estimator.py, docs/analysis.md):
+    #   "on"  estimate every freshly planned executing query at bind time
+    #         (attaches the verdict for admission/cache/ladder consumers,
+    #         records analysis.estimate.* metrics)
+    #   "off" no estimation (EXPLAIN ESTIMATE still works on demand)
+    "analysis.estimate": "on",
+    # device byte budget the compiled-rung proofs compare against: an
+    # Aggregate whose packed intermediate-buffer LOWER bound exceeds it has
+    # compiled_aggregate/compiled_join_aggregate pre-skipped (no attempt,
+    # no breaker charge).  None disables the proof.
+    "analysis.estimate.device_budget_bytes": None,
     # Serving runtime (serving/) — admission control, result cache, metrics.
     # See docs/serving.md for semantics; all keys are read when the runtime
     # or Context is constructed (per-query config_options do not re-size
@@ -66,6 +78,11 @@ DEFAULTS: Dict[str, Any] = {
     "serving.batch.max_running": None,  # concurrent batch cap (None = workers-1; 0 pauses batch)
     "serving.deadline_s": None,  # default per-query deadline, seconds (None = unbounded)
     "serving.retry_after_s": 1.0,  # floor of the retry-after hint on load shed
+    # pre-compile OOM gate: shed queries whose statically PROVABLE peak
+    # device bytes (estimator lower bound) exceed this budget, with a
+    # non-retryable ESTIMATED_BYTES_EXCEEDED before any compilation.
+    # None disables the gate.
+    "serving.admission.max_estimated_bytes": None,
     "serving.cache.enabled": True,  # result cache for repeated identical queries
     "serving.cache.max_bytes": 256 << 20,  # total resident bytes before LRU eviction
     "serving.cache.max_entry_bytes": 64 << 20,  # per-entry cap (huge results bypass the cache)
@@ -86,6 +103,49 @@ DEFAULTS: Dict[str, Any] = {
     "resilience.inject": None,  # fault-injection spec, e.g. "compile:0.5,oom:once" (tests only)
     "resilience.inject.seed": 0,  # PRNG seed for probabilistic fault modes
 }
+
+
+def parse_byte_budget(value: Any) -> Optional[int]:
+    """Normalize a byte-budget config value to ``int bytes`` or ``None``
+    (disabled).  ``None`` / ``""`` / ``0`` / ``"0"`` / ``"none"`` /
+    ``"off"`` / ``"false"`` (any case) and non-positive numbers all
+    disable — config values arrive as strings through SET statements and
+    environment overrides, and a string ``"0"`` must mean "off", never a
+    zero-byte budget that sheds everything.  Shared by every budget gate
+    (``serving.admission.max_estimated_bytes``,
+    ``analysis.estimate.device_budget_bytes``) so the sites cannot drift.
+
+    Malformed values (e.g. ``"sixty-four"``) disable with a logged warning
+    rather than raise — a typo'd budget must never turn into a raw
+    ValueError failing every query at the execute boundary.  Binary size
+    suffixes (``"64MB"``, ``"2gib"``) are accepted."""
+    if value is None:
+        return None
+    if isinstance(value, str):
+        value = value.strip().lower()
+        if value in ("", "0", "none", "off", "false"):
+            return None
+        scale = 1
+        for suffix, mult in (("kib", 1 << 10), ("mib", 1 << 20),
+                             ("gib", 1 << 30), ("tib", 1 << 40),
+                             ("kb", 1 << 10), ("mb", 1 << 20),
+                             ("gb", 1 << 30), ("tb", 1 << 40)):
+            if value.endswith(suffix):
+                value, scale = value[:-len(suffix)].strip(), mult
+                break
+        try:
+            value = float(value) * scale
+        except ValueError:
+            logging.getLogger(__name__).warning(
+                "unparseable byte budget %r; treating as disabled", value)
+            return None
+    try:
+        n = int(value)
+    except (TypeError, ValueError):
+        logging.getLogger(__name__).warning(
+            "unparseable byte budget %r; treating as disabled", value)
+        return None
+    return n if n > 0 else None
 
 
 class Config:

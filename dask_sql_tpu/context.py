@@ -88,6 +88,21 @@ class TpuFrame:
                     if hit is not None:
                         self._result = hit
                         return self._result
+                estimate = ctx._plan_estimate(self._plan)
+                if estimate is not None:
+                    # pre-compile OOM gate: a provable over-budget query is
+                    # shed HERE — before the executor compiles anything —
+                    # with a structured, non-retryable taxonomy error
+                    from .serving.admission import check_estimated_bytes
+
+                    check_estimated_bytes(estimate, ctx.config, ctx.metrics)
+                    # result-cache admission: a result whose PROVABLE bytes
+                    # already exceed the per-entry cap is never cacheable;
+                    # skip the insert instead of materializing-then-evicting
+                    if key is not None and estimate.result_bytes.lo > \
+                            ctx._result_cache.max_entry_bytes:
+                        ctx.metrics.inc("query.cache.estimate_skip")
+                        key = None
                 trace = bool(ctx.config.get("serving.metrics.node_traces",
                                             False))
                 executor = Executor(ctx, trace=trace)
@@ -706,7 +721,55 @@ class Context:
             # so the degradation ladder never attempts them
             analysis.verify_and_apply(plan, self,
                                       strict=(verify_mode == "strict"))
+        if wants_verify and not isinstance(plan, plan_nodes.CustomNode) \
+                and self._estimate_enabled():
+            # static cost & memory estimation (docs/analysis.md): the
+            # verdict rides the plan (`_dsql_estimate`) for the admission
+            # byte gate and result-cache admission, and compiled aggregate
+            # rungs whose intermediate-buffer lower bound provably cannot
+            # fit the device budget are pre-skipped for the ladder
+            self._run_estimator(plan)
         return plan
+
+    def _estimate_enabled(self) -> bool:
+        mode = str(self.config.get("analysis.estimate", "on")).lower()
+        return mode not in ("off", "false", "0", "none")
+
+    def _run_estimator(self, plan):
+        """Guarded `estimate_and_apply`: estimation is advisory, so an
+        estimator bug must never block planning or execution — the query
+        simply runs ungated, metric-counted."""
+        from .analysis import estimator
+
+        try:
+            return estimator.estimate_and_apply(plan, self)
+        except Exception:  # dsql: allow-broad-except — advisory analysis
+            self.metrics.inc("analysis.estimate.internal_error")
+            logger.debug("plan estimation failed; query runs ungated",
+                         exc_info=True)
+            return None
+
+    def _plan_estimate(self, plan):
+        """The bind-time `PlanEstimate` riding a plan, or a fresh one when
+        the gate is configured but the plan was never estimated (cached
+        plans carry theirs; `analysis.estimate = off` disables both)."""
+        est = getattr(plan, "_dsql_estimate", None)
+        if est is not None:
+            return est
+        if config_module.parse_byte_budget(
+                self.config.get("serving.admission.max_estimated_bytes")) \
+                is None:
+            return None
+        if not self._estimate_enabled():
+            return None
+        if isinstance(plan, plan_nodes.CustomNode):
+            return None
+        if isinstance(plan, plan_nodes.Explain) and not plan.analyze:
+            # plain EXPLAIN / LINT / ESTIMATE renders text, never executes
+            # its input — it must report on an over-budget query, not be
+            # shed by the gate
+            return None
+        return self._run_estimator(plan)
 
     def _encoded_catalog(self, catalog) -> Optional[bytes]:
         """Catalog bytes for the native binder, cached across queries until

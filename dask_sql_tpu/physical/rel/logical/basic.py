@@ -368,6 +368,19 @@ class ExplainPlugin(BaseRelPlugin):
             executor.context.metrics.inc("analysis.explain_lint")
             rows = verdict.format_rows()
             lines = np.array(rows, dtype=object)
+        elif getattr(rel, "estimate", False):
+            # EXPLAIN ESTIMATE: static cost & memory abstract interpreter
+            # (analysis/estimator.py) — cardinality + byte intervals per
+            # node and the whole-plan peak-bytes verdict; nothing executes
+            from ....analysis import estimator
+
+            est = estimator.estimate_plan(rel.input, context=executor.context)
+            # report (not apply) the budget proofs so EXPLAIN shows which
+            # compiled rungs execution would pre-skip
+            est.rung_proofs = estimator.collect_rung_proofs(
+                est, estimator.device_budget_bytes(executor.context.config))
+            executor.context.metrics.inc("analysis.explain_estimate")
+            lines = np.array(est.format_rows(), dtype=object)
         elif rel.analyze:
             # EXPLAIN ANALYZE: run the plan with per-node tracing
             from ...executor import Executor

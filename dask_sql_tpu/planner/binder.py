@@ -136,9 +136,10 @@ class Binder:
         if isinstance(stmt, a.ExplainStatement):
             plan, _ = self.bind_query(stmt.query)
             lint = getattr(stmt, "lint", False)
-            col = "LINT" if lint else "PLAN"
+            estimate = getattr(stmt, "estimate", False)
+            col = "LINT" if lint else "ESTIMATE" if estimate else "PLAN"
             return p.Explain(plan, [Field(col, SqlType.VARCHAR)],
-                             stmt.analyze, lint)
+                             stmt.analyze, lint, estimate)
         if isinstance(stmt, a.CreateTableWith):
             return p.CreateTableNode([], stmt.name, stmt.kwargs, stmt.if_not_exists, stmt.or_replace)
         if isinstance(stmt, a.CreateTableAs):

@@ -150,9 +150,9 @@ def _get_parser_lib():
             ]
             lib.dsql_buf_free.argtypes = [ctypes.POINTER(ctypes.c_uint8)]
             lib.dsql_parser_abi_version.restype = ctypes.c_int32
-            # grammar version 2 = EXPLAIN LINT; a stale .so predating it
+            # grammar version 3 = EXPLAIN ESTIMATE; a stale .so predating it
             # is rejected here so the Python parser handles the syntax
-            _parser_ok = lib.dsql_parser_abi_version() == 2
+            _parser_ok = lib.dsql_parser_abi_version() == 3
         except AttributeError:
             _parser_ok = False
     return lib if _parser_ok else None
@@ -527,7 +527,7 @@ def _decode_statement(f: "_FlatAst", sid: int):
         return a.QueryStatement(_decode_select(f, kids[0]))
     if kind == _K_EXPLAIN_STMT:
         return a.ExplainStatement(_decode_select(f, kids[0]), bool(flags & 1),
-                                  bool(flags & 2))
+                                  bool(flags & 2), bool(flags & 4))
     if kind == _K_CREATE_TABLE_WITH:
         return a.CreateTableWith(_decode_qname(f, kids[0]),
                                  _decode_kwargs(f, kids[1]), ine, orr)
@@ -637,7 +637,8 @@ def _get_binder_lib():
                 ctypes.POINTER(ctypes.c_int64),
             ]
             lib.dsql_binder_abi_version.restype = ctypes.c_int32
-            _binder_ok = lib.dsql_binder_abi_version() == 3
+            # version 4 = EXPLAIN ESTIMATE flag bit + ESTIMATE field name
+            _binder_ok = lib.dsql_binder_abi_version() == 4
         except AttributeError:
             _binder_ok = False
     return lib if _binder_ok else None
@@ -954,7 +955,8 @@ class _PlanDecoder:
                                   self.fields(kids[1:1 + nf]))
         if kind == _P_EXPLAIN:
             return p.Explain(self.plan(kids[0]), self.fields(kids[1:]),
-                             bool(flags & 1), bool(flags & 2))
+                             bool(flags & 1), bool(flags & 2),
+                             bool(flags & 4))
         # ---- DDL / ML custom nodes ----
         ine = bool(flags & 1)
         orr = bool(flags & 2)
@@ -1107,7 +1109,7 @@ def _get_planner_lib():
                 ctypes.POINTER(ctypes.c_int64),
             ]
             lib.dsql_optimizer_abi_version.restype = ctypes.c_int32
-            _planner_ok = lib.dsql_optimizer_abi_version() == 3
+            _planner_ok = lib.dsql_optimizer_abi_version() == 4
         except AttributeError:
             _planner_ok = False
     return lib if _planner_ok else None

@@ -136,8 +136,11 @@ class Parser:
             self.next()
             analyze = self.accept_keyword("ANALYZE")
             lint = False if analyze else self.accept_keyword("LINT")
+            estimate = False if (analyze or lint) \
+                else self.accept_keyword("ESTIMATE")
             self.accept_keyword("VERBOSE")
-            return a.ExplainStatement(self.parse_query(), analyze, lint)
+            return a.ExplainStatement(self.parse_query(), analyze, lint,
+                                      estimate)
         if self.at_keyword("CREATE"):
             return self.parse_create()
         if self.at_keyword("DROP"):

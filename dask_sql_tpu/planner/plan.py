@@ -336,12 +336,14 @@ class Explain(LogicalPlan):
     schema: Schema
     analyze: bool = False
     lint: bool = False  # EXPLAIN LINT: static verifier findings as rows
+    estimate: bool = False  # EXPLAIN ESTIMATE: static cost/memory intervals
 
     def inputs(self):
         return [self.input]
 
     def with_inputs(self, inputs):
-        return Explain(inputs[0], self.schema, self.analyze, self.lint)
+        return Explain(inputs[0], self.schema, self.analyze, self.lint,
+                       self.estimate)
 
 
 # ---------------------------------------------------------------------------

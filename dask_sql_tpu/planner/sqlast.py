@@ -312,12 +312,15 @@ class QueryStatement(Statement):
 
 @dataclass
 class ExplainStatement(Statement):
-    """EXPLAIN [ANALYZE|LINT] <query> — LINT runs the static plan verifier
-    (analysis/verifier.py) and returns its findings as a result set."""
+    """EXPLAIN [ANALYZE|LINT|ESTIMATE] <query> — LINT runs the static plan
+    verifier (analysis/verifier.py), ESTIMATE the static cost & memory
+    abstract interpreter (analysis/estimator.py); both return their
+    findings as a result set without executing the query."""
 
     query: Select
     analyze: bool = False
     lint: bool = False
+    estimate: bool = False
 
 
 @dataclass

@@ -147,6 +147,11 @@ def error_results(query_id: str, next_uri: Optional[str], error: Exception,
     }
     if isinstance(error, QueryError):
         payload.update(error.payload())
+    # payload() is the extension point: any keys beyond the standard four
+    # are subclass-declared wire fields (e.g. the OOM gate's
+    # estimatedBytesLow/budgetBytes proof) and ride the error dict as-is
+    extra = {k: v for k, v in payload.items()
+             if k not in ("code", "errorType", "retryable", "degradable")}
     return {
         "id": query_id,
         "infoUri": "",
@@ -163,6 +168,7 @@ def error_results(query_id: str, next_uri: Optional[str], error: Exception,
                 "message": str(error),
                 "stack": [],
             },
+            **extra,
         },
         "warnings": [],
     }

@@ -25,6 +25,7 @@ from ..resilience.errors import (
     CancelledError,
     DeadlineError,
     QueryError,
+    ResourceExhaustedError,
 )
 
 #: scheduling order — lower runs first
@@ -46,6 +47,58 @@ class QueueFullError(QueryError):
         self.priority_class = priority_class
         self.bound = bound
         self.retry_after_s = retry_after_s
+
+
+class EstimatedBytesExceededError(ResourceExhaustedError):
+    """Pre-compile OOM gate: the static estimator's PROVABLE lower bound on
+    peak device bytes exceeds the admission budget, so executing could only
+    OOM — the query is shed before any compilation or device work.
+
+    Taxonomy: non-retryable (the proof holds until the catalog or the
+    query changes) and non-degradable (lower rungs share the same device;
+    at serving scale, shedding beats a doomed attempt-and-degrade)."""
+
+    code = "ESTIMATED_BYTES_EXCEEDED"
+    error_type = INSUFFICIENT_RESOURCES
+    retryable = False
+    degradable = False
+
+    def __init__(self, estimated_bytes_lo: int, budget_bytes: int):
+        super().__init__(
+            f"estimated peak device bytes >= {estimated_bytes_lo} "
+            f"provably exceed the admission budget of {budget_bytes} bytes "
+            f"(serving.admission.max_estimated_bytes); query shed before "
+            f"compilation")
+        self.estimated_bytes_lo = int(estimated_bytes_lo)
+        self.budget_bytes = int(budget_bytes)
+
+    def payload(self) -> dict:
+        # clients/load balancers see the proof (estimator lower bound vs
+        # budget) on the wire instead of a bare message
+        out = super().payload()
+        out["estimatedBytesLow"] = self.estimated_bytes_lo
+        out["budgetBytes"] = self.budget_bytes
+        return out
+
+
+def check_estimated_bytes(estimate, config, metrics=None) -> None:
+    """The ``serving.admission.max_estimated_bytes`` gate: raise
+    `EstimatedBytesExceededError` when the estimate's *lower* bound on peak
+    device bytes exceeds the budget.  Called by ``TpuFrame.execute`` after
+    the result-cache lookup and before any executor/compiler work — only
+    the lower bound sheds, because only it is provable (an upper-bound shed
+    would reject feasible queries)."""
+    from ..config import parse_byte_budget
+
+    budget = None if config is None else parse_byte_budget(
+        config.get("serving.admission.max_estimated_bytes"))
+    if budget is None or estimate is None:
+        return
+    lo = int(estimate.peak_bytes.lo)
+    if lo > budget:
+        if metrics is not None:
+            metrics.inc("serving.shed_estimated_bytes")
+        raise EstimatedBytesExceededError(lo, budget)
 
 
 class DeadlineExceededError(DeadlineError):

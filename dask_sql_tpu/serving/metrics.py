@@ -14,6 +14,100 @@ from collections import deque
 from typing import Any, Dict, Iterable, List, Optional, Tuple
 
 
+# ---------------------------------------------------------------------------
+# documented metric registry
+# ---------------------------------------------------------------------------
+#: Every exact metric name the engine emits via ``metrics.inc`` /
+#: ``metrics.observe``.  This is the registry self-lint rule DSQL401
+#: checks string-literal metric names against — an undocumented name in
+#: code is name drift (a typo'd counter silently splits a time series) and
+#: fails CI.  Add the name here (with the emitting site) when introducing
+#: a metric; docs/serving.md and docs/analysis.md describe the families.
+DOCUMENTED_METRICS = frozenset({
+    # analysis/ — plan verifier + cost/memory estimator
+    "analysis.verify.runs",
+    "analysis.plan_error",
+    "analysis.verifier_internal",
+    "analysis.explain_lint",
+    "analysis.explain_estimate",
+    "analysis.rung_skip",
+    "analysis.estimate.runs",
+    "analysis.estimate.bytes_lo",
+    "analysis.estimate.bytes_hi",
+    "analysis.estimate.rows_hi",
+    "analysis.estimate.rung_proof",
+    "analysis.estimate.internal_error",
+    # planner
+    "planner.optimize.fallback",
+    # query lifecycle (Context / TpuFrame)
+    "query.executed",
+    "query.execute_ms",
+    "query.plan_cache.hit",
+    "query.plan_cache.miss",
+    "query.cache.hit",
+    "query.cache.miss",
+    "query.cache.oversize",
+    "query.cache.evicted",
+    "query.cache.estimate_skip",
+    # resilience/ — ladder, breaker, retry
+    "resilience.degraded",
+    "resilience.degraded.interpreted",
+    "resilience.rung.cpu",
+    "resilience.fallback",
+    "resilience.fallback.dist_aggregate",
+    "resilience.fallback.dist_sort",
+    "resilience.breaker.skip",
+    "resilience.breaker.trip",
+    "resilience.retry.attempts",
+    "resilience.retry.recovered",
+    "resilience.retry.deadline_abort",
+    "resilience.retry.backoff_ms",
+    # serving/ — admission, runtime
+    "serving.admitted",
+    "serving.rejected",
+    "serving.rejected.batch",
+    "serving.cancelled",
+    "serving.completed",
+    "serving.failed",
+    "serving.timeouts",
+    "serving.shutdown_shed",
+    "serving.shed_estimated_bytes",
+    "serving.latency_ms",
+    "serving.queue_wait_ms",
+})
+
+#: Prefixes legitimizing *dynamic* metric families (f-string names keyed by
+#: rung / rule / class / node type).  DSQL401 checks an f-string's static
+#: prefix against these.
+DOCUMENTED_METRIC_PREFIXES = (
+    "analysis.findings.",       # per verifier rule id
+    "analysis.rung_skip.",      # per pre-skipped ladder rung
+    "resilience.degraded.",     # per degraded rung
+    "resilience.rung.",         # per rung that answered
+    "resilience.breaker.skip.",  # per breaker-skipped rung
+    "serving.admitted.",        # per admission class
+    "serving.rejected.",        # per admission class
+    "executor.node.",           # per plan-node type (Tracer aggregation)
+)
+
+
+def is_documented_metric(name: str, prefix_only: bool = False) -> bool:
+    """True when ``name`` is covered by the documented registry.
+
+    ``prefix_only`` means ``name`` is the static *prefix* of an f-string
+    (the dynamic tail is unknown), so it also matches a documented family
+    prefix it truncates (``f"resilience.rung.{r}"`` → ``"resilience.rung."``
+    matching itself, or a shorter static run).  An exact literal gets no
+    such slack — ``metrics.inc("analysis.findings")`` missing its per-rule
+    suffix is exactly the drift DSQL401 exists to catch."""
+    if name in DOCUMENTED_METRICS:
+        return True
+    if any(name.startswith(p) for p in DOCUMENTED_METRIC_PREFIXES):
+        return True
+    return prefix_only and any(p.startswith(name)
+                               for p in DOCUMENTED_METRIC_PREFIXES)
+
+
 class Histogram:
     """Bounded-reservoir histogram: O(1) observe, percentile on snapshot.
 

@@ -3066,11 +3066,16 @@ class Binder {
       case K_EXPLAIN_STMT: {
         auto [plan, fields] = bind_query(ks[0], nullptr);
         (void)fields;
-        // EXPLAIN LINT (flag bit 2) returns verifier findings in a LINT column
+        // EXPLAIN LINT (flag bit 2) returns verifier findings in a LINT
+        // column; EXPLAIN ESTIMATE (bit 4) cost/memory intervals in an
+        // ESTIMATE column
         std::vector<BField> efields{
-            {(n.flags & 2) ? "LINT" : "PLAN", TY_VARCHAR, true}};
+            {(n.flags & 2) ? "LINT" : (n.flags & 4) ? "ESTIMATE" : "PLAN",
+             TY_VARCHAR, true}};
         return b.add(P_EXPLAIN, concat({plan}, mk_fields(efields)),
-                     ((n.flags & 1) ? 1 : 0) | ((n.flags & 2) ? 2 : 0), 1);
+                     ((n.flags & 1) ? 1 : 0) | ((n.flags & 2) ? 2 : 0) |
+                         ((n.flags & 4) ? 4 : 0),
+                     1);
       }
       case K_CREATE_TABLE_WITH:
         return b.add(P_CREATE_TABLE,
@@ -5660,8 +5665,8 @@ int32_t dsql_bind(const char* sql, int64_t n, const uint8_t* catalog_buf,
   }
 }
 
-// version 3: EXPLAIN LINT (flag bit 2 + LINT field name on P_EXPLAIN)
-int32_t dsql_binder_abi_version() { return 3; }
+// version 4: EXPLAIN ESTIMATE (flag bit 4 + ESTIMATE field name on P_EXPLAIN)
+int32_t dsql_binder_abi_version() { return 4; }
 
 // Parse + bind + run the structural optimizer rule loop, all native.
 // Same rc codes as dsql_bind; `predicate_pushdown` mirrors the
@@ -5725,6 +5730,7 @@ int32_t dsql_plan(const char* sql, int64_t n, const uint8_t* catalog_buf,
   }
 }
 
-int32_t dsql_optimizer_abi_version() { return 3; }
+// bumped in lockstep with the binder: dsql_plan shares its EXPLAIN encoding
+int32_t dsql_optimizer_abi_version() { return 4; }
 
 }  // extern "C"

@@ -393,9 +393,10 @@ class Parser {
       next();
       bool analyze = accept_keyword("ANALYZE");
       bool lint = analyze ? false : accept_keyword("LINT");
+      bool estimate = (analyze || lint) ? false : accept_keyword("ESTIMATE");
       accept_keyword("VERBOSE");
       return b_.add(K_EXPLAIN_STMT, {parse_query()},
-                    (analyze ? 1 : 0) | (lint ? 2 : 0));
+                    (analyze ? 1 : 0) | (lint ? 2 : 0) | (estimate ? 4 : 0));
     }
     if (at_keyword("CREATE")) return parse_create();
     if (at_keyword("DROP")) return parse_drop();
@@ -1673,8 +1674,8 @@ int32_t dsql_parse(const char* sql, int64_t n, uint8_t** out,
 
 void dsql_buf_free(uint8_t* p) { std::free(p); }
 
-// version 2: EXPLAIN LINT (flag bit 2 on K_EXPLAIN_STMT) — bumped so a
+// version 3: EXPLAIN ESTIMATE (flag bit 4 on K_EXPLAIN_STMT) — bumped so a
 // stale prebuilt .so is rejected and the Python parser handles the syntax
-int32_t dsql_parser_abi_version() { return 2; }
+int32_t dsql_parser_abi_version() { return 3; }
 
 }  // extern "C"

@@ -1,0 +1,3 @@
+# Package marker: tests/unit and tests/integration each ship a
+# test_checkpoint.py; without package-qualified module names pytest's
+# prepend import mode refuses the duplicate basename at collection time.

@@ -1,0 +1,489 @@
+"""Static cost & memory estimator: interval propagation, EXPLAIN ESTIMATE,
+the pre-compile admission byte gate, and proof-driven ladder rung skips.
+
+The acceptance-critical properties live here: the gate sheds provably
+over-budget queries BEFORE any compilation (asserted through the `compile`
+fault-injection site staying un-fired), rung proofs skip compiled
+aggregates with ``resilience.degraded == 0``, the native and Python parser
+paths produce the same ESTIMATE rows, and the upper bound dominates the
+measured byte footprint on the q1/q3-shaped bench tables.
+"""
+import numpy as np
+import pandas as pd
+import pytest
+
+from dask_sql_tpu import Context
+from dask_sql_tpu import config as config_module
+from dask_sql_tpu.analysis import estimator
+from dask_sql_tpu.analysis.estimator import Interval
+from dask_sql_tpu.columnar.dtypes import SqlType
+from dask_sql_tpu.planner import plan as p
+from dask_sql_tpu.planner.expressions import ColumnRef, Field, Literal
+from dask_sql_tpu.planner.parser import parse_sql
+from dask_sql_tpu.resilience import faults
+from dask_sql_tpu.serving.admission import EstimatedBytesExceededError
+from dask_sql_tpu.serving.cache import table_nbytes
+
+pytestmark = pytest.mark.estimator
+
+
+@pytest.fixture(autouse=True)
+def _fresh_injector():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+@pytest.fixture
+def ctx():
+    c = Context()
+    c.create_table("t", pd.DataFrame({
+        "a": np.arange(100, dtype=np.int64),
+        "b": [f"k{i % 7}" for i in range(100)],
+        "v": np.arange(100, dtype=np.float64),
+    }))
+    return c
+
+
+def _estimate(ctx, sql):
+    plan = ctx._get_ral(parse_sql(sql)[0], sql_text=sql)
+    return estimator.estimate_plan(plan, context=ctx)
+
+
+# ------------------------------------------------------- interval lattice
+def test_interval_arithmetic_saturates_unbounded():
+    a = Interval(2, 10)
+    b = Interval(3, None)
+    assert (a + b) == Interval(5, None)
+    assert (a * b) == Interval(6, None)
+    assert (a + Interval(1, 1)) == Interval(3, 11)
+    assert a.clamp_hi(4) == Interval(2, 4)
+    assert Interval(100, 100).clamp_hi(10) == Interval(10, 10)
+    assert b.clamp_hi(7) == Interval(3, 7)
+    assert a.drop_lo() == Interval(0, 10)
+    assert Interval.exact(5).fmt() == "[5, 5]"
+    assert b.fmt() == "[3, unbounded]"
+
+
+# -------------------------------------------------- per-node propagation
+def test_scan_rows_exact_from_statistics(ctx):
+    est = _estimate(ctx, "SELECT * FROM t")
+    assert est.rows == Interval(100, 100)
+    # exact rows -> exact result bytes (lo == hi at the unpadded count is
+    # not required, but lo must be positive and hi finite)
+    assert est.result_bytes.lo > 0
+    assert est.result_bytes.hi is not None
+
+
+def test_filter_drops_lower_bound(ctx):
+    est = _estimate(ctx, "SELECT a FROM t WHERE v > 50")
+    assert est.rows.lo == 0
+    assert est.rows.hi == 100
+
+
+def test_limit_clamps_both_bounds(ctx):
+    est = _estimate(ctx, "SELECT a FROM t LIMIT 7")
+    assert est.rows == Interval(7, 7)
+    est = _estimate(ctx, "SELECT a FROM t WHERE v > 50 LIMIT 7")
+    assert est.rows == Interval(0, 7)
+
+
+def test_cross_join_multiplies(ctx):
+    est = _estimate(ctx, "SELECT t1.a FROM t t1, t t2")
+    assert est.rows == Interval(100 * 100, 100 * 100)
+
+
+def test_inner_join_zero_lower_bound(ctx):
+    est = _estimate(ctx, "SELECT t1.a FROM t t1 JOIN t t2 ON t1.a = t2.a")
+    assert est.rows.lo == 0
+    assert est.rows.hi == 100 * 100
+
+
+def test_outer_join_bound_survives_empty_side():
+    """Regression: LEFT/RIGHT/FULL preserve their side even against an
+    empty opposite input — the upper bound must not collapse to 0 below
+    the actual row count (and the interval must stay well-formed)."""
+    c = Context()
+    c.create_table("l", pd.DataFrame({"k": np.array([1, 2, 3], dtype=np.int64)}))
+    c.create_table("r", pd.DataFrame({"k": pd.Series([], dtype="int64"),
+                                      "w": pd.Series([], dtype="float64")}))
+    for jt, lo, hi in [("LEFT", 3, 3), ("RIGHT", 0, 0), ("FULL", 3, 3)]:
+        sql = f"SELECT l.k FROM l {jt} JOIN r ON l.k = r.k"
+        est = _estimate(c, sql)
+        actual = len(c.sql(sql, return_futures=False))
+        assert est.rows.lo == lo, jt
+        assert est.rows.hi == hi, jt
+        assert est.rows.lo <= actual <= est.rows.hi, jt
+
+
+def test_aggregate_rows_clamped_by_radix_domain(ctx):
+    # b has 7 distinct values -> dictionary size 7, +1 NULL sentinel = 8
+    est = _estimate(ctx, "SELECT b, SUM(v) FROM t GROUP BY b")
+    assert est.rows.lo == 1
+    assert est.rows.hi == 8
+
+
+def test_global_aggregate_is_exactly_one_row(ctx):
+    est = _estimate(ctx, "SELECT SUM(v) FROM t")
+    assert est.rows == Interval(1, 1)
+
+
+def test_global_aggregate_scratch_not_charged_the_radix_gate(ctx):
+    """Regression: a no-GROUP-BY aggregate has a known domain of exactly 1,
+    so its packed-matrix upper bound must be slots*8 bytes — not the full
+    ~33.5 MB 1<<22 gate cap."""
+    est = _estimate(ctx, "SELECT SUM(v) FROM t")
+    assert est.peak_bytes.hi is not None
+    assert est.peak_bytes.hi < 1 << 20  # table is ~2.5 KB; gate cap is 2^25
+
+
+def test_union_all_sums_and_distinct_drops_lo(ctx):
+    est = _estimate(ctx, "SELECT a FROM t UNION ALL SELECT a FROM t")
+    assert est.rows == Interval(200, 200)
+    est = _estimate(ctx, "SELECT a FROM t UNION SELECT a FROM t")
+    assert est.rows.lo == 1
+    assert est.rows.hi == 200
+
+
+def test_values_exact(ctx):
+    est = _estimate(ctx, "SELECT * FROM (VALUES (1), (2), (3)) AS w(x)")
+    assert est.rows == Interval(3, 3)
+
+
+def test_direct_node_construction_sort_fetch():
+    scan = p.TableScan("root", "t", [Field("a", SqlType.BIGINT)],
+                       projection=["a"])
+    srt = p.Sort(scan, [], [Field("a", SqlType.BIGINT)], fetch=5)
+    est = estimator.estimate_plan(srt)
+    # no context -> scan rows unknown, but the fetch still caps the top
+    assert est.rows.hi == 5
+
+
+def test_unknown_scan_is_unbounded():
+    scan = p.TableScan("root", "missing", [Field("a", SqlType.BIGINT)],
+                       projection=["a"])
+    est = estimator.estimate_plan(scan)
+    assert est.rows == Interval(0, None)
+    assert est.peak_bytes.hi is None
+
+
+def test_lower_bound_never_charges_validity_masks(ctx):
+    """Regression: a nullable-declared column materializes a validity mask
+    only when nulls occur, so the provable lower bound (which admission
+    sheds on) must stay at or below the actual resident bytes of an
+    all-valid table; the mask belongs in the upper bound only."""
+    est = _estimate(ctx, "SELECT * FROM t")
+    actual = table_nbytes(ctx.schema["root"].tables["t"].table)
+    # lo = resident scan + materialized root; the root here aliases the
+    # scan, so lo is exactly the scan's data buffers
+    assert est.peak_bytes.lo <= actual
+    assert est.peak_bytes.hi >= actual
+
+
+def test_explain_analyze_estimate_is_bounded(ctx):
+    """Regression: bind-time estimation of EXPLAIN ANALYZE must estimate
+    the executing input plan, not the Explain text node (whose unknown
+    render size used to force every bound to unbounded)."""
+    from dask_sql_tpu.planner.parser import parse_sql
+
+    sql = "EXPLAIN ANALYZE SELECT b, SUM(v) FROM t GROUP BY b"
+    plan = ctx._get_ral(parse_sql(sql)[0], sql_text=sql)
+    est = getattr(plan, "_dsql_estimate", None)
+    assert est is not None
+    assert est.rows.hi is not None
+    assert est.peak_bytes.hi is not None
+
+
+def test_peak_lower_bound_counts_resident_scans(ctx):
+    est = _estimate(ctx, "SELECT a FROM t WHERE v > 1e9")
+    # even a filter that keeps nothing cannot run below the resident base
+    # table bytes: 100 rows x (int64 a + float64 v nullable)
+    assert est.peak_bytes.lo >= 100 * 16
+    # and the upper bound dominates the lower everywhere
+    assert est.peak_bytes.hi >= est.peak_bytes.lo
+
+
+# ------------------------------------------------------- EXPLAIN ESTIMATE
+def test_explain_estimate_shape(ctx):
+    out = ctx.sql("EXPLAIN ESTIMATE SELECT b, SUM(v) FROM t GROUP BY b",
+                  return_futures=False)
+    assert list(out.columns) == ["ESTIMATE"]
+    head = out["ESTIMATE"][0]
+    assert head.startswith("estimate: rows_lo=")
+    for token in ("rows_lo=", "rows_hi=", "bytes_lo=", "bytes_hi="):
+        assert token in head
+    text = "\n".join(out["ESTIMATE"])
+    assert "result: bytes=" in text
+    assert "node " in text
+
+
+def test_explain_estimate_native_python_parity(ctx):
+    sql = "EXPLAIN ESTIMATE SELECT b, SUM(v) FROM t GROUP BY b"
+    native = ctx.sql(sql, return_futures=False,
+                     config_options={"sql.native.binder": "on"})
+    python = ctx.sql(sql, return_futures=False,
+                     config_options={"sql.native.binder": "off",
+                                     "serving.cache.enabled": False})
+    assert list(native.columns) == list(python.columns) == ["ESTIMATE"]
+    # the headline interval must be identical across parser paths
+    assert native["ESTIMATE"][0] == python["ESTIMATE"][0]
+
+
+def test_explain_estimate_never_executes(ctx):
+    """Executing the input would run its compiled aggregate and fire the
+    armed `oom` site; EXPLAIN ESTIMATE only renders, so it never does."""
+    with config_module.set({"resilience.inject": "oom:always"}):
+        out = ctx.sql("EXPLAIN ESTIMATE SELECT b, SUM(v) FROM t GROUP BY b",
+                      return_futures=False,
+                      config_options={"serving.cache.enabled": False})
+        inj = faults.get_injector(config_module.config)
+        assert inj is not None and inj.fired("oom") == 0
+    assert out["ESTIMATE"][0].startswith("estimate:")
+
+
+def test_explain_estimate_reports_over_budget_instead_of_shedding(ctx):
+    # EXPLAIN ESTIMATE of an over-budget query must REPORT, never shed
+    out = ctx.sql(
+        "EXPLAIN ESTIMATE SELECT t1.a FROM t t1, t t2",
+        return_futures=False,
+        config_options={"serving.admission.max_estimated_bytes": 1,
+                        "serving.cache.enabled": False})
+    assert out["ESTIMATE"][0].startswith("estimate:")
+
+
+# --------------------------------------------------- admission byte gate
+def test_gate_sheds_before_any_compile(ctx):
+    """Acceptance: a synthetic over-budget query is shed with a taxonomy
+    error while the `compile` fault-injection site proves zero compilation
+    was attempted (an armed compile:always fault that never fires)."""
+    spec = {"serving.admission.max_estimated_bytes": 1 << 16,
+            "resilience.inject": "compile:always",
+            "serving.cache.enabled": False}
+    with config_module.set(spec):
+        with pytest.raises(EstimatedBytesExceededError) as ei:
+            ctx.sql("SELECT t1.a, t2.v FROM t t1, t t2",
+                    return_futures=False)
+        inj = faults.get_injector(config_module.config)
+        assert inj is not None and inj.fired("compile") == 0
+    err = ei.value
+    assert err.code == "ESTIMATED_BYTES_EXCEEDED"
+    assert err.retryable is False
+    assert err.payload()["errorType"] == "INSUFFICIENT_RESOURCES"
+    assert err.estimated_bytes_lo > err.budget_bytes == 1 << 16
+    counters = ctx.metrics.snapshot()["counters"]
+    assert counters.get("serving.shed_estimated_bytes", 0) >= 1
+    assert counters.get("analysis.estimate.runs", 0) >= 1
+    # nothing executed, nothing degraded
+    assert counters.get("query.executed", 0) == 0
+    assert counters.get("resilience.degraded", 0) == 0
+
+
+def test_gate_admits_within_budget(ctx):
+    out = ctx.sql(
+        "SELECT b, SUM(v) AS s FROM t GROUP BY b", return_futures=False,
+        config_options={"serving.admission.max_estimated_bytes": 1 << 30})
+    assert len(out) == 7
+
+
+def test_gate_disabled_by_default(ctx):
+    out = ctx.sql("SELECT t1.a FROM t t1, t t2 LIMIT 5",
+                  return_futures=False)
+    assert len(out) == 5
+
+
+def test_budget_string_zero_means_disabled(ctx):
+    """Regression: config values arrive as strings through SET/env — a
+    string "0" budget must disable the gate, not shed every query."""
+    from dask_sql_tpu.config import parse_byte_budget
+
+    for off in (None, "", 0, "0", " 0 ", "none", "OFF", "false", -1):
+        assert parse_byte_budget(off) is None, off
+    assert parse_byte_budget("1024") == 1024
+    assert parse_byte_budget(1 << 20) == 1 << 20
+    assert parse_byte_budget("64MB") == 64 << 20
+    assert parse_byte_budget("2 GiB") == 2 << 30
+    # malformed values disable with a warning instead of raising: a typo'd
+    # budget must never fail every query at the execute boundary
+    assert parse_byte_budget("sixty-four") is None
+    for bad in ("0", "sixty-four"):
+        out = ctx.sql(
+            "SELECT a FROM t LIMIT 3", return_futures=False,
+            config_options={"serving.admission.max_estimated_bytes": bad,
+                            "serving.cache.enabled": False})
+        assert len(out) == 3
+
+
+def test_gate_error_wire_payload(ctx):
+    from dask_sql_tpu.server.responses import error_results
+
+    err = EstimatedBytesExceededError(10_000, 1_000)
+    payload = error_results("q1", None, err)
+    assert payload["error"]["errorName"] == "ESTIMATED_BYTES_EXCEEDED"
+    assert payload["error"]["errorType"] == "INSUFFICIENT_RESOURCES"
+    assert payload["error"]["retryable"] is False
+    assert payload["error"]["estimatedBytesLow"] == 10_000
+    assert payload["error"]["budgetBytes"] == 1_000
+
+
+def test_result_cache_estimate_admission(ctx):
+    """A result whose PROVABLE bytes exceed the per-entry cap is never
+    inserted — no materialize-then-evict churn, no oversize reject."""
+    with config_module.set({"serving.cache.max_entry_bytes": 64}):
+        # rebuild the Context so the cache picks up the tiny cap
+        c = Context()
+        c.create_table("t", pd.DataFrame({
+            "a": np.arange(100, dtype=np.int64),
+            "v": np.arange(100, dtype=np.float64)}))
+        out = c.sql("SELECT a, v FROM t", return_futures=False)
+        assert len(out) == 100
+        counters = c.metrics.snapshot()["counters"]
+        assert counters.get("query.cache.estimate_skip", 0) >= 1
+        # the estimator pre-empted the insert: no oversize reject happened
+        assert c._result_cache.stats.oversize_rejects == 0
+        assert c._result_cache.stats.inserts == 0
+
+
+# ------------------------------------------------------- ladder rung proof
+def test_rung_proof_preskips_compiled_aggregate(ctx):
+    """Acceptance: an aggregate whose packed-matrix lower bound cannot fit
+    the device budget runs via lower rungs with zero degradations — the
+    compiled rungs are skipped by proof, not by failure."""
+    out = ctx.sql(
+        "SELECT b, SUM(v) AS s FROM t GROUP BY b", return_futures=False,
+        config_options={"analysis.estimate.device_budget_bytes": 16,
+                        "serving.cache.enabled": False})
+    assert len(out) == 7
+    counters = ctx.metrics.snapshot()["counters"]
+    assert counters.get("analysis.estimate.rung_proof", 0) >= 1
+    assert counters.get("analysis.rung_skip.compiled_aggregate", 0) >= 1
+    assert counters.get("resilience.degraded", 0) == 0
+
+
+def test_explain_estimate_renders_rung_proof(ctx):
+    """Regression: EXPLAIN ESTIMATE must show the budget proof rows the
+    execution path would act on (without marking the plan)."""
+    out = ctx.sql(
+        "EXPLAIN ESTIMATE SELECT b, SUM(v) FROM t GROUP BY b",
+        return_futures=False,
+        config_options={"analysis.estimate.device_budget_bytes": 16,
+                        "serving.cache.enabled": False})
+    text = "\n".join(out["ESTIMATE"])
+    assert "rungs pre-skipped" in text
+    assert "compiled_aggregate" in text
+
+
+def test_rung_proof_absent_with_roomy_budget(ctx):
+    ctx.sql("SELECT b, SUM(v) AS s FROM t GROUP BY b", return_futures=False,
+            config_options={"analysis.estimate.device_budget_bytes": 1 << 34,
+                            "serving.cache.enabled": False})
+    counters = ctx.metrics.snapshot()["counters"]
+    assert counters.get("analysis.estimate.rung_proof", 0) == 0
+
+
+# ------------------------------------------- estimate-vs-actual soundness
+def _bench_tables(n=20_000):
+    from tests.tpch import generate
+
+    return generate(scale_rows=n)
+
+
+def test_upper_bound_dominates_actual_q1_shape():
+    """q1-shaped bench query: measured result + resident input bytes never
+    exceed the estimator's upper bound (soundness of the hi bound)."""
+    import bench
+
+    df = bench.gen_lineitem(50_000, seed=0)
+    with config_module.set({"serving.cache.enabled": False}):
+        c = Context()
+        c.create_table("lineitem", df)
+        plan = c._get_ral(parse_sql(bench.QUERY)[0], sql_text=bench.QUERY)
+        est = estimator.estimate_plan(plan, context=c)
+        frame = c.sql(bench.QUERY)
+        result_table = frame.execute()
+        result = frame.compute()
+    assert len(result) > 0
+    # resident inputs + materialized result coexist at query end: a true
+    # peak lower bound the estimator's upper bound must dominate
+    measured = sum(
+        table_nbytes(dc.table)
+        for dc in c.schema["root"].tables.values())
+    measured += table_nbytes(result_table)
+    assert est.peak_bytes.hi is not None
+    # the provable lower bound must stay below the observed resident bytes
+    # it claims (this is what admission sheds on), the upper bound above
+    assert est.peak_bytes.lo <= measured <= est.peak_bytes.hi
+    assert est.peak_bytes.hi >= est.peak_bytes.lo
+    # the root cardinality bound holds for the actual result
+    assert est.rows.lo <= len(result)
+    assert est.rows.hi is None or len(result) <= est.rows.hi
+
+
+@pytest.mark.slow
+def test_upper_bound_dominates_actual_q3_shape():
+    from tests.tpch import QUERIES
+
+    tables = _bench_tables(20_000)
+    with config_module.set({"serving.cache.enabled": False}):
+        c = Context()
+        for name, frame in tables.items():
+            c.create_table(name, frame)
+        sql = QUERIES[3]
+        plan = c._get_ral(parse_sql(sql)[0], sql_text=sql)
+        est = estimator.estimate_plan(plan, context=c)
+        frame = c.sql(sql)
+        result_table = frame.execute()
+        result = frame.compute()
+    # the estimate is plan-scoped: measure only the tables the plan scans,
+    # plus the materialized result they coexist with at query end
+    scanned = set()
+
+    def _scans(node):
+        if isinstance(node, p.TableScan):
+            scanned.add(node.table_name)
+        for child in node.inputs():
+            _scans(child)
+
+    _scans(plan)
+    measured = sum(
+        table_nbytes(c.schema["root"].tables[t].table) for t in scanned)
+    measured += table_nbytes(result_table)
+    assert est.peak_bytes.lo <= measured
+    assert est.peak_bytes.hi is None or est.peak_bytes.hi >= measured
+    assert est.rows.hi is None or len(result) <= est.rows.hi
+    assert est.rows.lo <= len(result)
+
+
+# ----------------------------------------------------------- metrics view
+def test_estimate_metrics_visible_in_show_metrics(ctx):
+    ctx.sql("SELECT b, SUM(v) FROM t GROUP BY b", return_futures=False)
+    out = ctx.sql("SHOW METRICS LIKE 'analysis.estimate.%'",
+                  return_futures=False)
+    names = set(out[out.columns[0]])
+    assert any(n.startswith("analysis.estimate.bytes_lo") for n in names)
+    assert "analysis.estimate.runs" in names
+
+
+# ------------------------------------------------------------ DSQL401 lint
+def test_lint_flags_undocumented_metric_name():
+    from dask_sql_tpu.analysis.selflint import lint_source
+
+    src = 'def f(metrics):\n    metrics.inc("anaylsis.typo_counter")\n'
+    assert [f.rule for f in lint_source(src, "f.py")] == ["DSQL401"]
+    ok = 'def f(metrics):\n    metrics.inc("serving.admitted")\n'
+    assert lint_source(ok, "f.py") == []
+    fam = 'def f(metrics, r):\n    metrics.inc(f"resilience.rung.{r}")\n'
+    assert lint_source(fam, "f.py") == []
+    bad_fam = 'def f(metrics, r):\n    metrics.inc(f"resilience.wrung.{r}")\n'
+    assert [f.rule for f in lint_source(bad_fam, "f.py")] == ["DSQL401"]
+    sup = ('def f(metrics):\n'
+           '    metrics.inc("oneoff.x")  # dsql: allow-metric-name\n')
+    assert lint_source(sup, "f.py") == []
+    # dynamic names make no claim
+    dyn = 'def f(metrics, n):\n    metrics.inc(n)\n'
+    assert lint_source(dyn, "f.py") == []
+    # an exact literal that truncates a documented family prefix is DRIFT
+    # (missing the per-rule suffix); only f-string prefixes get that slack
+    trunc = 'def f(metrics):\n    metrics.inc("analysis.findings")\n'
+    assert [f.rule for f in lint_source(trunc, "f.py")] == ["DSQL401"]
+    short_fam = 'def f(metrics, r):\n    metrics.inc(f"analysis.fin{r}")\n'
+    assert lint_source(short_fam, "f.py") == []
