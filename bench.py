@@ -247,6 +247,54 @@ def run_estimate_smoke():
         raise SystemExit(1)
 
 
+def run_profile_smoke():
+    """`bench.py --profile`: query-lifecycle trace smoke.
+
+    Runs the benchmark query once through the Context API with lifecycle
+    tracing (observability/), asserts the trace is COMPLETE — every
+    expected stage present, stage timestamps monotonic and non-overlapping,
+    at least one per-rung compile span recorded — and writes the
+    Chrome-trace JSON artifact so a CI run leaves a loadable profile
+    behind.  Small input, safe to run on every change.
+    """
+    import json as _json
+    import os
+
+    _ensure_backend()
+    from dask_sql_tpu import Context
+
+    c = Context()
+    c.config.update({"serving.cache.enabled": False})
+    c.create_table("lineitem", gen_lineitem(100_000, seed=0))
+    c.sql(QUERY, return_futures=False)
+    tr = c.last_trace
+    stages = tr.stage_spans()
+    names = [s.name for s in stages]
+    required = ["parse", "bind", "verify", "estimate", "execute", "d2h"]
+    missing = [r for r in required if r not in names]
+    # stages must be sequential: each one ends before the next begins
+    monotonic = all(stages[i].t1 <= stages[i + 1].t0 + 1e-9
+                    for i in range(len(stages) - 1))
+    compiles = [s for s in tr.spans if s.name.startswith("compile:")]
+    artifact = os.environ.get("DSQL_PROFILE_ARTIFACT",
+                              "/tmp/dsql_q1_trace.json")
+    with open(artifact, "w") as f:
+        _json.dump(tr.to_chrome_trace(), f)
+    ok = not missing and monotonic and len(compiles) >= 1
+    print(_json.dumps({
+        "metric": "lifecycle_profile_smoke",
+        "ok": bool(ok),
+        "stages": names,
+        "missing_stages": missing,
+        "monotonic": bool(monotonic),
+        "compile_spans": len(compiles),
+        "fingerprint": tr.fingerprint,
+        "artifact": artifact,
+    }), flush=True)
+    if not ok:
+        raise SystemExit(1)
+
+
 def run_lint_smoke():
     """`bench.py --lint`: static-analysis smoke.
 
@@ -290,6 +338,9 @@ def main():
         return
     if "--estimate" in sys.argv:
         run_estimate_smoke()
+        return
+    if "--profile" in sys.argv:
+        run_profile_smoke()
         return
 
     import jax

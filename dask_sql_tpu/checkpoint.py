@@ -168,6 +168,15 @@ def save_state(context: "Context", location: str) -> dict:
                 "carry (%s) — re-issue their DDL after load_state",
                 schema_name, ", ".join(sorted(dropped)))
 
+    profiles = getattr(context, "profiles", None)
+    if profiles is not None and len(profiles):
+        # per-fingerprint query profiles (observability/profiles.py) ride
+        # the snapshot: a restarted process knows its hot fingerprints —
+        # the pre-warm input — without replaying traffic
+        with open(os.path.join(snap_dir, "profiles.json"), "w") as f:
+            json.dump(profiles.snapshot(), f)
+        manifest["profiles"] = "profiles.json"
+
     with open(os.path.join(snap_dir, "manifest.json"), "w") as f:
         json.dump(manifest, f, indent=1)
     # fault-injection site (resilience/faults.py): a crash HERE — snapshot
@@ -218,4 +227,11 @@ def load_state(context: "Context", location: str) -> dict:
         for tname, rows in entry.get("statistics", {}).items():
             context.schema[schema_name].statistics[tname] = Statistics(rows)
     context.schema_name = manifest.get("current_schema", context.schema_name)
+    profiles_rel = manifest.get("profiles")
+    if profiles_rel and getattr(context, "profiles", None) is not None:
+        path = os.path.join(snap_dir, profiles_rel)
+        if os.path.exists(path):
+            with open(path) as f:
+                restored = context.profiles.load(json.load(f))
+            logger.info("load_state: restored %d query profiles", restored)
     return manifest

@@ -88,6 +88,14 @@ DEFAULTS: Dict[str, Any] = {
     "serving.cache.max_entry_bytes": 64 << 20,  # per-entry cap (huge results bypass the cache)
     "serving.cache.ttl_s": 300.0,  # entry time-to-live, seconds (None = no TTL)
     "serving.metrics.node_traces": False,  # per-plan-node tracing folded into the registry
+    # Observability (observability/, docs/observability.md) — query-lifecycle
+    # tracing, per-fingerprint profiles, slow-query log.
+    "observability.trace.enabled": True,  # lifecycle span trace per query (EXPLAIN ANALYZE header, /v1/trace/{qid})
+    "observability.trace.keep": 256,  # finished traces retained for /v1/trace lookups (LRU)
+    "observability.slow_query_ms": None,  # span-tree log threshold, ms (None = off; 0 logs every query)
+    "observability.slow_query_path": None,  # JSONL sink for slow queries (None = python logger)
+    "observability.profiles.window": 64,  # rolling samples kept per fingerprint (exec/compile/bytes)
+    "observability.profiles.keep": 512,  # max fingerprints in the profile store (LRU)
     # Resilient execution (resilience/) — error taxonomy, degradation ladder,
     # retry/backoff, circuit breaker, fault injection.  docs/resilience.md.
     "resilience.ladder.enabled": True,  # degradable failures step down a rung instead of failing

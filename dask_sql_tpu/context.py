@@ -15,6 +15,7 @@ jax/XLA kernels through the physical plugin registries.
 """
 from __future__ import annotations
 
+import contextlib
 import logging
 import threading
 import time
@@ -24,6 +25,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple, Union
 import numpy as np
 
 from . import config as config_module
+from . import observability
 from .columnar.dtypes import SqlType, np_to_sql
 from .columnar.table import Table
 from .datacontainer import (
@@ -59,6 +61,12 @@ class TpuFrame:
         #: per-query overrides re-applied at execution time (lazy compute
         #: happens after Context.sql's config scope has exited)
         self._config_options = dict(config_options or {})
+        #: the lifecycle QueryTrace active when this frame was planned
+        #: (observability/spans.py) — lazy execute/compute re-activate it so
+        #: plan-time and run-time spans land on ONE trace
+        self._trace: Optional[observability.QueryTrace] = None
+        #: cached plan fingerprint (resilience/ladder.py plan_fingerprint)
+        self._fingerprint: Optional[str] = None
 
     @property
     def plan(self):
@@ -79,15 +87,60 @@ class TpuFrame:
         results can never be served."""
         if self._result is None:
             from .physical.executor import Executor
+            from .resilience.ladder import plan_fingerprint, wrap_boundary
 
             ctx = self._context
-            with ctx.config.set(self._config_options):
-                key = ctx._result_cache_key(self._plan, self._config_options)
-                if key is not None:
-                    hit = ctx._result_cache.get(key)
-                    if hit is not None:
-                        self._result = hit
-                        return self._result
+            tr = self._trace
+            fp = self._fingerprint
+            if fp is None:
+                fp = self._fingerprint = plan_fingerprint(self._plan)
+            sql_text = tr.sql if tr is not None else None
+
+            def _finish_on_error(exc_type, exc, tb):
+                # a failing query's lifecycle ends HERE — the slowest, most
+                # log-worthy queries (deadline expiries, OOM sheds, executor
+                # failures) must reach the slow-query check too
+                if exc is None or tr is None:
+                    return False
+                from .serving.runtime import current_ticket
+
+                if getattr(exc, "retryable", False) \
+                        and current_ticket() is not None:
+                    # a serving worker may retry this attempt: leave the
+                    # trace open — the registry's terminal done-callback
+                    # finishes it on the FINAL outcome, not attempt 1
+                    return False
+                tr.finish(ctx.config, ctx.metrics)
+                return False
+
+            with contextlib.ExitStack() as stack:
+                # entered before the finish hook is pushed, so the hook
+                # (LIFO) still sees this query's config overrides — a
+                # per-query slow_query_ms must gate its own failures
+                stack.enter_context(ctx.config.set(self._config_options))
+                stack.push(_finish_on_error)
+                if tr is not None:
+                    if observability.current_trace() is not tr:
+                        # lazy compute outside Context.sql's scope (or on a
+                        # different thread): re-install the plan-time trace
+                        stack.enter_context(observability.activate(tr))
+                    tr.fingerprint = fp
+                # compile histograms + per-fingerprint profiles record
+                # through the sink even with tracing disabled
+                stack.enter_context(observability.compile_sink(
+                    ctx.metrics, ctx.profiles, fp, sql_text))
+                with observability.stage("cache_lookup"):
+                    key = ctx._result_cache_key(self._plan,
+                                                self._config_options)
+                    hit = ctx._result_cache.get(key) if key is not None \
+                        else None
+                if hit is not None:
+                    if tr is not None:
+                        tr.event("result_cache_hit")
+                    ctx.profiles.record_exec(fp, sql=sql_text,
+                                             cache_hit=True)
+                    self._result = hit
+                    return self._result
                 estimate = ctx._plan_estimate(self._plan)
                 if estimate is not None:
                     # pre-compile OOM gate: a provable over-budget query is
@@ -110,15 +163,21 @@ class TpuFrame:
                 # executor boundary: every failure leaves here as a taxonomy
                 # QueryError (code/retryable/degradable), never a raw
                 # device traceback (resilience/errors.py)
-                from .resilience.ladder import wrap_boundary
-
-                self._result = wrap_boundary(
-                    lambda: executor.execute_root(self._plan))
-                ctx.metrics.observe(
-                    "query.execute_ms", (time.perf_counter() - t0) * 1000.0)
+                with observability.stage("execute"):
+                    self._result = wrap_boundary(
+                        lambda: executor.execute_root(self._plan))
+                exec_ms = (time.perf_counter() - t0) * 1000.0
+                ctx.metrics.observe("query.execute_ms", exec_ms)
                 ctx.metrics.inc("query.executed")
                 if trace:
                     executor.tracer.publish(ctx.metrics)
+                    if tr is not None:
+                        tr.attach_node_tree(executor.tracer.root)
+                from .serving.cache import table_nbytes
+
+                ctx.profiles.record_exec(
+                    fp, sql=sql_text, exec_ms=exec_ms,
+                    result_bytes=table_nbytes(self._result))
                 if key is not None:
                     ctx._result_cache.put(key, self._result)
         return self._result
@@ -126,7 +185,22 @@ class TpuFrame:
     def compute(self):
         """Materialize to a pandas DataFrame with the SQL output names."""
         table = self.execute()
+        t0 = time.perf_counter()
         df = table.to_pandas()
+        t1 = time.perf_counter()
+        # every call transfers again, so every call observes — but the
+        # metric must not go dark when tracing is off
+        self._context.metrics.observe("query.d2h_ms", (t1 - t0) * 1e3)
+        tr = self._trace
+        if tr is not None:
+            # add-once: a repeated compute() must not mutate a finished
+            # (possibly already slow-logged) trace with duplicate stages
+            tr.add_span_once("d2h", t0, t1, rows=table.num_rows,
+                             cols=len(self._field_names))
+            # the lifecycle ends here for the Context API (the server path
+            # appends its serialize span post-finish): run the slow-query
+            # check exactly once
+            tr.finish(self._context.config, self._context.metrics)
         df.columns = self._disambiguated_names()
         return df
 
@@ -203,6 +277,17 @@ class Context:
         #: the ServingRuntime when a server front-end attached one (so
         #: SHOW METRICS can surface admission/queue state)
         self.serving = None
+        #: per-fingerprint rolling profiles (compile/exec/bytes) behind
+        #: SHOW PROFILES; persisted by checkpoint.save_state
+        self.profiles = observability.ProfileStore(
+            window=int(self.config.get("observability.profiles.window", 64)),
+            keep=int(self.config.get("observability.profiles.keep", 512)))
+        #: finished lifecycle traces, qid -> QueryTrace (/v1/trace/{qid})
+        self.traces = observability.TraceStore(
+            int(self.config.get("observability.trace.keep", 256)))
+        #: the most recently started lifecycle trace (bench --profile and
+        #: notebook introspection; per-query lookups go through `traces`)
+        self.last_trace: Optional[observability.QueryTrace] = None
         from .resilience.retry import CircuitBreaker
 
         #: per-(plan fingerprint, ladder rung) circuit breaker: a query
@@ -506,9 +591,36 @@ class Context:
         if dataframes is not None:
             for df_name, df in dataframes.items():
                 self.create_table(df_name, df)
-        with self.config.set(config_options or {}):
+        with contextlib.ExitStack() as scope:
+            scope.enter_context(self.config.set(config_options or {}))
             if not isinstance(sql, str):
                 raise ValueError("sql must be a string (plans are internal here)")
+            # lifecycle trace (observability/): reuse the active trace when
+            # an outer scope (the Presto server's worker) already opened one
+            # for this query, else open (and own) a fresh trace here
+            tr = None
+            owned = False
+            if self._trace_enabled():
+                tr = observability.current_trace()
+                if tr is None:
+                    tr = observability.QueryTrace(
+                        sql=sql, metrics=self.metrics, profiles=self.profiles)
+                    self.traces.put(tr.qid, tr)
+                    owned = True
+                    scope.enter_context(observability.activate(tr))
+                self.last_trace = tr
+
+            def _finish_owned_on_error(exc_type, exc, tb):
+                # parse/bind/verify failures end the lifecycle of a trace
+                # this call opened: close it so the slow-query check runs
+                # and /v1/trace never serves a dangling open trace.  (A
+                # trace the SERVER opened is not owned here — its registry
+                # finishes it at the terminal outcome, after any retries.)
+                if exc is not None and owned and tr is not None:
+                    tr.finish(self.config, self.metrics)
+                return False
+
+            scope.push(_finish_owned_on_error)
             key = self._plan_cache_key(sql, config_options)
             plans = None
             if key is not None:
@@ -519,11 +631,14 @@ class Context:
             result = None
             if plans is not None:
                 self.metrics.inc("query.plan_cache.hit")
+                if tr is not None:
+                    tr.event("plan_cache_hit")
                 for plan in plans:
                     result = self._run_plan(plan, config_options)
             else:
                 self.metrics.inc("query.plan_cache.miss")
-                statements = parse_sql(sql)
+                with observability.stage("parse"):
+                    statements = parse_sql(sql)
                 plans = []
                 # plan each statement right before running it: a later
                 # statement may read what an earlier one created
@@ -540,7 +655,12 @@ class Context:
                         while len(self._plan_cache) > self._PLAN_CACHE_CAP:
                             self._plan_cache.popitem(last=False)
             if result is None:
+                # statement(s) with no result frame (DDL): the lifecycle
+                # ends here for a trace this call opened
+                if owned and tr is not None:
+                    tr.finish(self.config, self.metrics)
                 return None
+            result._trace = tr
             if return_futures:
                 return result
             return result.compute()
@@ -655,44 +775,49 @@ class Context:
         core_optimized = False
         native_mode = str(self.config.get("sql.native.binder", "auto")).lower()
         want_opt = bool(self.config.get("sql.optimize", True))
-        if sql_text is not None and native_mode in ("auto", "on", "true"):
-            from .planner.native_bridge import native_bind, native_plan
+        with observability.stage("bind") as bind_attrs:
+            if sql_text is not None and native_mode in ("auto", "on", "true"):
+                from .planner.native_bridge import native_bind, native_plan
 
-            cat_buf = self._encoded_catalog(catalog)
-            strict = native_mode != "auto"
-            if want_opt:
-                # one native call runs parse+bind+the structural rule loop
-                # AND the stats-driven join reorder (the reference's
-                # compiled DataFusion pipeline analogue)
-                plan = native_plan(
-                    sql_text, catalog, cat_buf=cat_buf,
-                    predicate_pushdown=bool(
-                        self.config.get("sql.predicate_pushdown", True)),
-                    strict=strict,
-                    fact_dimension_ratio=float(self.config.get(
-                        "sql.optimizer.fact_dimension_ratio", 0.7)),
-                    max_fact_tables=int(self.config.get(
-                        "sql.optimizer.max_fact_tables", 2)),
-                    preserve_user_order=bool(self.config.get(
-                        "sql.optimizer.preserve_user_order", True)),
-                    filter_selectivity=float(self.config.get(
-                        "sql.optimizer.filter_selectivity", 1.0)))
-                core_optimized = plan is not None
+                cat_buf = self._encoded_catalog(catalog)
+                strict = native_mode != "auto"
+                if want_opt:
+                    # one native call runs parse+bind+the structural rule loop
+                    # AND the stats-driven join reorder (the reference's
+                    # compiled DataFusion pipeline analogue)
+                    plan = native_plan(
+                        sql_text, catalog, cat_buf=cat_buf,
+                        predicate_pushdown=bool(
+                            self.config.get("sql.predicate_pushdown", True)),
+                        strict=strict,
+                        fact_dimension_ratio=float(self.config.get(
+                            "sql.optimizer.fact_dimension_ratio", 0.7)),
+                        max_fact_tables=int(self.config.get(
+                            "sql.optimizer.max_fact_tables", 2)),
+                        preserve_user_order=bool(self.config.get(
+                            "sql.optimizer.preserve_user_order", True)),
+                        filter_selectivity=float(self.config.get(
+                            "sql.optimizer.filter_selectivity", 1.0)))
+                    core_optimized = plan is not None
+                if plan is None:
+                    plan = native_bind(sql_text, catalog, cat_buf=cat_buf,
+                                       strict=strict)
+            bind_attrs["native"] = plan is not None
             if plan is None:
-                plan = native_bind(sql_text, catalog, cat_buf=cat_buf,
-                                   strict=strict)
-        if plan is None:
-            binder = Binder(catalog, case_sensitive=case_sensitive)
-            plan = binder.bind_statement(stmt)
+                binder = Binder(catalog, case_sensitive=case_sensitive)
+                plan = binder.bind_statement(stmt)
         if want_opt:
             from .planner.optimizer.driver import optimize_core, optimize_post
             from .resilience.errors import QueryError
 
             try:
-                if not core_optimized:
-                    plan = optimize_core(plan, self.config, catalog)
-                plan = optimize_post(plan, self.config, catalog, context=self,
-                                     skip_reorder=core_optimized)
+                with observability.stage("optimize",
+                                         core_native=core_optimized):
+                    if not core_optimized:
+                        plan = optimize_core(plan, self.config, catalog)
+                    plan = optimize_post(plan, self.config, catalog,
+                                         context=self,
+                                         skip_reorder=core_optimized)
             except QueryError:
                 # taxonomy errors (deadline expiry at a checkpoint, resource
                 # exhaustion in a plan-time data read) carry policy upstream
@@ -719,8 +844,9 @@ class Context:
             # cross-check raises taxonomy PlanError here — at bind time —
             # and statically-doomed compiled rungs are marked on the plan
             # so the degradation ladder never attempts them
-            analysis.verify_and_apply(plan, self,
-                                      strict=(verify_mode == "strict"))
+            with observability.stage("verify", mode=verify_mode):
+                analysis.verify_and_apply(plan, self,
+                                          strict=(verify_mode == "strict"))
         if wants_verify and not isinstance(plan, plan_nodes.CustomNode) \
                 and self._estimate_enabled():
             # static cost & memory estimation (docs/analysis.md): the
@@ -728,11 +854,20 @@ class Context:
             # byte gate and result-cache admission, and compiled aggregate
             # rungs whose intermediate-buffer lower bound provably cannot
             # fit the device budget are pre-skipped for the ladder
-            self._run_estimator(plan)
+            with observability.stage("estimate") as est_attrs:
+                est = self._run_estimator(plan)
+                if est is not None:
+                    est_attrs["rows_hi"] = est.rows.hi
+                    est_attrs["bytes_lo"] = est.peak_bytes.lo
         return plan
 
     def _estimate_enabled(self) -> bool:
         mode = str(self.config.get("analysis.estimate", "on")).lower()
+        return mode not in ("off", "false", "0", "none")
+
+    def _trace_enabled(self) -> bool:
+        mode = str(self.config.get("observability.trace.enabled",
+                                   True)).lower()
         return mode not in ("off", "false", "0", "none")
 
     def _run_estimator(self, plan):

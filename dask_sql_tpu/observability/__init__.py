@@ -1,0 +1,48 @@
+"""Query observability: lifecycle tracing, per-fingerprint profiles,
+Prometheus exposition, slow-query logging.
+
+The serving stack (admission, result cache, degradation ladder, breaker,
+estimator) makes multi-stage decisions per query; this subsystem makes
+every stage visible (docs/observability.md):
+
+- `spans`     — the `QueryTrace` span model, contextvar activation, the
+                bounded `TraceStore` behind ``/v1/trace/{qid}``, and
+                `timed_jit_call` per-rung compile timing;
+- `profiles`  — `ProfileStore`: rolling per-fingerprint compile/exec/bytes
+                profiles behind ``SHOW PROFILES``, persisted by the
+                checkpoint subsystem;
+- `prometheus`— text exposition of the MetricsRegistry for
+                ``/v1/metrics?format=prometheus``;
+- `slowlog`   — threshold-gated span-tree dumps of latency outliers.
+"""
+from .profiles import ProfileStore
+from .prometheus import CONTENT_TYPE as PROMETHEUS_CONTENT_TYPE
+from .prometheus import render_prometheus
+from .slowlog import maybe_log_slow
+from .spans import (
+    QueryTrace,
+    Span,
+    TraceStore,
+    activate,
+    compile_sink,
+    current_trace,
+    stage,
+    timed_jit_call,
+    trace_event,
+)
+
+__all__ = [
+    "ProfileStore",
+    "PROMETHEUS_CONTENT_TYPE",
+    "QueryTrace",
+    "Span",
+    "TraceStore",
+    "activate",
+    "compile_sink",
+    "current_trace",
+    "maybe_log_slow",
+    "render_prometheus",
+    "stage",
+    "timed_jit_call",
+    "trace_event",
+]

@@ -1,0 +1,193 @@
+"""Per-fingerprint query profiles.
+
+One rolling profile per plan fingerprint (resilience/ladder.py
+`plan_fingerprint`): hit counts, recent execute wall times, result bytes,
+and per-ladder-rung compile wall times.  Three consumers:
+
+- ``SHOW PROFILES [LIKE 'pat']`` renders the store as a result set
+  (native and Python parser paths, physical/rel/custom/ddl.py);
+- the checkpoint subsystem persists a JSON snapshot next to each catalog
+  snapshot (`profiles.json`), so a restarted process knows its hot
+  fingerprints — the input the zero-cold-start pre-warm (ROADMAP item 3)
+  needs before it can pre-compile anything;
+- the slow-query log and EXPLAIN ANALYZE read compile history to explain
+  where a cold p99 went.
+
+Everything is plain-JSON state (dicts, lists, floats) so snapshot/load is
+`json.dump`/`json.load` with no schema mapping.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Tuple
+
+#: truncation for the remembered SQL text of a fingerprint
+_SQL_KEEP = 200
+
+
+def _percentile(values: List[float], q: float) -> float:
+    # lazy import: serving/__init__ may still be mid-import when this
+    # module loads through the observability package
+    from ..serving.metrics import nearest_rank
+
+    return nearest_rank(sorted(values), q)
+
+
+def _fmt(v: float) -> str:
+    if isinstance(v, float) and v == int(v):
+        return str(int(v))
+    return f"{v:.3f}" if isinstance(v, float) else str(v)
+
+
+class ProfileStore:
+    """Thread-safe bounded store: fingerprint -> rolling profile dict."""
+
+    def __init__(self, window: int = 64, keep: int = 512):
+        self.window = max(1, int(window))
+        self.keep = max(1, int(keep))
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[str, Dict[str, Any]]" = OrderedDict()
+
+    # ------------------------------------------------------------ writes
+    def _entry_locked(self, fingerprint: str,
+                      sql: Optional[str]) -> Dict[str, Any]:
+        e = self._entries.get(fingerprint)
+        if e is None:
+            e = self._entries[fingerprint] = {
+                "sql": (sql or "")[:_SQL_KEEP],
+                "hits": 0,
+                "cache_hits": 0,
+                "exec_ms": [],
+                "result_bytes": [],
+                "compile": {},  # rung -> {"count": n, "ms": [rolling]}
+                "last_seen": 0.0,
+            }
+        elif sql and not e["sql"]:
+            e["sql"] = sql[:_SQL_KEEP]
+        self._entries.move_to_end(fingerprint)
+        while len(self._entries) > self.keep:
+            self._entries.popitem(last=False)
+        e["last_seen"] = time.time()
+        return e
+
+    def record_exec(self, fingerprint: str, sql: Optional[str] = None,
+                    exec_ms: Optional[float] = None,
+                    result_bytes: Optional[int] = None,
+                    cache_hit: bool = False) -> None:
+        with self._lock:
+            e = self._entry_locked(fingerprint, sql)
+            e["hits"] += 1
+            if cache_hit:
+                e["cache_hits"] += 1
+            if exec_ms is not None:
+                e["exec_ms"].append(round(float(exec_ms), 3))
+                del e["exec_ms"][:-self.window]
+            if result_bytes is not None:
+                e["result_bytes"].append(int(result_bytes))
+                del e["result_bytes"][:-self.window]
+
+    def record_compile(self, fingerprint: str, rung: str, ms: float,
+                       sql: Optional[str] = None) -> None:
+        with self._lock:
+            e = self._entry_locked(fingerprint, sql)
+            r = e["compile"].setdefault(rung, {"count": 0, "ms": []})
+            r["count"] += 1
+            r["ms"].append(round(float(ms), 3))
+            del r["ms"][:-self.window]
+
+    # ------------------------------------------------------------- reads
+    def rows(self) -> List[Tuple[str, str, str]]:
+        """(fingerprint, metric, value) triples for ``SHOW PROFILES`` —
+        same flat shape as SHOW METRICS, one group of rows per profile."""
+        with self._lock:
+            entries = {fp: _copy_entry(e) for fp, e in self._entries.items()}
+        out: List[Tuple[str, str, str]] = []
+        for fp in sorted(entries):
+            e = entries[fp]
+            out.append((fp, "sql", e["sql"]))
+            out.append((fp, "hits", str(e["hits"])))
+            out.append((fp, "cache_hits", str(e["cache_hits"])))
+            if e["exec_ms"]:
+                out.append((fp, "exec_ms.p50",
+                            _fmt(_percentile(e["exec_ms"], 0.5))))
+                out.append((fp, "exec_ms.max", _fmt(max(e["exec_ms"]))))
+                out.append((fp, "exec_ms.last", _fmt(e["exec_ms"][-1])))
+            if e["result_bytes"]:
+                out.append((fp, "result_bytes.last",
+                            str(e["result_bytes"][-1])))
+            for rung in sorted(e["compile"]):
+                r = e["compile"][rung]
+                out.append((fp, f"compile.{rung}.count", str(r["count"])))
+                if r["ms"]:
+                    out.append((fp, f"compile.{rung}.ms.p50",
+                                _fmt(_percentile(r["ms"], 0.5))))
+                    out.append((fp, f"compile.{rung}.ms.max",
+                                _fmt(max(r["ms"]))))
+        return out
+
+    def top_fingerprints(self, n: int = 10) -> List[str]:
+        """Hottest fingerprints by hit count — the pre-warm ordering."""
+        with self._lock:
+            ranked = sorted(self._entries.items(),
+                            key=lambda kv: kv[1]["hits"], reverse=True)
+        return [fp for fp, _ in ranked[:max(0, int(n))]]
+
+    def get(self, fingerprint: str) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            e = self._entries.get(fingerprint)
+            return None if e is None else _copy_entry(e)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    # ------------------------------------------------------- persistence
+    def snapshot(self) -> Dict[str, Any]:
+        """JSON-ready snapshot (checkpoint.py writes this as
+        profiles.json next to the catalog snapshot)."""
+        with self._lock:
+            return {
+                "version": 1,
+                "window": self.window,
+                "profiles": {fp: _copy_entry(e)
+                             for fp, e in self._entries.items()},
+            }
+
+    def load(self, data: Dict[str, Any]) -> int:
+        """Replace the store's contents with a `snapshot()` payload;
+        returns the number of profiles restored.  Unknown versions load
+        best-effort (the schema is additive)."""
+        profiles = (data or {}).get("profiles") or {}
+        with self._lock:
+            self._entries.clear()
+            for fp, e in profiles.items():
+                self._entries[fp] = {
+                    "sql": str(e.get("sql", ""))[:_SQL_KEEP],
+                    "hits": int(e.get("hits", 0)),
+                    "cache_hits": int(e.get("cache_hits", 0)),
+                    "exec_ms": [float(v) for v in
+                                e.get("exec_ms", [])][-self.window:],
+                    "result_bytes": [int(v) for v in
+                                     e.get("result_bytes", [])][-self.window:],
+                    "compile": {
+                        rung: {"count": int(r.get("count", 0)),
+                               "ms": [float(v) for v in
+                                      r.get("ms", [])][-self.window:]}
+                        for rung, r in (e.get("compile") or {}).items()
+                    },
+                    "last_seen": float(e.get("last_seen", 0.0)),
+                }
+                if len(self._entries) >= self.keep:
+                    break
+            return len(self._entries)
+
+
+def _copy_entry(e: Dict[str, Any]) -> Dict[str, Any]:
+    out = dict(e)
+    out["exec_ms"] = list(e["exec_ms"])
+    out["result_bytes"] = list(e["result_bytes"])
+    out["compile"] = {rung: {"count": r["count"], "ms": list(r["ms"])}
+                      for rung, r in e["compile"].items()}
+    return out

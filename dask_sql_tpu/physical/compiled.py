@@ -855,9 +855,13 @@ class CompiledAggregate:
         return fn
 
     def run(self) -> Table:
+        from ..observability import timed_jit_call
+
         datas = [self.table.columns[n].data for n in self.table.column_names]
         valids = [self.table.columns[n].validity for n in self.table.column_names]
-        packed = self._fn(tuple(datas), tuple(valids), self.table.row_valid)
+        packed = timed_jit_call("compiled_aggregate", self._fn,
+                                tuple(datas), tuple(valids),
+                                self.table.row_valid)
         tags = self._pack_tags
         host, present = fetch_packed(packed, self.domain)
         if not self.gcols and present.shape[0] == 0:

@@ -478,8 +478,11 @@ class CompiledJoinAggregate:
             bt = self.build_tables[k]
             c = bt.columns[bt.column_names[col]]
             build_cols[(k, col)] = (c.data, c.validity)
-        packed = self._fn(probe_datas, probe_valids, luts, build_cols,
-                          pt.row_valid)
+        from ..observability import timed_jit_call
+
+        packed = timed_jit_call("compiled_join_aggregate", self._fn,
+                                probe_datas, probe_valids, luts, build_cols,
+                                pt.row_valid)
         from .compiled import fetch_packed, unpack_row
 
         tags = self._pack_tags

@@ -212,7 +212,10 @@ class CompiledSelect:
         t = self.table
         datas = tuple(t.columns[n].data for n in t.column_names)
         valids = tuple(t.columns[n].validity for n in t.column_names)
-        mask, count_dev = self._mask_fn(datas, valids, t.row_valid)
+        from ..observability import timed_jit_call
+
+        mask, count_dev = timed_jit_call(
+            "compiled_select", self._mask_fn, datas, valids, t.row_valid)
         count_d2h()
         count = int(count_dev)  # one scalar round trip
         # without an ORDER BY, a LIMIT caps how many survivors we even pull:
@@ -229,7 +232,10 @@ class CompiledSelect:
                 valid_arrs.append(None)
         else:
             bucket = 1 << (count - 1).bit_length()
-            packed = self._gather_fn(datas, valids, mask, bucket=bucket)
+            # jit re-specializes per bucket: each new bucket is a fresh
+            # XLA compile the observability layer records per rung
+            packed = timed_jit_call("compiled_select", self._gather_fn,
+                                    datas, valids, mask, bucket=bucket)
             count_d2h()
             host = np.asarray(jax.device_get(packed))
             tags = self._pack_tags

@@ -259,6 +259,26 @@ class ShowMetricsPlugin(BaseRelPlugin):
 
 
 @Executor.add_plugin_class
+class ShowProfilesPlugin(BaseRelPlugin):
+    """SHOW PROFILES [LIKE 'pat'] — the per-fingerprint profile store
+    (observability/profiles.py) as a result set: hit counts, rolling
+    exec wall times, result bytes, and per-ladder-rung compile wall times.
+    LIKE filters on the fingerprint OR the metric name, so both
+    ``LIKE 'deadbeef%'`` and ``LIKE 'compile.%'`` narrow usefully."""
+
+    class_name = "ShowProfilesNode"
+
+    def convert(self, rel: p.ShowProfilesNode, executor) -> Table:
+        rows = executor.context.profiles.rows()
+        if rel.like:
+            rows = [r for r in rows
+                    if _like_match(rel.like, r[0]) or _like_match(rel.like, r[1])]
+        return _string_table({"Fingerprint": [r[0] for r in rows],
+                              "Metric": [r[1] for r in rows],
+                              "Value": [r[2] for r in rows]})
+
+
+@Executor.add_plugin_class
 class AnalyzeTablePlugin(BaseRelPlugin):
     """ANALYZE TABLE ... COMPUTE STATISTICS (parity: analyze_table.py:15 —
     describe-style stats as a queryable frame, NOT fed to the optimizer)."""

@@ -382,13 +382,40 @@ class ExplainPlugin(BaseRelPlugin):
             executor.context.metrics.inc("analysis.explain_estimate")
             lines = np.array(est.format_rows(), dtype=object)
         elif rel.analyze:
-            # EXPLAIN ANALYZE: run the plan with per-node tracing
+            # EXPLAIN ANALYZE: run the plan with per-node tracing, headed
+            # by the query-lifecycle stages (observability/spans.py) the
+            # active trace collected so far — queue wait, parse, bind,
+            # verify, estimate, per-rung compiles.  The execute stage is
+            # still open while this renders (the report IS the query's
+            # result), so it prints as "(open)"; the complete trace stays
+            # downloadable at /v1/trace/{qid} after the query finishes.
+            import json as _json
+
+            from ....observability import QueryTrace, current_trace
             from ...executor import Executor
 
             traced = Executor(executor.context, trace=True)
             traced.execute(rel.input)
-            text = traced.tracer.root.format() if traced.tracer.root else ""
-            lines = np.array(text.split("\n"), dtype=object)
+            root = traced.tracer.root
+            tr = current_trace()
+            if tr is not None and root is not None:
+                tr.attach_node_tree(root)
+            if getattr(rel, "fmt_json", False):
+                if tr is None:
+                    # tracing disabled: export the node tree alone so
+                    # FORMAT JSON still yields a loadable Chrome trace
+                    tr = QueryTrace(sql="EXPLAIN ANALYZE")
+                    tr.attach_node_tree(root)
+                lines = np.array([_json.dumps(tr.to_chrome_trace())],
+                                 dtype=object)
+            else:
+                out = []
+                if tr is not None:
+                    out.extend(tr.format_lines())
+                    out.append("")
+                text = root.format() if root else ""
+                out.extend(text.split("\n"))
+                lines = np.array(out, dtype=object)
         else:
             lines = np.array(rel.input.explain().split("\n"), dtype=object)
         col = rel.schema[0].name if rel.schema else "PLAN"

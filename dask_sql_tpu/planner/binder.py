@@ -137,9 +137,10 @@ class Binder:
             plan, _ = self.bind_query(stmt.query)
             lint = getattr(stmt, "lint", False)
             estimate = getattr(stmt, "estimate", False)
+            fmt_json = getattr(stmt, "fmt_json", False)
             col = "LINT" if lint else "ESTIMATE" if estimate else "PLAN"
             return p.Explain(plan, [Field(col, SqlType.VARCHAR)],
-                             stmt.analyze, lint, estimate)
+                             stmt.analyze, lint, estimate, fmt_json)
         if isinstance(stmt, a.CreateTableWith):
             return p.CreateTableNode([], stmt.name, stmt.kwargs, stmt.if_not_exists, stmt.or_replace)
         if isinstance(stmt, a.CreateTableAs):
@@ -171,6 +172,12 @@ class Binder:
         if isinstance(stmt, a.ShowMetrics):
             return p.ShowMetricsNode(
                 [Field("Metric", SqlType.VARCHAR), Field("Value", SqlType.VARCHAR)],
+                stmt.like)
+        if isinstance(stmt, a.ShowProfiles):
+            return p.ShowProfilesNode(
+                [Field("Fingerprint", SqlType.VARCHAR),
+                 Field("Metric", SqlType.VARCHAR),
+                 Field("Value", SqlType.VARCHAR)],
                 stmt.like)
         if isinstance(stmt, a.AnalyzeTable):
             return p.AnalyzeTableNode([], stmt.table, stmt.columns)

@@ -128,7 +128,7 @@ _K_SHOW_SCHEMAS = 88; _K_SHOW_TABLES = 89; _K_SHOW_COLUMNS = 90
 _K_SHOW_MODELS = 91; _K_ANALYZE_TABLE = 92; _K_CREATE_MODEL = 93
 _K_DROP_MODEL = 94; _K_DESCRIBE_MODEL = 95; _K_EXPORT_MODEL = 96
 _K_CREATE_EXPERIMENT = 97; _K_KWARGS = 98; _K_KV = 99; _K_KWLIST = 100
-_K_SHOW_METRICS = 101
+_K_SHOW_METRICS = 101; _K_SHOW_PROFILES = 102
 
 _FRAME_KINDS = ["UNBOUNDED_PRECEDING", "PRECEDING", "CURRENT_ROW",
                 "FOLLOWING", "UNBOUNDED_FOLLOWING"]
@@ -150,9 +150,10 @@ def _get_parser_lib():
             ]
             lib.dsql_buf_free.argtypes = [ctypes.POINTER(ctypes.c_uint8)]
             lib.dsql_parser_abi_version.restype = ctypes.c_int32
-            # grammar version 3 = EXPLAIN ESTIMATE; a stale .so predating it
-            # is rejected here so the Python parser handles the syntax
-            _parser_ok = lib.dsql_parser_abi_version() == 3
+            # grammar version 4 = SHOW PROFILES + EXPLAIN ANALYZE FORMAT
+            # JSON; a stale .so predating it is rejected here so the
+            # Python parser handles the syntax
+            _parser_ok = lib.dsql_parser_abi_version() == 4
         except AttributeError:
             _parser_ok = False
     return lib if _parser_ok else None
@@ -527,7 +528,8 @@ def _decode_statement(f: "_FlatAst", sid: int):
         return a.QueryStatement(_decode_select(f, kids[0]))
     if kind == _K_EXPLAIN_STMT:
         return a.ExplainStatement(_decode_select(f, kids[0]), bool(flags & 1),
-                                  bool(flags & 2), bool(flags & 4))
+                                  bool(flags & 2), bool(flags & 4),
+                                  bool(flags & 8))
     if kind == _K_CREATE_TABLE_WITH:
         return a.CreateTableWith(_decode_qname(f, kids[0]),
                                  _decode_kwargs(f, kids[1]), ine, orr)
@@ -559,6 +561,8 @@ def _decode_statement(f: "_FlatAst", sid: int):
         return a.ShowModels(f.s(s0))
     if kind == _K_SHOW_METRICS:
         return a.ShowMetrics(f.s(s0))
+    if kind == _K_SHOW_PROFILES:
+        return a.ShowProfiles(f.s(s0))
     if kind == _K_ANALYZE_TABLE:
         cols = [f.s(f.nodes[p][4]) for p in kids[1:]]
         return a.AnalyzeTable(_decode_qname(f, kids[0]), cols)
@@ -598,7 +602,7 @@ _P_ALTER_SCHEMA = 26; _P_ALTER_TABLE = 27; _P_SHOW_SCHEMAS = 28
 _P_SHOW_TABLES = 29; _P_SHOW_COLUMNS = 30; _P_SHOW_MODELS = 31
 _P_ANALYZE_TABLE = 32; _P_CREATE_MODEL = 33; _P_DROP_MODEL = 34
 _P_DESCRIBE_MODEL = 35; _P_EXPORT_MODEL = 36; _P_CREATE_EXPERIMENT = 37
-_P_PREDICT_MODEL = 38; _P_SHOW_METRICS = 39
+_P_PREDICT_MODEL = 38; _P_SHOW_METRICS = 39; _P_SHOW_PROFILES = 40
 _P_FIELD = 50; _P_SORTKEY = 51; _P_ON_PAIR = 52; _P_VALUES_ROW = 53
 _P_PART = 54; _P_KWARGS = 55; _P_KV = 56; _P_KWLIST = 57; _P_WINSPEC = 58
 _P_FRAME_BOUND = 59
@@ -637,8 +641,9 @@ def _get_binder_lib():
                 ctypes.POINTER(ctypes.c_int64),
             ]
             lib.dsql_binder_abi_version.restype = ctypes.c_int32
-            # version 4 = EXPLAIN ESTIMATE flag bit + ESTIMATE field name
-            _binder_ok = lib.dsql_binder_abi_version() == 4
+            # version 5 = P_SHOW_PROFILES + the FORMAT JSON flag bit
+            # riding through P_EXPLAIN
+            _binder_ok = lib.dsql_binder_abi_version() == 5
         except AttributeError:
             _binder_ok = False
     return lib if _binder_ok else None
@@ -956,7 +961,7 @@ class _PlanDecoder:
         if kind == _P_EXPLAIN:
             return p.Explain(self.plan(kids[0]), self.fields(kids[1:]),
                              bool(flags & 1), bool(flags & 2),
-                             bool(flags & 4))
+                             bool(flags & 4), bool(flags & 8))
         # ---- DDL / ML custom nodes ----
         ine = bool(flags & 1)
         orr = bool(flags & 2)
@@ -998,6 +1003,9 @@ class _PlanDecoder:
         if kind == _P_SHOW_METRICS:
             like = F.s(s0) if flags & 1 else None
             return p.ShowMetricsNode(self.fields(kids), like)
+        if kind == _P_SHOW_PROFILES:
+            like = F.s(s0) if flags & 1 else None
+            return p.ShowProfilesNode(self.fields(kids), like)
         if kind == _P_ANALYZE_TABLE:
             table = [F.s(F.nodes[i][4]) for i in kids if F.nodes[i][1] == 0]
             columns = [F.s(F.nodes[i][4]) for i in kids if F.nodes[i][1] == 1]
@@ -1109,7 +1117,7 @@ def _get_planner_lib():
                 ctypes.POINTER(ctypes.c_int64),
             ]
             lib.dsql_optimizer_abi_version.restype = ctypes.c_int32
-            _planner_ok = lib.dsql_optimizer_abi_version() == 4
+            _planner_ok = lib.dsql_optimizer_abi_version() == 5
         except AttributeError:
             _planner_ok = False
     return lib if _planner_ok else None

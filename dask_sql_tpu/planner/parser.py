@@ -138,9 +138,18 @@ class Parser:
             lint = False if analyze else self.accept_keyword("LINT")
             estimate = False if (analyze or lint) \
                 else self.accept_keyword("ESTIMATE")
+            fmt_json = False
+            if self.accept_keyword("FORMAT"):
+                self.expect_keyword("JSON")
+                if not analyze:
+                    # reject now rather than silently return text a JSON
+                    # client would choke on: only ANALYZE produces the
+                    # Chrome-trace payload
+                    raise self.error("FORMAT JSON requires EXPLAIN ANALYZE")
+                fmt_json = True
             self.accept_keyword("VERBOSE")
             return a.ExplainStatement(self.parse_query(), analyze, lint,
-                                      estimate)
+                                      estimate, fmt_json)
         if self.at_keyword("CREATE"):
             return self.parse_create()
         if self.at_keyword("DROP"):
@@ -279,8 +288,14 @@ class Parser:
             if self.accept_keyword("LIKE"):
                 like = self.next().value
             return a.ShowMetrics(like)
+        if self.accept_keyword("PROFILES"):
+            like = None
+            if self.accept_keyword("LIKE"):
+                like = self.next().value
+            return a.ShowProfiles(like)
         raise self.error(
-            "Expected SCHEMAS, TABLES, COLUMNS, MODELS or METRICS after SHOW")
+            "Expected SCHEMAS, TABLES, COLUMNS, MODELS, METRICS or PROFILES "
+            "after SHOW")
 
     def parse_alter(self) -> a.Statement:
         self.expect_keyword("ALTER")

@@ -337,13 +337,14 @@ class Explain(LogicalPlan):
     analyze: bool = False
     lint: bool = False  # EXPLAIN LINT: static verifier findings as rows
     estimate: bool = False  # EXPLAIN ESTIMATE: static cost/memory intervals
+    fmt_json: bool = False  # FORMAT JSON: Chrome-trace JSON (with ANALYZE)
 
     def inputs(self):
         return [self.input]
 
     def with_inputs(self, inputs):
         return Explain(inputs[0], self.schema, self.analyze, self.lint,
-                       self.estimate)
+                       self.estimate, self.fmt_json)
 
 
 # ---------------------------------------------------------------------------
@@ -440,6 +441,14 @@ class ShowModelsNode(CustomNode):
 @dataclass(eq=False)
 class ShowMetricsNode(CustomNode):
     """SHOW METRICS — serving runtime observability (serving/metrics.py)."""
+
+    like: Optional[str] = None
+
+
+@dataclass(eq=False)
+class ShowProfilesNode(CustomNode):
+    """SHOW PROFILES — per-fingerprint query profiles
+    (observability/profiles.py)."""
 
     like: Optional[str] = None
 

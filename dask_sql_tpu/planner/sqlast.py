@@ -312,15 +312,18 @@ class QueryStatement(Statement):
 
 @dataclass
 class ExplainStatement(Statement):
-    """EXPLAIN [ANALYZE|LINT|ESTIMATE] <query> — LINT runs the static plan
-    verifier (analysis/verifier.py), ESTIMATE the static cost & memory
-    abstract interpreter (analysis/estimator.py); both return their
-    findings as a result set without executing the query."""
+    """EXPLAIN [ANALYZE|LINT|ESTIMATE] [FORMAT JSON] <query> — LINT runs
+    the static plan verifier (analysis/verifier.py), ESTIMATE the static
+    cost & memory abstract interpreter (analysis/estimator.py); both return
+    their findings as a result set without executing the query.  FORMAT
+    JSON with ANALYZE emits the query-lifecycle trace as Chrome-trace JSON
+    (observability/spans.py) instead of the text tree."""
 
     query: Select
     analyze: bool = False
     lint: bool = False
     estimate: bool = False
+    fmt_json: bool = False
 
 
 @dataclass
@@ -402,6 +405,14 @@ class ShowModels(Statement):
 @dataclass
 class ShowMetrics(Statement):
     """SHOW METRICS: serving-runtime counters/histograms as a result set."""
+
+    like: Optional[str] = None
+
+
+@dataclass
+class ShowProfiles(Statement):
+    """SHOW PROFILES: per-fingerprint query profiles (observability/
+    profiles.py — hits, exec/compile wall times, result bytes)."""
 
     like: Optional[str] = None
 

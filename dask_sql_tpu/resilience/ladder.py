@@ -37,6 +37,7 @@ import hashlib
 import logging
 from typing import Callable, Optional, TypeVar
 
+from ..observability import trace_event
 from .errors import QueryError, classify
 from . import faults
 
@@ -88,6 +89,7 @@ def attempt(executor, rung: str, fn: Callable[[], Optional[T]],
     if skip_rungs and rung in skip_rungs:
         metrics.inc("analysis.rung_skip")
         metrics.inc(f"analysis.rung_skip.{rung}")
+        trace_event(f"rung_proof_skip:{rung}")
         logger.debug("plan verifier marked rung %s doomed: skipping", rung)
         return None
     breaker = _breaker_of(executor)
@@ -97,6 +99,7 @@ def attempt(executor, rung: str, fn: Callable[[], Optional[T]],
         if not breaker.allow(key):
             metrics.inc("resilience.breaker.skip")
             metrics.inc(f"resilience.breaker.skip.{rung}")
+            trace_event(f"breaker_skip:{rung}", fingerprint=key[0])
             logger.debug("breaker open for rung %s: skipping", rung)
             return None
     try:
@@ -117,6 +120,7 @@ def attempt(executor, rung: str, fn: Callable[[], Optional[T]],
             raise
         metrics.inc("resilience.degraded")
         metrics.inc(f"resilience.degraded.{rung}")
+        trace_event(f"degraded:{rung}", code=err.code)
         if executor.tracer.enabled:
             executor.tracer.event(f"degraded: {rung} [{err.code}]")
         if key is not None and breaker.record_failure(key):
@@ -169,6 +173,7 @@ def execute_interpreted(executor, rel):
         metrics = executor.context.metrics
         metrics.inc("resilience.degraded")
         metrics.inc("resilience.degraded.interpreted")
+        trace_event("degraded:interpreted", code=err.code)
         if executor.tracer.enabled:
             executor.tracer.event(f"degraded: interpreted [{err.code}]")
         logger.warning("interpreted path failed degradably (%s); "

@@ -28,7 +28,9 @@ from concurrent.futures import CancelledError
 from dataclasses import dataclass
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional
+from urllib.parse import parse_qs
 
+from .. import observability
 from ..serving.admission import (
     QueryCancelledError,
     QueryTicket,
@@ -51,6 +53,9 @@ class _QueryEntry:
     plan_done: Optional[float] = None
     finished: Optional[float] = None
     error: bool = False
+    #: the query's lifecycle trace (observability/spans.py), when tracing
+    #: is enabled — the status handler appends the serialize span to it
+    trace: Optional[observability.QueryTrace] = None
 
     def live_state(self) -> str:
         """QUEUED/RUNNING only — terminal states must come from the Future
@@ -106,10 +111,20 @@ class _QueryRegistry:
         self._terminal: "deque[str]" = deque()
 
     def submit(self, fn, priority_class: str = "interactive",
-               deadline_s: Optional[float] = None) -> str:
+               deadline_s: Optional[float] = None,
+               sql: Optional[str] = None) -> str:
         """Admit + enqueue; raises `QueueFullError` (load shed) without
         registering an entry."""
         qid = str(uuid.uuid4())
+        trace = None
+        if self.context is not None and self.context._trace_enabled():
+            # the lifecycle trace opens at SUBMIT time, so queue wait is a
+            # first-class stage; Context.sql reuses the activated trace.
+            # NOT registered in the trace store until admission succeeds —
+            # a shed query must not evict traces of queries that ran.
+            trace = observability.QueryTrace(
+                sql=sql, qid=qid, metrics=self.context.metrics,
+                profiles=self.context.profiles)
 
         def run(ticket):
             with self.lock:
@@ -126,7 +141,15 @@ class _QueryRegistry:
                     entry.started = time.monotonic()
                     self.n_queued -= 1
                     self.n_running += 1
-            return fn(lambda: self._mark_planned(qid))
+                    if trace is not None:
+                        # stage recorded once, guarded by the same
+                        # started-transition that makes retries idempotent
+                        trace.add_span("queue_wait", trace.created_perf,
+                                       time.perf_counter())
+            if trace is None:
+                return fn(lambda: self._mark_planned(qid))
+            with observability.activate(trace):
+                return fn(lambda: self._mark_planned(qid))
 
         with self.lock:
             # entry registered (and future attached) under one lock hold so
@@ -140,8 +163,11 @@ class _QueryRegistry:
                 raise
             self.entries[qid] = _QueryEntry(future=fut,
                                             submitted=time.monotonic(),
-                                            ticket=ticket)
+                                            ticket=ticket, trace=trace)
             self.n_queued += 1
+        if trace is not None:
+            self.context.traces.put(qid, trace)
+            self.context.last_trace = trace
         fut.add_done_callback(lambda f: self._finish(qid, f))
         return qid
 
@@ -187,6 +213,12 @@ class _QueryRegistry:
             self._terminal.append(qid)
             while len(self._terminal) > self.KEEP_TERMINAL:
                 self.entries.pop(self._terminal.popleft(), None)
+        if e.trace is not None and self.context is not None:
+            # terminal for EVERY outcome (result, error, deadline, cancel):
+            # close the lifecycle so failed/cancelled outliers reach the
+            # slow-query check too (finish is idempotent — a completed
+            # query's trace was already closed by TpuFrame.compute)
+            e.trace.finish(self.context.config, self.context.metrics)
 
     def get(self, qid: str) -> Optional[_QueryEntry]:
         with self.lock:
@@ -291,7 +323,7 @@ def _make_handler(context, registry: _QueryRegistry, jdbc_meta: bool):
                     deadline_s = None
             try:
                 qid = registry.submit(run, priority_class=priority_class,
-                                      deadline_s=deadline_s)
+                                      deadline_s=deadline_s, sql=sql)
             except QueueFullError as e:
                 # load shed: structured retry-after error instead of
                 # accepting unbounded work (parity: Trino's 429 + Retry-After)
@@ -313,16 +345,51 @@ def _make_handler(context, registry: _QueryRegistry, jdbc_meta: bool):
             return {"id": qid, "infoUri": "", "stats": responses.query_stats(),
                     "warnings": [], "columns": [], "data": []}
 
+        def _send_text(self, body: str, content_type: str,
+                       status: int = 200):
+            data = body.encode()
+            self.send_response(status)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
         # ------------------------------------------------------------- GET
         def do_GET(self):
-            parts = self.path.strip("/").split("/")
+            path, _, query = self.path.partition("?")
+            parts = path.strip("/").split("/")
             if len(parts) == 3 and parts[0] == "v1" and parts[1] == "statement":
                 self._status(parts[2])
                 return
-            if self.path.rstrip("/") == "/v1/empty":
+            if len(parts) == 3 and parts[0] == "v1" and parts[1] == "trace":
+                # the query's lifecycle trace as Chrome-trace JSON — load
+                # the download straight into chrome://tracing / Perfetto
+                trace = context.traces.get(parts[2])
+                if trace is None:
+                    self._send({"error": f"no trace for query {parts[2]}"},
+                               404)
+                    return
+                self._send(trace.to_chrome_trace())
+                return
+            if path.rstrip("/") == "/v1/empty":
                 self._send(self._empty_results())
                 return
-            if self.path.rstrip("/") == "/v1/metrics":
+            if path.rstrip("/") == "/v1/metrics":
+                fmt = (parse_qs(query).get("format") or ["json"])[0].lower()
+                if fmt == "prometheus":
+                    snap = registry.metrics()
+                    extra = {
+                        "serving.queue_depth": snap["queueDepth"],
+                        "serving.running": snap["running"],
+                        "serving.workers": snap["workers"],
+                        "serving.result_cache.bytes":
+                            snap.get("resultCache", {}).get("bytes", 0),
+                    }
+                    self._send_text(
+                        observability.render_prometheus(
+                            snap["registry"], extra),
+                        observability.PROMETHEUS_CONTENT_TYPE)
+                    return
                 self._send(registry.metrics())
                 return
             self._send({"error": "unknown endpoint"}, 404)
@@ -372,8 +439,20 @@ def _make_handler(context, registry: _QueryRegistry, jdbc_meta: bool):
                 "warnings": [],
             }
             if df is not None:
+                t0 = time.perf_counter()
                 payload["columns"] = responses.columns_from_frame(df)
                 payload["data"] = responses.data_from_frame(df)
+                t1 = time.perf_counter()
+                # every poll genuinely re-serializes, so every poll
+                # observes — and the metric records with tracing off too
+                context.metrics.observe("query.serialize_ms",
+                                        (t1 - t0) * 1000.0)
+                trace = entry.trace
+                if trace is not None:
+                    # atomic add-once: concurrent polls of a finished query
+                    # both serialize, but only the first records the stage
+                    trace.add_span_once("serialize", t0, t1,
+                                        rows=len(payload["data"]))
             self._send(payload)
 
         # ---------------------------------------------------------- DELETE

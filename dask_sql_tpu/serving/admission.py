@@ -98,6 +98,9 @@ def check_estimated_bytes(estimate, config, metrics=None) -> None:
     if lo > budget:
         if metrics is not None:
             metrics.inc("serving.shed_estimated_bytes")
+        from ..observability import trace_event
+
+        trace_event("shed:estimated_bytes", bytes_lo=lo, budget=budget)
         raise EstimatedBytesExceededError(lo, budget)
 
 

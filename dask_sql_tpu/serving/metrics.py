@@ -37,11 +37,15 @@ DOCUMENTED_METRICS = frozenset({
     "analysis.estimate.rows_hi",
     "analysis.estimate.rung_proof",
     "analysis.estimate.internal_error",
+    # observability/ — lifecycle tracing + slow-query log
+    "observability.slow_query",
     # planner
     "planner.optimize.fallback",
     # query lifecycle (Context / TpuFrame)
     "query.executed",
     "query.execute_ms",
+    "query.d2h_ms",
+    "query.serialize_ms",
     "query.plan_cache.hit",
     "query.plan_cache.miss",
     "query.cache.hit",
@@ -85,6 +89,7 @@ DOCUMENTED_METRIC_PREFIXES = (
     "resilience.degraded.",     # per degraded rung
     "resilience.rung.",         # per rung that answered
     "resilience.breaker.skip.",  # per breaker-skipped rung
+    "resilience.compile_ms.",   # per-rung XLA compile wall time (observability/spans.py)
     "serving.admitted.",        # per admission class
     "serving.rejected.",        # per admission class
     "executor.node.",           # per plan-node type (Tracer aggregation)
@@ -106,6 +111,17 @@ def is_documented_metric(name: str, prefix_only: bool = False) -> bool:
         return True
     return prefix_only and any(p.startswith(name)
                                for p in DOCUMENTED_METRIC_PREFIXES)
+
+
+def nearest_rank(data_sorted: List[float], q: float) -> float:
+    """Nearest-rank percentile over pre-sorted data — THE quantile formula
+    of the engine, shared by the serving histograms and the per-fingerprint
+    profile store so SHOW METRICS and SHOW PROFILES can never report
+    different p50s for the same samples."""
+    if not data_sorted:
+        return 0.0
+    n = len(data_sorted)
+    return data_sorted[min(n - 1, int(q * (n - 1) + 0.5))]
 
 
 class Histogram:
@@ -134,10 +150,7 @@ class Histogram:
 
     def percentiles(self, qs: Iterable[float] = (0.5, 0.95, 0.99)) -> List[float]:
         data = sorted(self._ring)
-        if not data:
-            return [0.0 for _ in qs]
-        n = len(data)
-        return [data[min(n - 1, int(q * (n - 1) + 0.5))] for q in qs]
+        return [nearest_rank(data, q) for q in qs]
 
     def snapshot(self) -> Dict[str, Any]:
         p50, p95, p99 = self.percentiles()

@@ -19,10 +19,21 @@ class NodeTrace:
     wall_ms: float
     rows: int
     children: List["NodeTrace"] = field(default_factory=list)
+    #: start timestamp (time.perf_counter seconds) — real timeline position,
+    #: so the observability layer can export the tree as Chrome-trace spans
+    t0: float = 0.0
 
     def format(self, indent: int = 0) -> str:
         pad = "  " * indent
-        lines = [f"{pad}{self.label}  [{self.wall_ms:.2f} ms, {self.rows} rows]"]
+        if self.node_type == "Resilience":
+            # zero-duration marker (ladder degradation step): the label IS
+            # the information — "0.00 ms, -1 rows" was noise
+            lines = [f"{pad}!! {self.label}"]
+        else:
+            # rows < 0 means "not observed" (e.g. a node that streamed its
+            # output), not a literal row count
+            rows = "? rows" if self.rows < 0 else f"{self.rows} rows"
+            lines = [f"{pad}{self.label}  [{self.wall_ms:.2f} ms, {rows}]"]
         for child in self.children:
             lines.append(child.format(indent + 1))
         return "\n".join(lines)
@@ -52,7 +63,9 @@ class Tracer:
         degradation step) at the current tree position, so EXPLAIN ANALYZE
         shows *where* the engine stepped down a rung."""
         if self.enabled:
-            self._stack[-1].append(NodeTrace("Resilience", label, 0.0, -1))
+            self._stack[-1].append(
+                NodeTrace("Resilience", label, 0.0, -1,
+                          t0=time.perf_counter()))
 
     def node(self, rel):
         tracer = self
@@ -67,7 +80,8 @@ class Tracer:
                 elapsed = (time.perf_counter() - self.t0) * 1000.0
                 children = tracer._stack.pop()
                 trace = NodeTrace(rel.node_type, rel._label(), elapsed,
-                                  getattr(self, "rows", -1), children)
+                                  getattr(self, "rows", -1), children,
+                                  t0=self.t0)
                 tracer._stack[-1].append(trace)
                 tracer.root = trace
                 return False

@@ -436,7 +436,7 @@ enum AstKind : int32_t {
   K_SHOW_MODELS = 91, K_ANALYZE_TABLE = 92, K_CREATE_MODEL = 93,
   K_DROP_MODEL = 94, K_DESCRIBE_MODEL = 95, K_EXPORT_MODEL = 96,
   K_CREATE_EXPERIMENT = 97, K_KWARGS = 98, K_KV = 99, K_KWLIST = 100,
-  K_SHOW_METRICS = 101,
+  K_SHOW_METRICS = 101, K_SHOW_PROFILES = 102,
 };
 
 struct AstNode {
@@ -649,7 +649,7 @@ enum PKind : int32_t {
   P_SHOW_TABLES = 29, P_SHOW_COLUMNS = 30, P_SHOW_MODELS = 31,
   P_ANALYZE_TABLE = 32, P_CREATE_MODEL = 33, P_DROP_MODEL = 34,
   P_DESCRIBE_MODEL = 35, P_EXPORT_MODEL = 36, P_CREATE_EXPERIMENT = 37,
-  P_PREDICT_MODEL = 38, P_SHOW_METRICS = 39,
+  P_PREDICT_MODEL = 38, P_SHOW_METRICS = 39, P_SHOW_PROFILES = 40,
   // aux
   P_FIELD = 50, P_SORTKEY = 51, P_ON_PAIR = 52, P_VALUES_ROW = 53,
   P_PART = 54, P_KWARGS = 55, P_KV = 56, P_KWLIST = 57, P_WINSPEC = 58,
@@ -3068,13 +3068,14 @@ class Binder {
         (void)fields;
         // EXPLAIN LINT (flag bit 2) returns verifier findings in a LINT
         // column; EXPLAIN ESTIMATE (bit 4) cost/memory intervals in an
-        // ESTIMATE column
+        // ESTIMATE column; FORMAT JSON (bit 8) rides through for the
+        // Chrome-trace variant of ANALYZE
         std::vector<BField> efields{
             {(n.flags & 2) ? "LINT" : (n.flags & 4) ? "ESTIMATE" : "PLAN",
              TY_VARCHAR, true}};
         return b.add(P_EXPLAIN, concat({plan}, mk_fields(efields)),
                      ((n.flags & 1) ? 1 : 0) | ((n.flags & 2) ? 2 : 0) |
-                         ((n.flags & 4) ? 4 : 0),
+                         ((n.flags & 4) ? 4 : 0) | ((n.flags & 8) ? 8 : 0),
                      1);
       }
       case K_CREATE_TABLE_WITH:
@@ -3133,6 +3134,13 @@ class Binder {
         std::vector<BField> f{{"Metric", TY_VARCHAR, true},
                               {"Value", TY_VARCHAR, true}};
         return b.add(P_SHOW_METRICS, mk_fields(f), a.has_s(n.s0) ? 1 : 0, 0,
+                     0.0, a.has_s(n.s0) ? b.intern(a.s(n.s0)) : -1);
+      }
+      case K_SHOW_PROFILES: {
+        std::vector<BField> f{{"Fingerprint", TY_VARCHAR, true},
+                              {"Metric", TY_VARCHAR, true},
+                              {"Value", TY_VARCHAR, true}};
+        return b.add(P_SHOW_PROFILES, mk_fields(f), a.has_s(n.s0) ? 1 : 0, 0,
                      0.0, a.has_s(n.s0) ? b.intern(a.s(n.s0)) : -1);
       }
       case K_ANALYZE_TABLE: {
@@ -5665,8 +5673,9 @@ int32_t dsql_bind(const char* sql, int64_t n, const uint8_t* catalog_buf,
   }
 }
 
-// version 4: EXPLAIN ESTIMATE (flag bit 4 + ESTIMATE field name on P_EXPLAIN)
-int32_t dsql_binder_abi_version() { return 4; }
+// version 5: SHOW PROFILES (P_SHOW_PROFILES) + EXPLAIN ... FORMAT JSON
+// (flag bit 8 riding through P_EXPLAIN)
+int32_t dsql_binder_abi_version() { return 5; }
 
 // Parse + bind + run the structural optimizer rule loop, all native.
 // Same rc codes as dsql_bind; `predicate_pushdown` mirrors the
@@ -5731,6 +5740,6 @@ int32_t dsql_plan(const char* sql, int64_t n, const uint8_t* catalog_buf,
 }
 
 // bumped in lockstep with the binder: dsql_plan shares its EXPLAIN encoding
-int32_t dsql_optimizer_abi_version() { return 4; }
+int32_t dsql_optimizer_abi_version() { return 5; }
 
 }  // extern "C"

@@ -71,7 +71,7 @@ enum Kind : int32_t {
   K_SHOW_MODELS = 91, K_ANALYZE_TABLE = 92, K_CREATE_MODEL = 93,
   K_DROP_MODEL = 94, K_DESCRIBE_MODEL = 95, K_EXPORT_MODEL = 96,
   K_CREATE_EXPERIMENT = 97, K_KWARGS = 98, K_KV = 99, K_KWLIST = 100,
-  K_SHOW_METRICS = 101,
+  K_SHOW_METRICS = 101, K_SHOW_PROFILES = 102,
 };
 
 // statement flag bits
@@ -394,9 +394,19 @@ class Parser {
       bool analyze = accept_keyword("ANALYZE");
       bool lint = analyze ? false : accept_keyword("LINT");
       bool estimate = (analyze || lint) ? false : accept_keyword("ESTIMATE");
+      bool fmt_json = false;
+      if (accept_keyword("FORMAT")) {
+        expect_keyword("JSON");
+        // only ANALYZE emits the Chrome-trace payload: reject now rather
+        // than silently returning text a JSON client would choke on
+        if (!analyze)
+          throw ParseErr{peek().pos, "FORMAT JSON requires EXPLAIN ANALYZE"};
+        fmt_json = true;
+      }
       accept_keyword("VERBOSE");
       return b_.add(K_EXPLAIN_STMT, {parse_query()},
-                    (analyze ? 1 : 0) | (lint ? 2 : 0) | (estimate ? 4 : 0));
+                    (analyze ? 1 : 0) | (lint ? 2 : 0) | (estimate ? 4 : 0) |
+                        (fmt_json ? 8 : 0));
     }
     if (at_keyword("CREATE")) return parse_create();
     if (at_keyword("DROP")) return parse_drop();
@@ -564,9 +574,14 @@ class Parser {
       if (accept_keyword("LIKE")) like = b_.intern(next().value);
       return b_.add(K_SHOW_METRICS, {}, 0, 0, 0.0, like);
     }
+    if (accept_keyword("PROFILES")) {
+      int32_t like = -1;
+      if (accept_keyword("LIKE")) like = b_.intern(next().value);
+      return b_.add(K_SHOW_PROFILES, {}, 0, 0, 0.0, like);
+    }
     throw ParseErr{peek().pos,
-                   "Expected SCHEMAS, TABLES, COLUMNS, MODELS or METRICS "
-                   "after SHOW"};
+                   "Expected SCHEMAS, TABLES, COLUMNS, MODELS, METRICS or "
+                   "PROFILES after SHOW"};
   }
 
   int32_t parse_alter() {
@@ -1674,8 +1689,9 @@ int32_t dsql_parse(const char* sql, int64_t n, uint8_t** out,
 
 void dsql_buf_free(uint8_t* p) { std::free(p); }
 
-// version 3: EXPLAIN ESTIMATE (flag bit 4 on K_EXPLAIN_STMT) — bumped so a
-// stale prebuilt .so is rejected and the Python parser handles the syntax
-int32_t dsql_parser_abi_version() { return 3; }
+// version 4: SHOW PROFILES (K_SHOW_PROFILES) + EXPLAIN ... FORMAT JSON
+// (flag bit 8 on K_EXPLAIN_STMT) — bumped so a stale prebuilt .so is
+// rejected and the Python parser handles the syntax
+int32_t dsql_parser_abi_version() { return 4; }
 
 }  // extern "C"
