@@ -295,6 +295,79 @@ def run_profile_smoke():
         raise SystemExit(1)
 
 
+def run_coldstart_smoke():
+    """`bench.py --coldstart`: zero-cold-start restart smoke.
+
+    Serves the benchmark query cold (foreground compiles, persistent
+    executable cache filling), snapshots, then restarts the Context
+    in-process: load_state restores tables + profiles and kicks the
+    profile-driven warm-up.  Asserts the restart contract — the warm-up
+    reaches ready, the pre-warmed fingerprint's first query shows ZERO
+    foreground ``compile:<rung>`` spans in its lifecycle trace, and the
+    persistent cache recorded at least one cross-"process" hit — and
+    reports cold-vs-warm first-query latency.  Exit 1 on violation.
+    """
+    import json as _json
+    import os
+    import tempfile
+
+    import jax
+
+    _ensure_backend()
+    from dask_sql_tpu import Context
+    from dask_sql_tpu import config as config_module
+    from dask_sql_tpu.serving import compile_cache
+
+    work = tempfile.mkdtemp(prefix="dsql_coldstart_")
+    config_module.config.update({
+        "serving.cache.enabled": False,
+        "serving.compile_cache.path": os.path.join(work, "compile-cache"),
+    })
+    df = gen_lineitem(100_000, seed=0)
+
+    c1 = Context()
+    c1.create_table("lineitem", df)
+    t0 = time.perf_counter()
+    cold = c1.sql(QUERY, return_futures=False)
+    cold_ms = (time.perf_counter() - t0) * 1000.0
+    c1.sql(QUERY).execute()  # second hit: the fingerprint is clearly hot
+    snap = os.path.join(work, "snapshot")
+    c1.save_state(snap)
+
+    c2 = Context()  # the "restarted process"
+    c2.load_state(snap)
+    warm = c2.warmup
+    warmed = ready = 0
+    if warm is not None:
+        warm.join(300)
+        ready = int(warm.ready)
+        warmed = warm.warmed
+    t0 = time.perf_counter()
+    out = c2.sql(QUERY, return_futures=False)
+    warm_ms = (time.perf_counter() - t0) * 1000.0
+    tr = c2.last_trace
+    fg_compiles = [s.name for s in tr.spans if s.name.startswith("compile:")]
+    same = len(out) == len(cold) and np.allclose(
+        out["sum_qty"].to_numpy(np.float64),
+        cold["sum_qty"].to_numpy(np.float64), rtol=1e-9)
+
+    ok = bool(ready and warmed >= 1 and not fg_compiles and same)
+    print(_json.dumps({
+        "metric": "coldstart_smoke",
+        "backend": jax.default_backend(),
+        "ok": ok,
+        "cold_first_query_ms": round(cold_ms, 2),
+        "warm_first_query_ms": round(warm_ms, 2),
+        "cold_over_warm": round(cold_ms / warm_ms, 2) if warm_ms else None,
+        "warmed_fingerprints": warmed,
+        "foreground_compile_spans": fg_compiles,
+        "persistent_cache": compile_cache.stats(),
+        "results_match": bool(same),
+    }), flush=True)
+    if not ok:
+        raise SystemExit(1)
+
+
 def run_lint_smoke():
     """`bench.py --lint`: static-analysis smoke.
 
@@ -341,6 +414,9 @@ def main():
         return
     if "--profile" in sys.argv:
         run_profile_smoke()
+        return
+    if "--coldstart" in sys.argv:
+        run_coldstart_smoke()
         return
 
     import jax

@@ -177,6 +177,17 @@ def save_state(context: "Context", location: str) -> dict:
             json.dump(profiles.snapshot(), f)
         manifest["profiles"] = "profiles.json"
 
+    breaker = getattr(context, "breaker", None)
+    if breaker is not None:
+        # open circuit-breaker verdicts ride along too: a restarted process
+        # must not burn its recovery window re-proving rungs this one
+        # already proved bad (restore is TTL-bounded, see load_state)
+        bsnap = breaker.snapshot_state()
+        if bsnap["open"]:
+            with open(os.path.join(snap_dir, "breaker.json"), "w") as f:
+                json.dump(bsnap, f)
+            manifest["breaker"] = "breaker.json"
+
     with open(os.path.join(snap_dir, "manifest.json"), "w") as f:
         json.dump(manifest, f, indent=1)
     # fault-injection site (resilience/faults.py): a crash HERE — snapshot
@@ -234,4 +245,17 @@ def load_state(context: "Context", location: str) -> dict:
             with open(path) as f:
                 restored = context.profiles.load(json.load(f))
             logger.info("load_state: restored %d query profiles", restored)
+    breaker_rel = manifest.get("breaker")
+    if breaker_rel and getattr(context, "breaker", None) is not None:
+        ttl = float(context.config.get(
+            "resilience.breaker.persist_ttl_s", 300.0) or 0.0)
+        path = os.path.join(snap_dir, breaker_rel)
+        if ttl > 0 and os.path.exists(path):
+            with open(path) as f:
+                n = context.breaker.load_state(json.load(f), ttl_s=ttl)
+            if n:
+                context.metrics.inc("resilience.breaker.restored", n)
+                logger.info(
+                    "load_state: restored %d open breaker verdicts "
+                    "(ttl %.0fs)", n, ttl)
     return manifest

@@ -83,6 +83,15 @@ DEFAULTS: Dict[str, Any] = {
     # non-retryable ESTIMATED_BYTES_EXCEEDED before any compilation.
     # None disables the gate.
     "serving.admission.max_estimated_bytes": None,
+    # Zero-cold-start serving (docs/serving.md "Cold starts"): persistent
+    # executable cache + profile-driven pre-warm + background recompile.
+    "serving.compile_cache.path": None,  # dir for the persistent XLA executable cache (None = off)
+    "serving.compile_cache.min_compile_time_s": 0.0,  # only persist compiles at least this slow
+    "serving.warmup.enabled": True,  # pre-warm top profiled fingerprints after load_state / server boot
+    "serving.warmup.top_n": 8,  # how many hot fingerprints the warm-up replays
+    "serving.warmup.throttle_s": 0.0,  # pause between warm statements (rate-limit boot device load)
+    "serving.bg_compile.enabled": False,  # recompile grown/replaced plan families off the critical path
+    "serving.bg_compile.max_pending": 8,  # bounded background-compile queue (past it: foreground)
     "serving.cache.enabled": True,  # result cache for repeated identical queries
     "serving.cache.max_bytes": 256 << 20,  # total resident bytes before LRU eviction
     "serving.cache.max_entry_bytes": 64 << 20,  # per-entry cap (huge results bypass the cache)
@@ -108,8 +117,11 @@ DEFAULTS: Dict[str, Any] = {
     "resilience.breaker.enabled": True,  # per-plan-fingerprint circuit breaker on ladder rungs
     "resilience.breaker.threshold": 3,  # consecutive failures before a rung is skipped
     "resilience.breaker.cooldown_s": 30.0,  # seconds before a half-open trial is admitted
+    "resilience.breaker.persist_ttl_s": 300.0,  # max age of checkpointed breaker verdicts restored on load_state (0 = never restore)
+    "resilience.compile_timeout_ms": None,  # watchdog deadline on any XLA compile (None = off); expiry degrades the rung
     "resilience.inject": None,  # fault-injection spec, e.g. "compile:0.5,oom:once" (tests only)
     "resilience.inject.seed": 0,  # PRNG seed for probabilistic fault modes
+    "resilience.inject.hang_s": 30.0,  # sleep modeled by HANG fault sites (compile_hang)
 }
 
 

@@ -65,6 +65,10 @@ class TpuFrame:
         #: (observability/spans.py) — lazy execute/compute re-activate it so
         #: plan-time and run-time spans land on ONE trace
         self._trace: Optional[observability.QueryTrace] = None
+        #: the FULL statement text (the trace's copy is display-truncated);
+        #: recorded into the per-fingerprint profile so the pre-warm pass
+        #: can replay it verbatim after a restart
+        self._sql: Optional[str] = None
         #: cached plan fingerprint (resilience/ladder.py plan_fingerprint)
         self._fingerprint: Optional[str] = None
 
@@ -94,7 +98,7 @@ class TpuFrame:
             fp = self._fingerprint
             if fp is None:
                 fp = self._fingerprint = plan_fingerprint(self._plan)
-            sql_text = tr.sql if tr is not None else None
+            sql_text = self._sql or (tr.sql if tr is not None else None)
 
             def _finish_on_error(exc_type, exc, tb):
                 # a failing query's lifecycle ends HERE — the slowest, most
@@ -294,6 +298,24 @@ class Context:
         #: shape that repeatedly kills a compiled rung skips straight to
         #: its known-good rung (resilience/ladder.py consults this)
         self.breaker = CircuitBreaker.from_config(self.config)
+        #: the active warm-up pass (serving/warmup.py) after load_state /
+        #: server boot; /v1/health reports its warming->ready transition
+        self.warmup = None
+        #: lazily-created background recompiler (serving/background.py);
+        #: guarded by _plan_lock — use background_compiler() to read
+        self._bg_compiler = None
+        #: plan family ((rung tag, key-minus-bucket) tuple) -> table bucket
+        #: (uid, rows, padded_rows) last compiled by THIS context: a
+        #: plugin-cache miss whose family maps to a DIFFERENT bucket means
+        #: the table grew/was replaced — the background-recompile trigger
+        #: (physical/compiled.py).  Guarded by _plan_lock.
+        self._compiled_families: dict = {}
+        from .serving import compile_cache
+
+        # persistent executable cache: when serving.compile_cache.path is
+        # set, XLA executables survive the process (restart = deserialize,
+        # not recompile; docs/serving.md "Cold starts")
+        compile_cache.maybe_enable(self.config, self.metrics)
         logging.basicConfig(level=logging_level)
 
     _PLAN_CACHE_CAP = 128
@@ -563,10 +585,62 @@ class Context:
         return checkpoint.save_state(self, location)
 
     def load_state(self, location: str) -> dict:
-        """Re-hydrate a `save_state` snapshot into this Context."""
+        """Re-hydrate a `save_state` snapshot into this Context, then kick
+        the profile-driven warm-up so the restored process compiles its hot
+        query families before (or while) traffic arrives."""
         from . import checkpoint
 
-        return checkpoint.load_state(self, location)
+        manifest = checkpoint.load_state(self, location)
+        self.maybe_start_warmup()
+        return manifest
+
+    def maybe_start_warmup(self):
+        """Start a background warm-up over the hottest profiled
+        fingerprints (serving/warmup.py), when configured and there is
+        anything to warm.  Idempotent while a pass is running; a finished
+        pass is replaced (a second load_state re-warms).  Returns the
+        `WarmupManager` or None."""
+        if not self.config.get("serving.warmup.enabled", True):
+            return None
+        top_n = int(self.config.get("serving.warmup.top_n", 8) or 0)
+        if top_n <= 0 or not len(self.profiles):
+            return None
+        if self.warmup is not None and not self.warmup.ready:
+            return self.warmup  # a pass is already in flight
+        from .serving.warmup import WarmupManager
+
+        manager = WarmupManager(
+            self, top_n=top_n,
+            throttle_s=float(self.config.get(
+                "serving.warmup.throttle_s", 0.0) or 0.0))
+        self.warmup = manager
+        self._register_background(manager)
+        return manager.start()
+
+    def background_compiler(self):
+        """The bounded background recompiler (serving/background.py), or
+        None when ``serving.bg_compile.enabled`` is off.  Created lazily so
+        non-serving Contexts never start the thread."""
+        if not self.config.get("serving.bg_compile.enabled", False):
+            return None
+        with self._plan_lock:
+            bg = self._bg_compiler
+            if bg is None:
+                from .serving.background import BackgroundCompiler
+
+                bg = self._bg_compiler = BackgroundCompiler.from_config(
+                    self.config, metrics=self.metrics)
+            else:
+                return bg
+        self._register_background(bg)
+        return bg
+
+    def _register_background(self, worker) -> None:
+        """Hand a cancellable/joinable background worker to the serving
+        runtime (if one is attached) so shutdown(wait=True) drains it."""
+        runtime = self.serving
+        if runtime is not None:
+            runtime.register_background(worker)
 
     # ------------------------------------------------------------ models
     def register_model(self, model_name: str, model: Any,
@@ -661,6 +735,7 @@ class Context:
                     tr.finish(self.config, self.metrics)
                 return None
             result._trace = tr
+            result._sql = sql
             if return_futures:
                 return result
             return result.compute()

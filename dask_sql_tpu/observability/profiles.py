@@ -23,8 +23,11 @@ import time
 from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Tuple
 
-#: truncation for the remembered SQL text of a fingerprint
-_SQL_KEEP = 200
+#: truncation for the remembered SQL text of a fingerprint.  Sized so that
+#: realistic serving statements survive whole: the pre-warm pass
+#: (serving/warmup.py) REPLAYS this text after a restart, and a truncated
+#: statement is unreplayable (flagged `sql_truncated`, skipped by warm-up).
+_SQL_KEEP = 4096
 
 
 def _percentile(values: List[float], q: float) -> float:
@@ -57,6 +60,7 @@ class ProfileStore:
         if e is None:
             e = self._entries[fingerprint] = {
                 "sql": (sql or "")[:_SQL_KEEP],
+                "sql_truncated": len(sql or "") > _SQL_KEEP,
                 "hits": 0,
                 "cache_hits": 0,
                 "exec_ms": [],
@@ -66,6 +70,7 @@ class ProfileStore:
             }
         elif sql and not e["sql"]:
             e["sql"] = sql[:_SQL_KEEP]
+            e["sql_truncated"] = len(sql) > _SQL_KEEP
         self._entries.move_to_end(fingerprint)
         while len(self._entries) > self.keep:
             self._entries.popitem(last=False)
@@ -134,6 +139,17 @@ class ProfileStore:
                             key=lambda kv: kv[1]["hits"], reverse=True)
         return [fp for fp, _ in ranked[:max(0, int(n))]]
 
+    def warm_candidates(self, n: int = 10) -> List[Tuple[str, str]]:
+        """(fingerprint, sql) for the hottest REPLAYABLE fingerprints — the
+        pre-warm work list (serving/warmup.py).  Entries with no recorded
+        SQL or a truncation-lossy one are excluded: replaying a prefix
+        would warm (or fail) the wrong statement."""
+        with self._lock:
+            ranked = sorted(self._entries.items(),
+                            key=lambda kv: kv[1]["hits"], reverse=True)
+            return [(fp, e["sql"]) for fp, e in ranked[:max(0, int(n))]
+                    if e["sql"] and not e.get("sql_truncated")]
+
     def get(self, fingerprint: str) -> Optional[Dict[str, Any]]:
         with self._lock:
             e = self._entries.get(fingerprint)
@@ -144,12 +160,17 @@ class ProfileStore:
             return len(self._entries)
 
     # ------------------------------------------------------- persistence
+    #: the pre-version-2 truncation cap: entries in legacy snapshots whose
+    #: SQL reaches it may be silent prefixes of the real statement
+    _LEGACY_SQL_KEEP = 200
+
     def snapshot(self) -> Dict[str, Any]:
         """JSON-ready snapshot (checkpoint.py writes this as
-        profiles.json next to the catalog snapshot)."""
+        profiles.json next to the catalog snapshot).  Version 2 carries
+        the per-entry ``sql_truncated`` flag the warm-up relies on."""
         with self._lock:
             return {
-                "version": 1,
+                "version": 2,
                 "window": self.window,
                 "profiles": {fp: _copy_entry(e)
                              for fp, e in self._entries.items()},
@@ -160,11 +181,20 @@ class ProfileStore:
         returns the number of profiles restored.  Unknown versions load
         best-effort (the schema is additive)."""
         profiles = (data or {}).get("profiles") or {}
+        # a version-1 snapshot predates the flag AND used a 200-char cap:
+        # an entry whose SQL reaches that cap may be a silent prefix of the
+        # real statement — mark it truncated so warm-up never replays a
+        # prefix that happens to parse as a different (wrong) query
+        legacy = int((data or {}).get("version") or 1) < 2
         with self._lock:
             self._entries.clear()
             for fp, e in profiles.items():
+                sql = str(e.get("sql", ""))[:_SQL_KEEP]
                 self._entries[fp] = {
-                    "sql": str(e.get("sql", ""))[:_SQL_KEEP],
+                    "sql": sql,
+                    "sql_truncated": bool(e.get(
+                        "sql_truncated",
+                        legacy and len(sql) >= self._LEGACY_SQL_KEEP)),
                     "hits": int(e.get("hits", 0)),
                     "cache_hits": int(e.get("cache_hits", 0)),
                     "exec_ms": [float(v) for v in

@@ -330,7 +330,8 @@ def _jit_cache_size(fn) -> Optional[int]:
         return None
 
 
-def timed_jit_call(rung: str, fn, *args, **kwargs):
+def timed_jit_call(rung: str, fn, *args, may_compile: Optional[bool] = None,
+                   **kwargs):
     """Invoke a `jax.jit` callable, recording the call as a fresh XLA
     compile for `rung` when the jit's executable cache grew.
 
@@ -338,10 +339,44 @@ def timed_jit_call(rung: str, fn, *args, **kwargs):
     histogram observation and a per-fingerprint ProfileStore entry (via the
     installed `compile_sink` — independent of tracing, so SHOW METRICS and
     the pre-warm input stay populated with tracing disabled), plus a
-    ``compile:<rung>`` detail span when a trace is active."""
+    ``compile:<rung>`` detail span when a trace is active.  When the
+    persistent executable cache (serving/compile_cache.py) is enabled, the
+    span carries a ``persistent_hit`` flag and the compile is counted as
+    ``resilience.compile_cache.hit`` / ``.miss``.
+
+    ``may_compile`` is the caller's hint about whether THIS call can
+    trigger a fresh compile (False = the shape is known-warm).  When a
+    compile is possible and ``resilience.compile_timeout_ms`` is set, the
+    call runs under the compile watchdog (resilience/watchdog.py): a hung
+    or exploding compile raises a degradable `CompileTimeoutError` instead
+    of wedging the serving worker."""
+    metrics = profiles = fingerprint = sql = None
+    sink = _sink.get()
+    if sink is not None:
+        metrics, profiles, fingerprint, sql = sink
+    tr = current_trace()
+    if tr is not None and metrics is None:
+        metrics = tr.metrics
     before = _jit_cache_size(fn)
+    pc_hits0 = None
+    from ..serving import compile_cache
+
+    if compile_cache.enabled_path() is not None:
+        pc_hits0 = compile_cache.hit_count()
     t0 = time.perf_counter()
-    out = fn(*args, **kwargs)
+    deadline_ms = None
+    if may_compile is not False:
+        from ..config import config as _config
+        from ..resilience import faults, watchdog
+
+        deadline_ms = watchdog.timeout_ms(_config)
+    if deadline_ms is not None:
+        out = watchdog.watched_call(
+            rung, fn, args, kwargs, deadline_ms=deadline_ms,
+            hang_s=faults.hang_duration("compile_hang", _config),
+            metrics=metrics)
+    else:
+        out = fn(*args, **kwargs)
     if before is None:
         return out
     after = _jit_cache_size(fn)
@@ -349,16 +384,19 @@ def timed_jit_call(rung: str, fn, *args, **kwargs):
         return out
     t1 = time.perf_counter()
     ms = (t1 - t0) * 1000.0
-    metrics = profiles = fingerprint = sql = None
-    sink = _sink.get()
-    if sink is not None:
-        metrics, profiles, fingerprint, sql = sink
-    tr = current_trace()
+    persistent_hit = None
+    if pc_hits0 is not None:
+        # best-effort attribution: a concurrent query's compile can land in
+        # the same window, but a false positive only flips a trace flag
+        persistent_hit = compile_cache.hit_count() > pc_hits0
+        if metrics is not None:
+            metrics.inc("resilience.compile_cache.hit" if persistent_hit
+                        else "resilience.compile_cache.miss")
     if tr is not None:
         fingerprint = tr.fingerprint or fingerprint
         tr.add_span(f"compile:{rung}", t0, t1, kind=DETAIL, parent="execute",
-                    rung=rung, fingerprint=fingerprint)
-        metrics = metrics if metrics is not None else tr.metrics
+                    rung=rung, fingerprint=fingerprint,
+                    persistent_hit=persistent_hit)
         profiles = profiles if profiles is not None else tr.profiles
         sql = sql or tr.sql
     if metrics is not None:

@@ -792,6 +792,9 @@ class CompiledAggregate:
         self._pack_tags: List[Tuple[str, np.dtype]] = []
         self._fn = jax.jit(self._build())
         # warming is left to the caller; tracing happens on first call
+        #: True once _fn compiled for this table's shapes — the compile
+        #: watchdog only watches calls that may compile
+        self._warm = False
 
     def _build(self) -> Callable:
         # metadata-only eval inside the closure: no device buffers pinned
@@ -854,14 +857,21 @@ class CompiledAggregate:
 
         return fn
 
-    def run(self) -> Table:
+    def run(self, table: Optional[Table] = None) -> Table:
         from ..observability import timed_jit_call
 
-        datas = [self.table.columns[n].data for n in self.table.column_names]
-        valids = [self.table.columns[n].validity for n in self.table.column_names]
+        # the input table is a PARAMETER, not shared object state: cached
+        # pipelines are hit by concurrent server worker threads, and the
+        # historical set-run-reset dance on self.table let one thread's
+        # reset null the table out from under another's run
+        table = table if table is not None else self.table
+        datas = [table.columns[n].data for n in table.column_names]
+        valids = [table.columns[n].validity for n in table.column_names]
         packed = timed_jit_call("compiled_aggregate", self._fn,
                                 tuple(datas), tuple(valids),
-                                self.table.row_valid)
+                                table.row_valid,
+                                may_compile=not self._warm)
+        self._warm = True
         tags = self._pack_tags
         host, present = fetch_packed(packed, self.domain)
         if not self.gcols and present.shape[0] == 0:
@@ -919,6 +929,21 @@ class CompiledAggregate:
 _CACHE_CAP = 32
 _cache: "OrderedDict[Tuple, CompiledAggregate]" = __import__(
     "collections").OrderedDict()
+#: cap on the per-context compiled-family set (context._compiled_families:
+#: a key miss for a SEEN family means the table grew or was replaced, which
+#: is the background-recompile trigger, ISSUE 7 — the query is served
+#: interpreted while the new bucket compiles off-path)
+_FAMILY_CAP = 256
+
+
+def _family_of(key: Tuple) -> Tuple:
+    # drop (uid, num_rows, padded_rows); keep plan shape + segsum mode
+    return ("compiled_aggregate",) + key[1:-3] + (key[-1],)
+
+
+def _bucket_of(key: Tuple) -> Tuple:
+    # the table-identity part the family drops: (uid, num_rows, padded_rows)
+    return (key[0], key[-3], key[-2])
 
 
 def try_compiled_aggregate(rel: p.Aggregate, executor) -> Optional[Table]:
@@ -930,10 +955,11 @@ def try_compiled_aggregate(rel: p.Aggregate, executor) -> Optional[Table]:
         return None
     scan, filters, group_exprs, agg_exprs = chain
     try:
+        ctx = executor.context
         table = executor.get_table(scan.schema_name, scan.table_name)
         if scan.projection is not None:
             table = table.select(scan.projection)
-        dc = executor.context.schema[scan.schema_name].tables.get(scan.table_name)
+        dc = ctx.schema[scan.schema_name].tables.get(scan.table_name)
         if dc is None:
             return None  # view-backed scans take the eager path
         key = (
@@ -948,23 +974,104 @@ def try_compiled_aggregate(rel: p.Aggregate, executor) -> Optional[Table]:
         )
         mode = str(executor.config.get("sql.compile.segsum", "auto"))
         key = key + (mode,)
-        compiled = _cache.get(key)
+        # the plugin cache (and the background compiler's swap) are guarded
+        # by the plan-cache lock: server worker threads share these dicts
+        with ctx._plan_lock:
+            compiled = _cache.get(key)
+            if compiled is not None:
+                _cache.move_to_end(key)
         if compiled is None:
-            compiled = CompiledAggregate(rel, table, scan, filters, group_exprs,
-                                         agg_exprs, executor.config)
-            _cache[key] = compiled
-            while len(_cache) > _CACHE_CAP:
-                _cache.popitem(last=False)
-        else:
-            _cache.move_to_end(key)
-            compiled.table = table
-        try:
-            from ..resilience import faults
-
-            faults.maybe_inject("oom", executor.config)
-            return compiled.run()
-        finally:
+            if _defer_to_background(ctx, rel, key, table, scan, filters,
+                                    group_exprs, agg_exprs, executor.config):
+                return None  # served on a lower rung this time
+            compiled = CompiledAggregate(rel, table, scan, filters,
+                                         group_exprs, agg_exprs,
+                                         executor.config)
+            # cached pipelines must not pin the construction table's HBM
             compiled.table = None
+            with ctx._plan_lock:
+                _cache[key] = compiled
+                while len(_cache) > _CACHE_CAP:
+                    _cache.popitem(last=False)
+                _remember_family_locked(ctx, _family_of(key),
+                                        _bucket_of(key))
+        from ..resilience import faults
+
+        faults.maybe_inject("oom", executor.config)
+        return compiled.run(table)
     except _Unsupported as e:
         logger.debug("compiled pipeline unsupported: %s", e)
         return None
+
+
+def _remember_family_locked(ctx, family: Tuple, bucket: Tuple) -> None:
+    """Record a compiled plan family -> table bucket on the context
+    (caller holds the plan lock); bounded crudely — family memory is an
+    optimization hint only.  The bucket is the growth EVIDENCE: a later
+    cache miss defers to background only when the table identity actually
+    changed, so plain LRU eviction of an unchanged plan recompiles in the
+    foreground as before instead of being misread as growth."""
+    if len(ctx._compiled_families) >= _FAMILY_CAP:
+        ctx._compiled_families.clear()
+    ctx._compiled_families[family] = bucket
+
+
+def _defer_to_background(ctx, rel, key, table, scan, filters, group_exprs,
+                         agg_exprs, config) -> bool:
+    """Background-recompile hook: when this plan FAMILY compiled before but
+    the table's bucket changed (growth / replacement), build-and-compile
+    the new pipeline on the background thread and decline the rung now —
+    the ladder serves this query interpreted instead of paying a foreground
+    XLA compile on the serving path.  Returns True when deferred."""
+    bg = ctx.background_compiler()
+    if bg is None:
+        return False
+    family = _family_of(key)
+    bucket = _bucket_of(key)
+    with ctx._plan_lock:
+        stored = ctx._compiled_families.get(family)
+    if stored is None or stored == bucket:
+        # never compiled here, or same table identity (a plain LRU
+        # eviction): compile in the foreground as before — deferral is
+        # only for actual growth/replacement
+        return False
+    # the triggering thread's config overlays (per-query options, test
+    # scopes) are thread-local and invisible on the bg thread; capture the
+    # effective view now so the rebuilt pipeline matches its cache key
+    effective = dict(ctx.config.effective_items())
+
+    def task():
+        try:
+            from .. import observability
+
+            with ctx.config.set(effective):
+                obj = CompiledAggregate(rel, table, scan, filters,
+                                        group_exprs, agg_exprs, config)
+                with observability.compile_sink(ctx.metrics):
+                    obj.run(table)  # compiles every kernel; result discarded
+            obj.table = None
+            obj._warm = True
+            with ctx._plan_lock:
+                _cache[key] = obj
+                while len(_cache) > _CACHE_CAP:
+                    _cache.popitem(last=False)
+                _remember_family_locked(ctx, family, bucket)
+        except BaseException:
+            # un-mark the family: the next query takes the foreground path
+            # where the ladder/breaker apply their normal failure policy
+            with ctx._plan_lock:
+                ctx._compiled_families.pop(family, None)
+            raise
+
+    task_key = ("compiled_aggregate", key)
+    # while the compile is pending, every query of the family keeps
+    # declining (still served interpreted) instead of compiling anyway
+    if not bg.pending(task_key) and not bg.submit(task_key, task):
+        return False
+    ctx.metrics.inc("serving.bg_compile.deferred")
+    from ..observability import trace_event
+
+    trace_event("bg_compile_deferred:compiled_aggregate")
+    logger.debug("plan family bucket changed; compiling in background and "
+                 "serving interpreted")
+    return True

@@ -294,6 +294,8 @@ class CompiledJoinAggregate:
         #: (kind, np.dtype) per packed output row; filled when _fn traces
         self._pack_tags: List[Tuple[str, np.dtype]] = []
         self._fn = jax.jit(self._build())
+        #: compile-watchdog hint: True after _fn compiled for these shapes
+        self._warm = False
 
     @staticmethod
     def _plan_radix(group_exprs, probe_table, build_tables):
@@ -482,7 +484,8 @@ class CompiledJoinAggregate:
 
         packed = timed_jit_call("compiled_join_aggregate", self._fn,
                                 probe_datas, probe_valids, luts, build_cols,
-                                pt.row_valid)
+                                pt.row_valid, may_compile=not self._warm)
+        self._warm = True
         from .compiled import fetch_packed, unpack_row
 
         tags = self._pack_tags

@@ -204,18 +204,26 @@ class CompiledSelect:
                        jax.ShapeDtypeStruct((table.padded_rows,), jnp.bool_))
         self._mask_fn = jax.jit(mask_fn)
         self._gather_fn = jax.jit(gather_fn, static_argnames=("bucket",))
+        #: compile-watchdog hints: the mask kernel compiles once, the
+        #: gather kernel once per distinct pow2 survivor bucket
+        self._mask_warm = False
+        self._warm_buckets: set = set()
 
-    def run(self) -> Table:
+    def run(self, table: Optional[Table] = None) -> Table:
         from ..utils import count_d2h
         from .compiled import unpack_row
 
-        t = self.table
+        # parameter, not shared state: cached pipelines serve concurrent
+        # worker threads (see CompiledAggregate.run)
+        t = table if table is not None else self.table
         datas = tuple(t.columns[n].data for n in t.column_names)
         valids = tuple(t.columns[n].validity for n in t.column_names)
         from ..observability import timed_jit_call
 
         mask, count_dev = timed_jit_call(
-            "compiled_select", self._mask_fn, datas, valids, t.row_valid)
+            "compiled_select", self._mask_fn, datas, valids, t.row_valid,
+            may_compile=not self._mask_warm)
+        self._mask_warm = True
         count_d2h()
         count = int(count_dev)  # one scalar round trip
         # without an ORDER BY, a LIMIT caps how many survivors we even pull:
@@ -235,7 +243,10 @@ class CompiledSelect:
             # jit re-specializes per bucket: each new bucket is a fresh
             # XLA compile the observability layer records per rung
             packed = timed_jit_call("compiled_select", self._gather_fn,
-                                    datas, valids, mask, bucket=bucket)
+                                    datas, valids, mask, bucket=bucket,
+                                    may_compile=bucket not in
+                                    self._warm_buckets)
+            self._warm_buckets.add(bucket)
             count_d2h()
             host = np.asarray(jax.device_get(packed))
             tags = self._pack_tags
@@ -298,6 +309,17 @@ def _dictionary_sorted(dic) -> bool:
 
 _CACHE_CAP = 32
 _cache: "OrderedDict[Tuple, CompiledSelect]" = OrderedDict()
+def _family_of(key: Tuple) -> Tuple:
+    """Plan family = cache key minus (uid, num_rows, padded_rows): a miss
+    for a family the context already compiled under a DIFFERENT table
+    bucket means the table grew or was replaced — the background-recompile
+    trigger (see physical/compiled.py for the pattern; family -> bucket
+    lives on context._compiled_families)."""
+    return ("compiled_select",) + key[1:-2]
+
+
+def _bucket_of(key: Tuple) -> Tuple:
+    return (key[0], key[-2], key[-1])  # (uid, num_rows, padded_rows)
 
 
 def try_compiled_select(root, executor) -> Optional[Table]:
@@ -343,24 +365,33 @@ def try_compiled_select(root, executor) -> Optional[Table]:
             table.num_rows,
             table.padded_rows,
         )
-        compiled = _cache.get(key)
+        ctx = executor.context
+        with ctx._plan_lock:
+            compiled = _cache.get(key)
+            if compiled is not None:
+                _cache.move_to_end(key)
         if compiled is None:
+            if _defer_to_background(ctx, key, table, scan, upper_filters,
+                                    proj, sort_keys, sort_fetch, limit,
+                                    inner_limit):
+                return None  # served on a lower rung this time
             compiled = CompiledSelect(table, scan, upper_filters, proj,
                                       sort_keys, sort_fetch, limit,
                                       inner_limit)
-            _cache[key] = compiled
-            while len(_cache) > _CACHE_CAP:
-                _cache.popitem(last=False)
-        else:
-            _cache.move_to_end(key)
-            compiled.table = table
-        try:
-            from ..resilience import faults
-
-            faults.maybe_inject("oom", executor.config)
-            return compiled.run()
-        finally:
+            # cached pipelines must not pin the construction table's HBM
             compiled.table = None
+            from .compiled import _remember_family_locked
+
+            with ctx._plan_lock:
+                _cache[key] = compiled
+                while len(_cache) > _CACHE_CAP:
+                    _cache.popitem(last=False)
+                _remember_family_locked(ctx, _family_of(key),
+                                        _bucket_of(key))
+        from ..resilience import faults
+
+        faults.maybe_inject("oom", executor.config)
+        return compiled.run(table)
     except _Unsupported as e:
         logger.debug("compiled select unsupported: %s", e)
         return None
@@ -369,3 +400,57 @@ def try_compiled_select(root, executor) -> Optional[Table]:
         # query — the eager converters are always correct
         logger.debug("compiled select declined: %s", e)
         return None
+
+
+def _defer_to_background(ctx, key, table, scan, upper_filters, proj,
+                         sort_keys, sort_fetch, limit, inner_limit) -> bool:
+    """Background-recompile hook for root select chains — same policy as
+    physical/compiled.py `_defer_to_background`: a seen family whose table
+    bucket changed compiles off the critical path while this query runs
+    interpreted.  Returns True when deferred."""
+    bg = ctx.background_compiler()
+    if bg is None:
+        return False
+    family = _family_of(key)
+    bucket = _bucket_of(key)
+    with ctx._plan_lock:
+        stored = ctx._compiled_families.get(family)
+    if stored is None or stored == bucket:
+        # never compiled here, or same table identity (plain LRU
+        # eviction): foreground compile as before
+        return False
+    effective = dict(ctx.config.effective_items())
+
+    def task():
+        from .compiled import _remember_family_locked
+
+        try:
+            from .. import observability
+
+            with ctx.config.set(effective):
+                obj = CompiledSelect(table, scan, upper_filters, proj,
+                                     sort_keys, sort_fetch, limit,
+                                     inner_limit)
+                with observability.compile_sink(ctx.metrics):
+                    obj.run(table)  # compiles mask + first-bucket gather
+            obj.table = None
+            with ctx._plan_lock:
+                _cache[key] = obj
+                while len(_cache) > _CACHE_CAP:
+                    _cache.popitem(last=False)
+                _remember_family_locked(ctx, family, bucket)
+        except BaseException:
+            with ctx._plan_lock:
+                ctx._compiled_families.pop(family, None)
+            raise
+
+    task_key = ("compiled_select", key)
+    if not bg.pending(task_key) and not bg.submit(task_key, task):
+        return False
+    ctx.metrics.inc("serving.bg_compile.deferred")
+    from ..observability import trace_event
+
+    trace_event("bg_compile_deferred:compiled_select")
+    logger.debug("select family bucket changed; compiling in background "
+                 "and serving interpreted")
+    return True

@@ -97,6 +97,15 @@ class CompileError(QueryError):
     degradable = True
 
 
+class CompileTimeoutError(CompileError):
+    """An XLA compile exceeded ``resilience.compile_timeout_ms`` and was
+    abandoned by the watchdog (resilience/watchdog.py).  Degradable like
+    any CompileError — the ladder serves the query on a lower rung and the
+    breaker is charged so the fingerprint stops re-attempting the hang."""
+
+    code = "COMPILE_TIMEOUT"
+
+
 class ExecutionError(QueryError):
     """A plan node failed while executing device kernels."""
 

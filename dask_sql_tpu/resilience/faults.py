@@ -75,6 +75,12 @@ SITE_ERRORS = {
     "checkpoint": InjectedWriteError,
 }
 
+#: sites that model a HANG rather than an error: arming one yields a sleep
+#: of ``resilience.inject.hang_s`` inside the watched region (the compile
+#: watchdog's deterministic test seam) instead of raising
+HANG_SITES = frozenset({"compile_hang"})
+HANG_SECONDS_KEY = "resilience.inject.hang_s"
+
 
 class _SiteRule:
     __slots__ = ("mode", "budget", "probability", "fired")
@@ -121,10 +127,10 @@ class FaultInjector:
                 continue
             site, _, mode = part.partition(":")
             site = site.strip()
-            if site not in SITE_ERRORS:
+            if site not in SITE_ERRORS and site not in HANG_SITES:
                 raise ValueError(
                     f"unknown fault site {site!r} in {CONFIG_KEY}; known "
-                    f"sites: {sorted(SITE_ERRORS)}")
+                    f"sites: {sorted(SITE_ERRORS) + sorted(HANG_SITES)}")
             self._rules[site] = _SiteRule(mode.strip() or "once")
 
     def arm(self, site: str) -> bool:
@@ -188,3 +194,15 @@ def maybe_inject(site: str, config) -> None:
     inj = get_injector(config)
     if inj is not None:
         inj.check(site)
+
+
+def hang_duration(site: str, config) -> float:
+    """Seconds a HANG-site fault should sleep now, 0.0 when not armed.
+
+    Resolved on the calling thread (config overlays are thread-local); the
+    watchdog passes the duration into its helper thread, which does the
+    actual sleeping — modeling a wedged XLA compile."""
+    inj = get_injector(config)
+    if inj is None or not inj.arm(site):
+        return 0.0
+    return float(config.get(HANG_SECONDS_KEY, 30.0) or 0.0)

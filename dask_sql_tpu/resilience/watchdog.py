@@ -1,0 +1,120 @@
+"""Compile watchdog: a deadline on every XLA compile.
+
+An XLA compile is host-side work with no cooperative cancellation
+checkpoints — a pathological program (or a wedged compiler) can hold a
+serving worker for minutes with the admission queue backing up behind it.
+This module bounds that exposure: `watched_call` runs the callable on a
+helper thread and waits at most ``resilience.compile_timeout_ms``; on
+expiry the *caller* gets a degradable `CompileTimeoutError` immediately —
+the degradation ladder steps the rung down to interpreted and the circuit
+breaker is charged (resilience/ladder.py), so the query completes and the
+fingerprint stops paying the hang — while the helper thread is abandoned
+to finish (or hang) off the critical path.
+
+Python threads cannot be killed, so an abandoned compile leaks one daemon
+thread until XLA returns; ``resilience.watchdog.abandoned`` counts them so
+an operator can see a wedged-compiler epidemic.  If the abandoned compile
+eventually completes, its executable lands in the jit (and persistent)
+cache and later queries get it for free.
+
+The watchdog applies to every compile path — foreground
+(`timed_jit_call`, observability/spans.py), pre-warm (serving/warmup.py
+executes through the same executor), and background (serving/background.py
+tasks call through `timed_jit_call` too) — because each reads the same
+config key at call time.
+"""
+from __future__ import annotations
+
+import atexit
+import contextvars
+import logging
+import threading
+import time
+from typing import Callable, Optional
+
+from .errors import CompileTimeoutError
+
+logger = logging.getLogger(__name__)
+
+CONFIG_KEY = "resilience.compile_timeout_ms"
+
+#: abandoned compile threads, joined (bounded) at interpreter exit:
+#: teardown while a daemon thread is inside XLA aborts the process
+_abandoned: list = []
+_abandoned_lock = threading.Lock()
+_ATEXIT_JOIN_S = 15.0
+#: set at exit so injected hangs (Event.wait, not sleep) cut short and
+#: their threads become joinable immediately
+_exiting = threading.Event()
+
+
+@atexit.register
+def _join_abandoned_at_exit() -> None:
+    _exiting.set()
+    with _abandoned_lock:
+        threads = [t for t in _abandoned if t.is_alive()]
+    deadline = time.monotonic() + _ATEXIT_JOIN_S
+    for t in threads:
+        t.join(max(0.0, deadline - time.monotonic()))
+
+
+def timeout_ms(config) -> Optional[float]:
+    """The configured compile deadline in ms, or None (watchdog off).
+    String values arrive through SET statements; non-positive disables."""
+    raw = config.get(CONFIG_KEY)
+    if raw is None:
+        return None
+    try:
+        val = float(raw)
+    except (TypeError, ValueError):
+        logger.warning("unparseable %s=%r; watchdog disabled", CONFIG_KEY, raw)
+        return None
+    return val if val > 0 else None
+
+
+def watched_call(label: str, fn: Callable, args=(), kwargs=None, *,
+                 deadline_ms: float, hang_s: float = 0.0, metrics=None):
+    """Run ``fn(*args, **kwargs)`` on a helper thread; raise
+    `CompileTimeoutError` if it has not finished within `deadline_ms`.
+
+    `hang_s` is the fault-injection seam (resilience/faults.py site
+    ``compile_hang``): the armed duration is resolved on the CALLER thread
+    (config overlays are thread-local) and slept inside the helper, so a
+    test models a wedged XLA compile deterministically.  The caller's
+    contextvars (active trace, compile sink) are copied into the helper so
+    spans and metrics attribute to the right query."""
+    box: list = []
+    done = threading.Event()
+    ctx = contextvars.copy_context()
+
+    def target():
+        try:
+            if hang_s > 0:
+                _exiting.wait(hang_s)
+            box.append((True, ctx.run(fn, *args, **(kwargs or {}))))
+        except BaseException as exc:  # dsql: allow-broad-except — the
+            # failure is re-raised verbatim on the waiting thread below
+            box.append((False, exc))
+        finally:
+            done.set()
+
+    t = threading.Thread(target=target, daemon=True,
+                         name=f"dsql-compile-watchdog-{label}")
+    t.start()
+    if not done.wait(deadline_ms / 1000.0):
+        with _abandoned_lock:
+            _abandoned.append(t)
+            # drop finished threads so the list stays bounded
+            _abandoned[:] = [x for x in _abandoned if x.is_alive()]
+        if metrics is not None:
+            metrics.inc("resilience.watchdog.timeout")
+            metrics.inc("resilience.watchdog.abandoned")
+        logger.warning(
+            "compile for %s exceeded %s=%0.0fms; abandoning the compile "
+            "thread and degrading the rung", label, CONFIG_KEY, deadline_ms)
+        raise CompileTimeoutError(
+            f"compile for {label!r} exceeded {CONFIG_KEY}={deadline_ms:g}ms")
+    ok, value = box[0]
+    if ok:
+        return value
+    raise value

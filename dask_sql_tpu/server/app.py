@@ -96,6 +96,14 @@ class _QueryRegistry:
         if context is not None:
             # SHOW METRICS surfaces the admission/queue state of the runtime
             context.serving = self.runtime
+            # background workers that predate the server (a load_state
+            # before run_server started a warm-up) join the drain set, and
+            # server boot kicks the warm-up for a context with hot profiles
+            # (/v1/health reports warming until the pass completes)
+            for worker in (context.warmup, context._bg_compiler):
+                if worker is not None:
+                    self.runtime.register_background(worker)
+            context.maybe_start_warmup()
         self.entries: Dict[str, _QueryEntry] = {}
         self.lock = threading.Lock()
         self.max_workers = self.runtime.workers
@@ -373,6 +381,18 @@ def _make_handler(context, registry: _QueryRegistry, jdbc_meta: bool):
                 return
             if path.rstrip("/") == "/v1/empty":
                 self._send(self._empty_results())
+                return
+            if path.rstrip("/") == "/v1/health":
+                # readiness for load balancers: 503 while the profile-
+                # driven warm-up is compiling hot query families, 200 once
+                # the process serves them warm (serving/warmup.py); a
+                # context with nothing to warm is ready immediately
+                warm = getattr(context, "warmup", None)
+                if warm is None:
+                    self._send({"status": "ready", "warmed": 0, "total": 0})
+                    return
+                status = warm.status()
+                self._send(status, 200 if warm.ready else 503)
                 return
             if path.rstrip("/") == "/v1/metrics":
                 fmt = (parse_qs(query).get("format") or ["json"])[0].lower()

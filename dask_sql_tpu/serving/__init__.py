@@ -13,6 +13,15 @@ arXiv:1703.08219 makes the same point for compiled Spark):
 - :mod:`.metrics` — counters + latency/queue-depth histograms aggregated
   from the per-node Tracer, surfaced as ``SHOW METRICS`` and ``/v1/metrics``.
 
+Zero-cold-start additions (docs/serving.md "Cold starts"):
+
+- :mod:`.compile_cache` — the persistent XLA executable cache, so a
+  restart deserializes hot executables instead of recompiling them;
+- :mod:`.warmup` — profile-driven pre-warm after load_state / server boot,
+  reported by ``/v1/health`` as ``warming`` -> ``ready``;
+- :mod:`.background` — the bounded background recompile thread that takes
+  bucket-growth recompiles off the serving path.
+
 :mod:`.runtime` ties them together into the worker pool the Presto server
 runs queries on.
 """
@@ -24,12 +33,15 @@ from .admission import (
     QueryTicket,
     QueueFullError,
 )
+from .background import BackgroundCompiler
 from .cache import ResultCache, table_nbytes
 from .metrics import Histogram, MetricsRegistry
 from .runtime import ServingRuntime, current_ticket
+from .warmup import WarmupManager
 
 __all__ = [
     "AdmissionController",
+    "BackgroundCompiler",
     "DeadlineExceededError",
     "Histogram",
     "MetricsRegistry",
@@ -39,6 +51,7 @@ __all__ = [
     "ResultCache",
     "ServingRuntime",
     "ShutdownError",
+    "WarmupManager",
     "current_ticket",
     "table_nbytes",
 ]

@@ -1,0 +1,168 @@
+"""Persistent executable cache: XLA compiles that survive the process.
+
+A process restart is the one fault the engine otherwise handles badly —
+every rung of every hot query recompiles on the critical path of a
+recovering fleet (ROADMAP item 3).  Flare (arXiv:1703.08219) and TQP
+(arXiv:2203.01877) both argue the compiled artifact, not the plan, is the
+unit of serving; this module applies that discipline by enabling the JAX
+persistent compilation cache under ``serving.compile_cache.path``:
+
+- executables are keyed by the lowered HLO (which embeds the plan-family
+  shape, the pow2 bucket shapes, and the rung's kernel structure), so a
+  restarted process that re-plans the same query family deserializes the
+  executable from disk instead of re-running XLA;
+- a half-written entry (crash mid-write) is a cache MISS, never an error:
+  ``jax_raise_persistent_cache_errors`` stays False, so corruption degrades
+  to a recompile (tests/unit/test_coldstart.py proves it);
+- hit/miss attribution reaches the engine's own metrics: a jax monitoring
+  listener feeds process-global counters, and `timed_jit_call`
+  (observability/spans.py) snapshots them around each recorded compile to
+  emit ``resilience.compile_cache.hit`` / ``.miss`` and stamp the
+  ``persistent_hit`` attribute on the trace's ``compile:<rung>`` span.
+
+The JAX cache directory is process-global state: one path per process.
+`enable` is idempotent for the same path and logs (rather than flips) on a
+conflicting second path — the first serving Context wins.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from typing import Any, Dict, Optional
+
+logger = logging.getLogger(__name__)
+
+CONFIG_PATH_KEY = "serving.compile_cache.path"
+CONFIG_MIN_COMPILE_KEY = "serving.compile_cache.min_compile_time_s"
+
+_HIT_EVENT = "/jax/compilation_cache/cache_hits"
+_MISS_EVENT = "/jax/compilation_cache/cache_misses"
+
+_lock = threading.Lock()
+_state: Dict[str, Any] = {"path": None, "listener_registered": False}
+_counters = {"hits": 0, "misses": 0}
+
+
+def _listener(event: str, **kwargs) -> None:
+    if event == _HIT_EVENT:
+        with _lock:
+            _counters["hits"] += 1
+    elif event == _MISS_EVENT:
+        with _lock:
+            _counters["misses"] += 1
+
+
+def enable(path: str, min_compile_time_s: float = 0.0) -> bool:
+    """Point the JAX persistent compilation cache at `path` (idempotent).
+
+    Returns True when the cache is active on `path` after the call.  The
+    floor defaults to 0 seconds so even fast CPU-backend compiles persist
+    (a restarted process pays trace+lower either way; the XLA compile is
+    the part worth skipping)."""
+    import jax
+
+    with _lock:
+        current = _state["path"]
+        if current == path:
+            return True
+        if current is not None:
+            # jax holds ONE cache dir per process; flipping it mid-flight
+            # would orphan the first Context's entries silently
+            logger.warning(
+                "persistent compile cache already enabled at %r; "
+                "ignoring second path %r", current, path)
+            return False
+        try:
+            os.makedirs(path, exist_ok=True)
+            # jax latches its cache-used decision at the FIRST compile of
+            # the process: without a reset, enabling after any compile has
+            # happened (earlier Context, notebook warm-up) silently never
+            # persists anything
+            from jax.experimental.compilation_cache import (
+                compilation_cache as jax_cc,
+            )
+
+            jax_cc.reset_cache()
+            jax.config.update("jax_compilation_cache_dir", path)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                              float(min_compile_time_s))
+            # -1 disables the entry-size floor (0 would auto-raise it to the
+            # jax default and drop the small CPU-test executables)
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+            # a torn/corrupt cache entry must degrade to a recompile, never
+            # fail the query that tripped over it
+            jax.config.update("jax_raise_persistent_cache_errors", False)
+        except Exception:  # dsql: allow-broad-except — the cache is an
+            # optimization; a jax version without these knobs serves cold
+            logger.warning("could not enable the persistent compile cache",
+                           exc_info=True)
+            return False
+        if not _state["listener_registered"]:
+            try:
+                from jax._src import monitoring
+
+                monitoring.register_event_listener(_listener)
+                _state["listener_registered"] = True
+            except Exception:  # dsql: allow-broad-except — hit/miss
+                # attribution is best-effort; the cache itself still works
+                logger.debug("jax monitoring listener unavailable",
+                             exc_info=True)
+        _state["path"] = path
+        logger.info("persistent compile cache enabled at %s", path)
+        return True
+
+
+def disable() -> None:
+    """Turn the persistent cache off (tests: undo process-global state).
+    Resets jax's lazily-initialized cache object too — without that, a
+    later enable() on a different path would keep writing to the old
+    directory (jax binds the cache object on first use)."""
+    import jax
+
+    with _lock:
+        if _state["path"] is None:
+            return
+        try:
+            jax.config.update("jax_compilation_cache_dir", None)
+            from jax.experimental.compilation_cache import (
+                compilation_cache as jax_cc,
+            )
+
+            jax_cc.reset_cache()
+        except Exception:  # dsql: allow-broad-except — best-effort teardown
+            logger.debug("could not reset jax compilation cache",
+                         exc_info=True)
+        _state["path"] = None
+
+
+def maybe_enable(config, metrics=None) -> bool:
+    """Enable from the ``serving.compile_cache.*`` config keys; no-op when
+    unconfigured.  Called from Context.__init__ so any serving process
+    that sets the path gets restart-surviving executables."""
+    path = config.get(CONFIG_PATH_KEY)
+    if not path:
+        return False
+    ok = enable(str(path),
+                float(config.get(CONFIG_MIN_COMPILE_KEY, 0.0) or 0.0))
+    if ok and metrics is not None:
+        metrics.gauge("resilience.compile_cache.enabled", 1.0)
+    return ok
+
+
+def enabled_path() -> Optional[str]:
+    with _lock:
+        return _state["path"]
+
+
+def hit_count() -> int:
+    """Cumulative persistent-cache hits this process (monitoring events).
+    `timed_jit_call` snapshots this around a compile to attribute the hit
+    to a specific rung/span — best-effort under concurrent compiles."""
+    with _lock:
+        return _counters["hits"]
+
+
+def stats() -> Dict[str, int]:
+    with _lock:
+        return dict(_counters)

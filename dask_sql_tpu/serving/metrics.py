@@ -53,7 +53,13 @@ DOCUMENTED_METRICS = frozenset({
     "query.cache.oversize",
     "query.cache.evicted",
     "query.cache.estimate_skip",
-    # resilience/ — ladder, breaker, retry
+    # resilience/ — ladder, breaker, retry, watchdog, persistent cache
+    "resilience.compile_cache.enabled",
+    "resilience.compile_cache.hit",
+    "resilience.compile_cache.miss",
+    "resilience.watchdog.timeout",
+    "resilience.watchdog.abandoned",
+    "resilience.breaker.restored",
     "resilience.degraded",
     "resilience.degraded.interpreted",
     "resilience.rung.cpu",
@@ -78,6 +84,19 @@ DOCUMENTED_METRICS = frozenset({
     "serving.shed_estimated_bytes",
     "serving.latency_ms",
     "serving.queue_wait_ms",
+    # serving/ — zero-cold-start: pre-warm + background recompile
+    "serving.warmup.started",
+    "serving.warmup.warmed",
+    "serving.warmup.failed",
+    "serving.warmup.skipped",
+    "serving.warmup.cancelled",
+    "serving.warmup.ms",
+    "serving.bg_compile.submitted",
+    "serving.bg_compile.completed",
+    "serving.bg_compile.failed",
+    "serving.bg_compile.dropped",
+    "serving.bg_compile.deferred",
+    "serving.bg_compile.ms",
 })
 
 #: Prefixes legitimizing *dynamic* metric families (f-string names keyed by

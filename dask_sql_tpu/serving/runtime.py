@@ -77,6 +77,10 @@ class ServingRuntime:
         #: it from _pop_locked would let a burst overshoot the cap)
         self._batch_in_flight = 0
         self._shutdown = False
+        #: auxiliary background workers (warm-up pass, background
+        #: recompiler) that shutdown() must cancel and join — worker queues
+        #: alone draining is not a full drain (ISSUE 7 regression)
+        self._background: list = []
         self._threads = [
             threading.Thread(target=self._worker, daemon=True,
                              name=f"dsql-serving-{i}")
@@ -211,17 +215,36 @@ class ServingRuntime:
             self._cv.notify_all()
 
     # ------------------------------------------------------------ lifecycle
+    def register_background(self, worker) -> None:
+        """Track an auxiliary background worker (must expose ``cancel()``
+        and ``join(timeout)``) so shutdown() drains it with the queues.
+        Registering against an already-shut-down runtime cancels the
+        worker immediately — shutdown() has drained its snapshot and will
+        never see this one."""
+        with self._cv:
+            if not self._shutdown:
+                self._background.append(worker)
+                return
+        try:
+            worker.cancel()
+        except Exception:  # dsql: allow-broad-except — same policy as the
+            # shutdown drain: a worker's teardown bug must not propagate
+            logger.warning("background worker cancel failed", exc_info=True)
+
     def shutdown(self, wait: bool = False, timeout: float = 5.0) -> None:
         """Stop accepting work and drain deterministically.
 
         Queued-but-not-started queries fail immediately with a structured
         (retryable) `ShutdownError` — another replica or a restart can take
         them — instead of hanging on futures no worker will ever pop.
-        In-flight queries run to completion; ``wait=True`` joins the worker
-        threads (bounded by `timeout` each)."""
+        Registered background workers (the warm-up pass, the background
+        recompiler) are cancelled too; ``wait=True`` joins the worker
+        threads AND the background threads (bounded by `timeout` each), so
+        a drained runtime leaves no thread still compiling."""
         drained = []
         with self._cv:
             self._shutdown = True
+            background = list(self._background)
             for cls in CLASSES:
                 q = self._queues[cls]
                 while q:
@@ -233,9 +256,18 @@ class ServingRuntime:
             if fut.set_running_or_notify_cancel():
                 fut.set_exception(ShutdownError(
                     f"query {ticket.qid} shed: serving runtime shutting down"))
+        for worker in background:
+            try:
+                worker.cancel()
+            except Exception:  # dsql: allow-broad-except — one worker's
+                # teardown bug must not block the rest of the drain
+                logger.warning("background worker cancel failed",
+                               exc_info=True)
         if wait:
             for t in self._threads:
                 t.join(timeout)
+            for worker in background:
+                worker.join(timeout)
 
     def snapshot(self) -> Dict[str, object]:
         adm = self.admission.snapshot()

@@ -1,0 +1,205 @@
+"""Profile-driven pre-warm: replay hot fingerprints before taking traffic.
+
+The checkpoint subsystem persists the per-fingerprint ProfileStore
+(observability/profiles.py) next to each catalog snapshot, so a restarted
+process knows exactly which query families its predecessor served hottest.
+This module turns that knowledge into readiness: on `Context.load_state`
+(and Presto-server boot) a background thread replays the top-N profiled
+statements through the full parse->bind->compile->execute path, populating
+the plan cache, the jit caches of every compiled rung, and — when the
+persistent executable cache (compile_cache.py) is enabled — deserializing
+XLA executables from disk instead of recompiling them.
+
+Readiness is a first-class state machine the server's ``/v1/health``
+endpoint reports (``warming (k/N)`` with HTTP 503 -> ``ready`` with 200),
+so a load balancer keeps traffic off a cold process until its hot paths
+are compiled.  Warm-up is best-effort by design: a statement that fails to
+replay (table dropped since the snapshot, injected fault) is counted
+(``serving.warmup.failed``) and skipped — a broken profile entry must
+never wedge readiness.
+
+Lifecycle: the manager registers with the ServingRuntime when a server
+front-end is attached, so ``ServingRuntime.shutdown(wait=True)`` cancels
+and joins the warm thread deterministically (cancellation takes effect
+between statements; the in-flight statement finishes).
+"""
+from __future__ import annotations
+
+import atexit
+import logging
+import threading
+import time
+import weakref
+from typing import Dict, List, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+#: warm-up states surfaced by ``/v1/health``
+IDLE, WARMING, READY = "idle", "warming", "ready"
+
+#: live managers drained at interpreter exit: a daemon thread killed by
+#: teardown MID-XLA segfaults the process (observed ~1 in 5 exits), so
+#: atexit cancels every pass (cooperative, takes effect at the executor's
+#: per-node checkpoints) and joins it bounded
+_live: "weakref.WeakSet[WarmupManager]" = weakref.WeakSet()
+_ATEXIT_JOIN_S = 10.0
+
+
+@atexit.register
+def _drain_at_exit() -> None:
+    managers = list(_live)
+    for m in managers:
+        m.cancel()
+    for m in managers:
+        m.join(_ATEXIT_JOIN_S)
+
+
+class WarmupManager:
+    """One warm-up pass over the profile store's hottest fingerprints."""
+
+    def __init__(self, context, top_n: int = 8,
+                 throttle_s: float = 0.0):
+        self.context = context
+        self.top_n = max(0, int(top_n))
+        self.throttle_s = max(0.0, float(throttle_s))
+        self._lock = threading.Lock()
+        self._state = IDLE
+        self._thread: Optional[threading.Thread] = None
+        self._cancel = threading.Event()
+        self.total = 0
+        self.warmed = 0
+        self.failed = 0
+        self.skipped = 0
+        #: ticket of the in-flight warm statement (cooperative cancel)
+        self._current_ticket = None
+
+    # ----------------------------------------------------------- lifecycle
+    def start(self) -> "WarmupManager":
+        with self._lock:
+            if self._thread is not None:
+                return self
+            self._state = WARMING
+            self._thread = threading.Thread(
+                target=self._run, daemon=True, name="dsql-warmup")
+        _live.add(self)
+        self.context.metrics.inc("serving.warmup.started")
+        self._thread.start()
+        return self
+
+    def cancel(self) -> None:
+        """Stop the pass: the in-flight statement aborts at the executor's
+        next cancellation checkpoint (its ticket is cancelled), later
+        entries never start; ``join`` afterwards for determinism."""
+        self._cancel.set()
+        with self._lock:
+            ticket = self._current_ticket
+        if ticket is not None:
+            ticket.cancel()
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        t = self._thread
+        if t is not None:
+            t.join(timeout)
+
+    # ----------------------------------------------------------- the pass
+    @staticmethod
+    def _replayable(sql: str) -> bool:
+        """Only single read-only statements replay: a profiled SCRIPT can
+        carry DDL ("CREATE TABLE ...; SELECT ...") whose re-execution at
+        boot would mutate the restored catalog."""
+        head = sql.lstrip().lower()
+        if not head.startswith(("select", "with", "values", "(")):
+            return False
+        try:
+            from ..planner.parser import parse_sql
+
+            return len(parse_sql(sql)) == 1
+        except Exception:  # dsql: allow-broad-except — an unparseable
+            # profile is simply not warmable; never block the pass
+            return False
+
+    def _candidates(self) -> List[Tuple[str, str]]:
+        return [(fp, sql) for fp, sql
+                in self.context.profiles.warm_candidates(self.top_n)
+                if self._replayable(sql)]
+
+    def _run(self) -> None:
+        ctx = self.context
+        entries = self._candidates()
+        n_ranked = len(ctx.profiles.top_fingerprints(self.top_n))
+        with self._lock:
+            self.total = len(entries)
+            self.skipped = n_ranked - len(entries)
+        if self.skipped:
+            # hot fingerprints whose SQL was lost to truncation or never
+            # recorded: visible, so an operator knows the warm set is partial
+            ctx.metrics.inc("serving.warmup.skipped", self.skipped)
+        t_start = time.perf_counter()
+        from .admission import QueryTicket
+        from . import runtime as _runtime
+
+        for fp, sql in entries:
+            if self._cancel.is_set():
+                ctx.metrics.inc("serving.warmup.cancelled")
+                logger.info("warm-up cancelled after %d/%d fingerprints",
+                            self.warmed, self.total)
+                break
+            t0 = time.perf_counter()
+            # the warm statement runs under a cancellable ticket: cancel()
+            # (shutdown drain, interpreter exit) aborts it at the
+            # executor's next per-node checkpoint instead of letting a
+            # daemon thread die mid-XLA during teardown (segfault)
+            ticket = QueryTicket(f"warmup-{fp}")
+            with self._lock:
+                self._current_ticket = ticket
+            _runtime._tls.ticket = ticket
+            try:
+                frame = ctx.sql(sql)
+                if frame is not None:
+                    # device-side execute only: warming compiles + caches;
+                    # the d2h/pandas tail is per-request work
+                    frame.execute()
+                with self._lock:
+                    self.warmed += 1
+                ctx.metrics.inc("serving.warmup.warmed")
+                ctx.metrics.observe("serving.warmup.ms",
+                                    (time.perf_counter() - t0) * 1000.0)
+            except Exception:  # dsql: allow-broad-except — warm-up is
+                # best-effort: one unreplayable profile (stale table,
+                # injected fault) must not block readiness or later entries
+                if self._cancel.is_set():
+                    continue  # cancelled mid-statement, not a failure
+                with self._lock:
+                    self.failed += 1
+                ctx.metrics.inc("serving.warmup.failed")
+                logger.warning("warm-up replay failed for fingerprint %s",
+                               fp, exc_info=True)
+            finally:
+                _runtime._tls.ticket = None
+                with self._lock:
+                    self._current_ticket = None
+            if self.throttle_s:
+                self._cancel.wait(self.throttle_s)
+        with self._lock:
+            self._state = READY
+        logger.info(
+            "warm-up ready: %d/%d fingerprints warmed (%d failed) in %.0fms",
+            self.warmed, self.total, self.failed,
+            (time.perf_counter() - t_start) * 1000.0)
+
+    # -------------------------------------------------------------- reads
+    @property
+    def ready(self) -> bool:
+        with self._lock:
+            return self._state == READY
+
+    def status(self) -> Dict[str, object]:
+        """The ``/v1/health`` payload body."""
+        with self._lock:
+            state = self._state
+            return {
+                "status": state,
+                "warmed": self.warmed,
+                "total": self.total,
+                "failed": self.failed,
+            }

@@ -68,8 +68,8 @@ def test_limit_without_sort_caps_transfer(c, big):
     pulled = {}
     orig = CS.CompiledSelect.run
 
-    def spy(self):
-        out = orig(self)
+    def spy(self, table=None):
+        out = orig(self, table)
         pulled["rows"] = out.num_rows
         return out
 
