@@ -368,6 +368,120 @@ def run_coldstart_smoke():
         raise SystemExit(1)
 
 
+def run_families_smoke():
+    """`bench.py --families`: parameterized plan families + batching smoke.
+
+    Two checks, exit 1 on violation:
+
+    1. *Compile-once-run-many*: two sequential queries differing only in a
+       literal must share one family fingerprint, and the SECOND query's
+       lifecycle trace must contain ZERO foreground ``compile:<rung>``
+       spans (one executable serves the family).
+    2. *Inter-query batching*: N concurrent clients issuing same-family
+       queries with distinct literals through a ServingRuntime must be
+       served with exactly ONE client paying a foreground compile (the
+       batch leader) and at least one stacked launch serving >1 query
+       (``serving.batch.launches`` / ``serving.batch.queries``), with
+       every client's result matching pandas.
+    """
+    import json as _json
+
+    _ensure_backend()
+    import jax
+
+    from dask_sql_tpu import Context
+    from dask_sql_tpu.serving.runtime import ServingRuntime
+
+    def q(disc):
+        return ("SELECT l_returnflag, SUM(l_extendedprice) AS s, "
+                "COUNT(*) AS n FROM lineitem "
+                f"WHERE l_discount > {disc} GROUP BY l_returnflag")
+
+    def compile_spans(tr):
+        return [s.name for s in tr.spans if s.name.startswith("compile:")]
+
+    df = gen_lineitem(100_000, seed=0)
+
+    # -- phase 1: sequential family proof ---------------------------------
+    c1 = Context()
+    c1.config.update({"serving.cache.enabled": False})
+    c1.create_table("lineitem", df)
+    c1.sql(q(0.02), return_futures=False)
+    tr_first = c1.last_trace
+    c1.sql(q(0.05), return_futures=False)
+    tr_second = c1.last_trace
+    seq_same_family = tr_first.fingerprint == tr_second.fingerprint
+    seq_second_compiles = compile_spans(tr_second)
+    seq_ok = (seq_same_family and len(compile_spans(tr_first)) >= 1
+              and not seq_second_compiles)
+
+    # -- phase 2: concurrent clients, cold context, batched launch --------
+    c2 = Context()
+    c2.config.update({"serving.cache.enabled": False})
+    c2.create_table("lineitem", df)
+    discs = [0.01, 0.03, 0.05, 0.07]
+    # batch bound == client count so the group closes the moment everyone
+    # arrives; the window is an upper bound for stragglers (host-side
+    # parse/bind of the members serializes under the GIL)
+    runtime = ServingRuntime(workers=8, metrics=c2.metrics,
+                             batch_queries=len(discs),
+                             batch_window_ms=2000.0)
+    c2.serving = runtime
+    for d in discs:
+        # pre-plan (no execution): the clients then hit the plan cache and
+        # reach the executor together, so the phase measures EXECUTION
+        # batching rather than GIL-serialized parse jitter
+        c2.sql(q(d))
+    frames = {}
+
+    def client(disc):
+        def work(_ticket):
+            frame = c2.sql(q(disc))
+            frame.execute()
+            frames[disc] = frame
+            return frame
+        return work
+
+    futures = [runtime.submit(client(d))[1] for d in discs]
+    for fut in futures:
+        fut.result(300)
+    runtime.shutdown(wait=True)
+    results_ok = True
+    for disc in discs:
+        got = frames[disc].execute().to_pandas().set_index(
+            frames[disc].columns[0])
+        exp = df[df.l_discount > disc].groupby("l_returnflag").agg(
+            s=("l_extendedprice", "sum"), n=("l_extendedprice", "count"))
+        # rtol: f32 sums of ~25k values differ by summation order alone
+        results_ok = results_ok and len(got) == len(exp) and all(
+            np.allclose(got.loc[k, "s"], exp.loc[k, "s"], rtol=1e-4)
+            and got.loc[k, "n"] == exp.loc[k, "n"] for k in exp.index)
+    compiling_clients = sum(
+        1 for f in frames.values()
+        if f._trace is not None and compile_spans(f._trace))
+    launches = c2.metrics.counter("serving.batch.launches")
+    batched_queries = c2.metrics.counter("serving.batch.queries")
+    conc_ok = (compiling_clients == 1 and launches >= 1
+               and batched_queries >= 2 and results_ok)
+
+    ok = seq_ok and conc_ok
+    print(_json.dumps({
+        "metric": "plan_families_smoke",
+        "backend": jax.default_backend(),
+        "ok": bool(ok),
+        "sequential_same_family": bool(seq_same_family),
+        "sequential_second_query_compiles": seq_second_compiles,
+        "concurrent_clients": len(discs),
+        "clients_with_foreground_compile": compiling_clients,
+        "batched_launches": launches,
+        "queries_served_batched": batched_queries,
+        "results_match": bool(results_ok),
+        "family": tr_first.fingerprint,
+    }), flush=True)
+    if not ok:
+        raise SystemExit(1)
+
+
 def run_lint_smoke():
     """`bench.py --lint`: static-analysis smoke.
 
@@ -417,6 +531,9 @@ def main():
         return
     if "--coldstart" in sys.argv:
         run_coldstart_smoke()
+        return
+    if "--families" in sys.argv:
+        run_families_smoke()
         return
 
     import jax

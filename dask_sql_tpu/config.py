@@ -68,6 +68,22 @@ DEFAULTS: Dict[str, Any] = {
     # compiled_aggregate/compiled_join_aggregate pre-skipped (no attempt,
     # no breaker charge).  None disables the proof.
     "analysis.estimate.device_budget_bytes": None,
+    # Parameterized plan families (families/, docs/serving.md "Plan
+    # families and batching"): post-optimize literal extraction into a
+    # runtime parameter vector.  One XLA executable then serves every
+    # literal variant of a statement, and the family fingerprint keys the
+    # result cache, the circuit breaker / degradation ladder, the
+    # estimator memo, and the per-family profiles behind SHOW PROFILES and
+    # restart pre-warm.  Off = literal-baked plan identity everywhere
+    # (pre-family behavior, byte-identical).
+    "families.enabled": True,
+    # Inter-query family batching (families/batcher.py, ServingRuntime):
+    # concurrently admitted same-family queries coalesce into ONE stacked
+    # (vmapped) kernel launch sharing a single scan.  max_queries <= 1
+    # disables coalescing; window_ms is how long a batch leader waits for
+    # followers — only charged when other queries are already in flight.
+    "serving.batch.max_queries": 8,
+    "serving.batch.window_ms": 2.0,
     # Serving runtime (serving/) — admission control, result cache, metrics.
     # See docs/serving.md for semantics; all keys are read when the runtime
     # or Context is constructed (per-query config_options do not re-size

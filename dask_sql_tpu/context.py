@@ -96,8 +96,15 @@ class TpuFrame:
             ctx = self._context
             tr = self._trace
             fp = self._fingerprint
+            # family identity (families/): when the plan parameterized, its
+            # literal-stripped family fingerprint keys the breaker, the
+            # profiles and the warm-up — `user_id = 17` and `user_id = 404`
+            # are one serving entity
+            family = getattr(self._plan, "_dsql_family", None)
+            family_fp = family.fingerprint if family is not None else None
             if fp is None:
-                fp = self._fingerprint = plan_fingerprint(self._plan)
+                fp = self._fingerprint = family_fp or plan_fingerprint(
+                    self._plan)
             sql_text = self._sql or (tr.sql if tr is not None else None)
 
             def _finish_on_error(exc_type, exc, tb):
@@ -132,7 +139,8 @@ class TpuFrame:
                 # compile histograms + per-fingerprint profiles record
                 # through the sink even with tracing disabled
                 stack.enter_context(observability.compile_sink(
-                    ctx.metrics, ctx.profiles, fp, sql_text))
+                    ctx.metrics, ctx.profiles, fp, sql_text,
+                    family=family_fp))
                 with observability.stage("cache_lookup"):
                     key = ctx._result_cache_key(self._plan,
                                                 self._config_options)
@@ -142,7 +150,8 @@ class TpuFrame:
                     if tr is not None:
                         tr.event("result_cache_hit")
                     ctx.profiles.record_exec(fp, sql=sql_text,
-                                             cache_hit=True)
+                                             cache_hit=True,
+                                             family=family_fp)
                     self._result = hit
                     return self._result
                 estimate = ctx._plan_estimate(self._plan)
@@ -181,7 +190,8 @@ class TpuFrame:
 
                 ctx.profiles.record_exec(
                     fp, sql=sql_text, exec_ms=exec_ms,
-                    result_bytes=table_nbytes(self._result))
+                    result_bytes=table_nbytes(self._result),
+                    family=family_fp)
                 if key is not None:
                     ctx._result_cache.put(key, self._result)
         return self._result
@@ -310,6 +320,11 @@ class Context:
         #: the table grew/was replaced — the background-recompile trigger
         #: (physical/compiled.py).  Guarded by _plan_lock.
         self._compiled_families: dict = {}
+        #: (family fingerprint, catalog/config key) -> PlanEstimate: the
+        #: estimator's intervals are literal-value-agnostic, so one
+        #: estimate serves every member of a family (families/,
+        #: docs/analysis.md).  Guarded by _plan_lock; cleared on DDL.
+        self._family_estimates: dict = {}
         from .serving import compile_cache
 
         # persistent executable cache: when serving.compile_cache.path is
@@ -367,6 +382,8 @@ class Context:
         would stay pinned in HBM until byte-pressure from new inserts.
         Dropping the cache eagerly frees those buffers now."""
         self._result_cache.invalidate_all()
+        with self._plan_lock:
+            self._family_estimates.clear()
 
     def _result_cache_key(self, plan, config_options) -> Optional[Tuple]:
         """Result-cache key: (normalized plan fingerprint, catalog
@@ -411,8 +428,18 @@ class Context:
             # repr() (not explain()) as the plan fingerprint: dataclass reprs
             # include every semantic field recursively, so two plans that
             # differ only in a detail the pretty-printer omits (e.g. sort
-            # null ordering) can never collide
-            parts: List[Any] = ["result", repr(plan), self.schema_name]
+            # null ordering) can never collide.  With plan families enabled
+            # the key splits into (literal-stripped family repr, parameter
+            # values) — bijective with repr(plan), since substituting the
+            # values back into the placeholder slots reconstructs it — so
+            # family metrics and cache accounting see one family, while two
+            # queries with different literals still get distinct entries.
+            family = getattr(plan, "_dsql_family", None)
+            if family is not None:
+                parts: List[Any] = ["result", family.family_repr,
+                                    family.key_values, self.schema_name]
+            else:
+                parts = ["result", repr(plan), self.schema_name]
             parts.extend(self._catalog_signature())
             parts.append(self._catalog_serial)
             parts.append(self.config.effective_items())
@@ -912,6 +939,24 @@ class Context:
         # including EXPLAIN ANALYZE — pay the bind-time check
         wants_verify = not (isinstance(plan, plan_nodes.Explain)
                             and not plan.analyze)
+        if wants_verify and not isinstance(plan, plan_nodes.CustomNode):
+            # plan-family parameterization (families/, docs/serving.md):
+            # literals lift into a runtime parameter vector, and the
+            # literal-stripped fingerprint becomes the query's serving
+            # identity — result-cache key, breaker/ladder key, estimator
+            # memo, per-family profile/warm-up entry — while the compiled
+            # pipelines share one executable across the whole family
+            from . import families
+
+            if families.enabled(self.config):
+                with observability.stage("parameterize") as fam_attrs:
+                    info = families.family_of(plan, self.config,
+                                              metrics=self.metrics)
+                    if info is not None:
+                        fam_attrs["family"] = info.fingerprint
+                        fam_attrs["params"] = info.n_params
+                        if info.n_params:
+                            self.metrics.inc("families.parameterized")
         if wants_verify and verify_mode not in ("off", "false", "0", "none"):
             from . import analysis
 
@@ -948,11 +993,45 @@ class Context:
     def _run_estimator(self, plan):
         """Guarded `estimate_and_apply`: estimation is advisory, so an
         estimator bug must never block planning or execution — the query
-        simply runs ungated, metric-counted."""
+        simply runs ungated, metric-counted.
+
+        Family reuse (families/): the estimator's intervals never read
+        literal *values* (filters drop the lower bound and keep the upper;
+        IN buckets and LIMIT windows are part of the family), so a
+        family's first estimate is exact for every member — later members
+        reuse it instead of re-walking the plan.  When the device-budget
+        rung proofs are armed the walk re-runs per plan, because proofs
+        mark the concrete plan's nodes."""
         from .analysis import estimator
 
         try:
-            return estimator.estimate_and_apply(plan, self)
+            fam = getattr(plan, "_dsql_family", None)
+            key = None
+            if fam is not None and estimator.device_budget_bytes(
+                    self.config) is None:
+                try:
+                    key = (fam.fingerprint,
+                           tuple(tuple(x) if isinstance(x, list) else x
+                                 for x in self._catalog_signature()),
+                           self._catalog_serial,
+                           self.config.effective_items())
+                    hash(key)
+                except TypeError:
+                    key = None
+            if key is not None:
+                with self._plan_lock:
+                    cached = self._family_estimates.get(key)
+                if cached is not None:
+                    plan._dsql_estimate = cached
+                    self.metrics.inc("families.estimate.hit")
+                    return cached
+            est = estimator.estimate_and_apply(plan, self)
+            if key is not None and est is not None:
+                with self._plan_lock:
+                    if len(self._family_estimates) >= 512:
+                        self._family_estimates.clear()
+                    self._family_estimates[key] = est
+            return est
         except Exception:  # dsql: allow-broad-except — advisory analysis
             self.metrics.inc("analysis.estimate.internal_error")
             logger.debug("plan estimation failed; query runs ungated",

@@ -1,0 +1,108 @@
+"""Parameterized plan families + inter-query batched execution.
+
+A *family* is the set of queries that differ only in literal values:
+``WHERE user_id = 17`` and ``WHERE user_id = 404`` are one family.  This
+subsystem (ROADMAP item 1; Flare arXiv:1703.08219, TQP arXiv:2203.01877)
+makes the family — not the literal-baked plan — the engine's unit of
+compilation, caching, resilience and accounting:
+
+- `parameterize` — the post-optimize pass: literals lift into a runtime
+  parameter vector, yielding a literal-stripped *family fingerprint* plus
+  this query's param tuple (`FamilyInfo`);
+- the compiled pipelines (physical/compiled*.py) key their caches on the
+  parameterized expressions and take the values as traced runtime
+  arguments, so one XLA executable serves the whole family — the second
+  query of a family pays ZERO foreground compiles;
+- `batcher` — the ServingRuntime's family batcher: concurrently admitted
+  same-family queries coalesce into a single stacked (vmapped) kernel
+  launch with the literal vectors as a batched leading axis, sharing one
+  scan;
+- the family fingerprint keys the result cache (family + param values),
+  the circuit breaker and degradation ladder (per family, rung), the
+  estimator (one interval per family — its bounds are value-agnostic),
+  and the per-family profiles that drive `SHOW PROFILES` and restart
+  pre-warm.
+
+``families.enabled`` (default on) switches the whole subsystem; off means
+byte-identical behavior to the pre-family engine.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from .batcher import FamilyBatcher
+from .parameterize import (
+    FamilyInfo,
+    Parameterizer,
+    compute_family,
+    normalize_in_values,
+    pow2_bucket,
+    stack_params,
+)
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "FamilyBatcher",
+    "FamilyInfo",
+    "Parameterizer",
+    "batcher_of",
+    "compute_family",
+    "enabled",
+    "family_of",
+    "normalize_in_values",
+    "pipeline_parameterizer",
+    "pow2_bucket",
+    "stack_params",
+]
+
+
+def enabled(config) -> bool:
+    mode = str(config.get("families.enabled", True)).lower()
+    return mode not in ("off", "false", "0", "none")
+
+
+def family_of(plan, config, metrics=None) -> Optional[FamilyInfo]:
+    """The `FamilyInfo` of a planned query, computed once and memoized on
+    the plan object (plans are cached per SQL text, so their literals —
+    and therefore their param values — are fixed).  Returns None for DDL /
+    custom statements, with families disabled, or if the pass fails
+    (parameterization is advisory: a bug here must never block a query)."""
+    from ..planner import plan as p
+
+    if plan is None or isinstance(plan, p.CustomNode):
+        return None
+    if not enabled(config):
+        return None
+    info = getattr(plan, "_dsql_family", None)
+    if info is not None:
+        return info
+    try:
+        info = compute_family(plan)
+        plan._dsql_family = info
+        return info
+    except Exception:  # dsql: allow-broad-except — advisory analysis: an
+        # unparameterizable plan simply keeps its literal-baked identity
+        if metrics is not None:
+            metrics.inc("families.internal_error")
+        logger.debug("family parameterization failed; using literal plan "
+                     "identity", exc_info=True)
+        return None
+
+
+def pipeline_parameterizer(config) -> Parameterizer:
+    """The rewrite pass the compiled pipelines run on their extracted
+    expression lists (no subplan recursion — subquery expressions decline
+    at trace time anyway)."""
+    return Parameterizer(enabled=enabled(config), recurse_subplans=False)
+
+
+def batcher_of(context) -> Optional[FamilyBatcher]:
+    """The serving runtime's family batcher, when one is attached and
+    batching is on — compiled pipelines consult this before launching."""
+    runtime = getattr(context, "serving", None)
+    batcher = getattr(runtime, "batcher", None)
+    if batcher is None or batcher.max_queries <= 1:
+        return None
+    return batcher

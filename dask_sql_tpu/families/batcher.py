@@ -1,0 +1,161 @@
+"""Inter-query family batcher: one stacked kernel launch for concurrently
+admitted same-family queries.
+
+When several serving workers execute queries of the same plan family (same
+compiled executable, different literal vectors) at the same time, running
+them back-to-back scans the same table N times.  The batcher instead
+rendezvouses the members: the first arrival becomes the *leader*, waits a
+short window (``serving.batch.window_ms``) for followers of the same
+(family, table-version) key, stacks every member's parameter vector along
+a new leading axis, and makes ONE vmapped launch whose kernel reads the
+scan once and reduces each member's literals against it
+(physical/compiled.py `run_batched`).  Followers block on the group and
+receive their slice of the batched result — the tensor-runtime
+inter-query batching argument of TQP (arXiv:2203.01877).
+
+Latency discipline: the leader only waits out the window when the serving
+runtime reports other queries in flight (`busy` probe) — an idle server
+pays zero added latency.  Batch sizes pad to the next power of two
+(members repeat the last vector) so a family compiles at most log2(max)
+stacked variants.  Failures propagate to every member and feed the normal
+degradation ladder in each member's own thread.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+#: upper bound on how long a follower waits for its leader's launch; the
+#: leader always sets the group's done event in a finally, so this only
+#: guards against pathological scheduler stalls
+_FOLLOWER_WAIT_S = 600.0
+
+
+class _Group:
+    __slots__ = ("members", "outputs", "error", "done", "full", "closed")
+
+    def __init__(self):
+        self.members: List[Any] = []  # one params tuple per member
+        self.outputs: Optional[List[Any]] = None
+        self.error: Optional[BaseException] = None
+        self.done = threading.Event()
+        self.full = threading.Event()
+        self.closed = False
+
+
+class FamilyBatcher:
+    """Rendezvous point keyed by (family, table version).
+
+    `run` is called from the executing worker thread with this query's
+    parameter vector and two callables: ``solo()`` runs the member alone,
+    ``batched(members)`` runs one stacked launch and returns one result
+    per member, in order."""
+
+    def __init__(self, max_queries: int = 8, window_ms: float = 2.0,
+                 metrics=None, busy: Optional[Callable[[], bool]] = None):
+        self.max_queries = max(1, int(max_queries))
+        self.window_s = max(0.0, float(window_ms)) / 1000.0
+        self.metrics = metrics
+        #: "is any OTHER query in flight right now?" — gates the leader's
+        #: window wait so idle traffic pays no batching latency
+        self._busy = busy
+        self._lock = threading.Lock()
+        self._groups: Dict[Any, _Group] = {}
+
+    # ----------------------------------------------------------------- run
+    def run(self, key: Any, params: Any,
+            solo: Callable[[], Any],
+            batched: Callable[[List[Any]], List[Any]]) -> Any:
+        if self.max_queries <= 1:
+            return solo()
+        with self._lock:
+            group = self._groups.get(key)
+            if group is None or group.closed \
+                    or len(group.members) >= self.max_queries:
+                group = _Group()
+                self._groups[key] = group
+                leader = True
+            else:
+                leader = False
+            index = len(group.members)
+            group.members.append(params)
+            if not leader and len(group.members) >= self.max_queries:
+                group.full.set()
+        if leader:
+            return self._lead(key, group, solo, batched)
+        group.done.wait(_FOLLOWER_WAIT_S)
+        if group.error is not None:
+            raise group.error
+        if group.outputs is None:  # leader never finished (stalled/killed)
+            logger.warning("family batch leader stalled; running solo")
+            return solo()
+        self._mark_member(len(group.members))
+        return group.outputs[index]
+
+    #: unconditional rendezvous grace: the first query of a burst can reach
+    #: the batcher before its batch-mates are even admitted (the submit
+    #: loop races the worker pool), so a single busy-probe sample at entry
+    #: would skip the window exactly when it matters.  The grace bounds the
+    #: idle-traffic latency cost; the probe then decides whether the FULL
+    #: window is worth waiting out.
+    _GRACE_S = 0.010
+
+    def _lead(self, key: Any, group: _Group,
+              solo: Callable[[], Any],
+              batched: Callable[[List[Any]], List[Any]]) -> Any:
+        try:
+            if self.window_s:
+                grace = min(self.window_s, self._GRACE_S)
+                group.full.wait(grace)
+                if not group.full.is_set() and self.window_s > grace:
+                    with self._lock:
+                        joined = len(group.members) > 1
+                    if joined or self._busy is None or self._busy():
+                        group.full.wait(self.window_s - grace)
+            with self._lock:
+                group.closed = True
+                if self._groups.get(key) is group:
+                    del self._groups[key]
+                members = list(group.members)
+            if len(members) == 1:
+                if self.metrics is not None:
+                    self.metrics.inc("serving.batch.solo")
+                group.outputs = [solo()]
+            else:
+                group.outputs = batched(members)
+                if self.metrics is not None:
+                    self.metrics.inc("serving.batch.launches")
+                    self.metrics.inc("serving.batch.queries", len(members))
+                    self.metrics.observe("serving.batch.size", len(members))
+        except BaseException as exc:
+            group.error = exc
+            raise
+        finally:
+            # ALWAYS close and deregister — an exception before the mid-try
+            # close (window wait / busy probe raising) must not leave an
+            # open zombie group that later same-family queries join only to
+            # re-raise this leader's stale error (review finding)
+            with self._lock:
+                group.closed = True
+                if self._groups.get(key) is group:
+                    del self._groups[key]
+            group.done.set()
+        self._mark_member(len(group.members))
+        return group.outputs[0]
+
+    def _mark_member(self, size: int) -> None:
+        if size > 1:
+            from ..observability import trace_event
+
+            trace_event("family_batched", size=size)
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "maxQueries": self.max_queries,
+                "windowMs": self.window_s * 1000.0,
+                "openGroups": len(self._groups),
+            }

@@ -54,13 +54,19 @@ class ProfileStore:
         self._entries: "OrderedDict[str, Dict[str, Any]]" = OrderedDict()
 
     # ------------------------------------------------------------ writes
-    def _entry_locked(self, fingerprint: str,
-                      sql: Optional[str]) -> Dict[str, Any]:
+    def _entry_locked(self, fingerprint: str, sql: Optional[str],
+                      family: Optional[str] = None) -> Dict[str, Any]:
         e = self._entries.get(fingerprint)
         if e is None:
             e = self._entries[fingerprint] = {
                 "sql": (sql or "")[:_SQL_KEEP],
                 "sql_truncated": len(sql or "") > _SQL_KEEP,
+                #: the literal-stripped family fingerprint (families/);
+                #: "" for profiles recorded with families disabled or
+                #: restored from pre-family snapshots.  With families on,
+                #: entries are KEYED by family, so hit counts roll up
+                #: across every literal variant of the statement.
+                "family": family or "",
                 "hits": 0,
                 "cache_hits": 0,
                 "exec_ms": [],
@@ -68,9 +74,12 @@ class ProfileStore:
                 "compile": {},  # rung -> {"count": n, "ms": [rolling]}
                 "last_seen": 0.0,
             }
-        elif sql and not e["sql"]:
-            e["sql"] = sql[:_SQL_KEEP]
-            e["sql_truncated"] = len(sql) > _SQL_KEEP
+        else:
+            if sql and not e["sql"]:
+                e["sql"] = sql[:_SQL_KEEP]
+                e["sql_truncated"] = len(sql) > _SQL_KEEP
+            if family and not e.get("family"):
+                e["family"] = family
         self._entries.move_to_end(fingerprint)
         while len(self._entries) > self.keep:
             self._entries.popitem(last=False)
@@ -80,9 +89,10 @@ class ProfileStore:
     def record_exec(self, fingerprint: str, sql: Optional[str] = None,
                     exec_ms: Optional[float] = None,
                     result_bytes: Optional[int] = None,
-                    cache_hit: bool = False) -> None:
+                    cache_hit: bool = False,
+                    family: Optional[str] = None) -> None:
         with self._lock:
-            e = self._entry_locked(fingerprint, sql)
+            e = self._entry_locked(fingerprint, sql, family)
             e["hits"] += 1
             if cache_hit:
                 e["cache_hits"] += 1
@@ -94,41 +104,45 @@ class ProfileStore:
                 del e["result_bytes"][:-self.window]
 
     def record_compile(self, fingerprint: str, rung: str, ms: float,
-                       sql: Optional[str] = None) -> None:
+                       sql: Optional[str] = None,
+                       family: Optional[str] = None) -> None:
         with self._lock:
-            e = self._entry_locked(fingerprint, sql)
+            e = self._entry_locked(fingerprint, sql, family)
             r = e["compile"].setdefault(rung, {"count": 0, "ms": []})
             r["count"] += 1
             r["ms"].append(round(float(ms), 3))
             del r["ms"][:-self.window]
 
     # ------------------------------------------------------------- reads
-    def rows(self) -> List[Tuple[str, str, str]]:
-        """(fingerprint, metric, value) triples for ``SHOW PROFILES`` —
-        same flat shape as SHOW METRICS, one group of rows per profile."""
+    def rows(self) -> List[Tuple[str, str, str, str]]:
+        """(fingerprint, family, metric, value) rows for ``SHOW PROFILES``
+        — same flat shape as SHOW METRICS plus the family column, one
+        group of rows per profile."""
         with self._lock:
             entries = {fp: _copy_entry(e) for fp, e in self._entries.items()}
-        out: List[Tuple[str, str, str]] = []
+        out: List[Tuple[str, str, str, str]] = []
         for fp in sorted(entries):
             e = entries[fp]
-            out.append((fp, "sql", e["sql"]))
-            out.append((fp, "hits", str(e["hits"])))
-            out.append((fp, "cache_hits", str(e["cache_hits"])))
+            fam = e.get("family", "")
+            out.append((fp, fam, "sql", e["sql"]))
+            out.append((fp, fam, "hits", str(e["hits"])))
+            out.append((fp, fam, "cache_hits", str(e["cache_hits"])))
             if e["exec_ms"]:
-                out.append((fp, "exec_ms.p50",
+                out.append((fp, fam, "exec_ms.p50",
                             _fmt(_percentile(e["exec_ms"], 0.5))))
-                out.append((fp, "exec_ms.max", _fmt(max(e["exec_ms"]))))
-                out.append((fp, "exec_ms.last", _fmt(e["exec_ms"][-1])))
+                out.append((fp, fam, "exec_ms.max", _fmt(max(e["exec_ms"]))))
+                out.append((fp, fam, "exec_ms.last", _fmt(e["exec_ms"][-1])))
             if e["result_bytes"]:
-                out.append((fp, "result_bytes.last",
+                out.append((fp, fam, "result_bytes.last",
                             str(e["result_bytes"][-1])))
             for rung in sorted(e["compile"]):
                 r = e["compile"][rung]
-                out.append((fp, f"compile.{rung}.count", str(r["count"])))
+                out.append((fp, fam, f"compile.{rung}.count",
+                            str(r["count"])))
                 if r["ms"]:
-                    out.append((fp, f"compile.{rung}.ms.p50",
+                    out.append((fp, fam, f"compile.{rung}.ms.p50",
                                 _fmt(_percentile(r["ms"], 0.5))))
-                    out.append((fp, f"compile.{rung}.ms.max",
+                    out.append((fp, fam, f"compile.{rung}.ms.max",
                                 _fmt(max(r["ms"]))))
         return out
 
@@ -143,12 +157,30 @@ class ProfileStore:
         """(fingerprint, sql) for the hottest REPLAYABLE fingerprints — the
         pre-warm work list (serving/warmup.py).  Entries with no recorded
         SQL or a truncation-lossy one are excluded: replaying a prefix
-        would warm (or fail) the wrong statement."""
+        would warm (or fail) the wrong statement.  Deduped by family —
+        one compiled executable serves every literal variant, so pre-warm
+        replays ONE representative statement per family.  With the
+        engine's current recording (entries KEYED by family fingerprint)
+        the collapse is structural and this dedupe is a no-op guard; it
+        exists to keep the store's contract honest for callers that key
+        by literal fingerprint and pass `family` as the rollup field
+        (the record_* API explicitly allows that split)."""
         with self._lock:
             ranked = sorted(self._entries.items(),
                             key=lambda kv: kv[1]["hits"], reverse=True)
-            return [(fp, e["sql"]) for fp, e in ranked[:max(0, int(n))]
-                    if e["sql"] and not e.get("sql_truncated")]
+            out: List[Tuple[str, str]] = []
+            seen_families: set = set()
+            for fp, e in ranked:
+                if len(out) >= max(0, int(n)):
+                    break
+                if not e["sql"] or e.get("sql_truncated"):
+                    continue
+                family = e.get("family") or fp
+                if family in seen_families:
+                    continue
+                seen_families.add(family)
+                out.append((fp, e["sql"]))
+            return out
 
     def get(self, fingerprint: str) -> Optional[Dict[str, Any]]:
         with self._lock:
@@ -195,6 +227,9 @@ class ProfileStore:
                     "sql_truncated": bool(e.get(
                         "sql_truncated",
                         legacy and len(sql) >= self._LEGACY_SQL_KEEP)),
+                    # pre-family snapshots carry no family: "" (unknown),
+                    # so warm-up dedupes them by fingerprint as before
+                    "family": str(e.get("family", "") or ""),
                     "hits": int(e.get("hits", 0)),
                     "cache_hits": int(e.get("cache_hits", 0)),
                     "exec_ms": [float(v) for v in

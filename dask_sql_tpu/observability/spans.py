@@ -312,10 +312,12 @@ _sink: "contextvars.ContextVar[Optional[tuple]]" = \
 
 @contextlib.contextmanager
 def compile_sink(metrics, profiles=None, fingerprint: Optional[str] = None,
-                 sql: Optional[str] = None):
+                 sql: Optional[str] = None, family: Optional[str] = None):
     """Install the metric/profile destinations for `timed_jit_call` over
-    the dynamic extent of one query execution."""
-    token = _sink.set((metrics, profiles, fingerprint, sql))
+    the dynamic extent of one query execution.  `family` is the query's
+    literal-stripped family fingerprint (families/), recorded on the
+    profile entry so SHOW PROFILES can group and warm-up can dedupe."""
+    token = _sink.set((metrics, profiles, fingerprint, sql, family))
     try:
         yield
     finally:
@@ -350,10 +352,10 @@ def timed_jit_call(rung: str, fn, *args, may_compile: Optional[bool] = None,
     call runs under the compile watchdog (resilience/watchdog.py): a hung
     or exploding compile raises a degradable `CompileTimeoutError` instead
     of wedging the serving worker."""
-    metrics = profiles = fingerprint = sql = None
+    metrics = profiles = fingerprint = sql = family = None
     sink = _sink.get()
     if sink is not None:
-        metrics, profiles, fingerprint, sql = sink
+        metrics, profiles, fingerprint, sql, family = sink
     tr = current_trace()
     if tr is not None and metrics is None:
         metrics = tr.metrics
@@ -402,5 +404,6 @@ def timed_jit_call(rung: str, fn, *args, may_compile: Optional[bool] = None,
     if metrics is not None:
         metrics.observe(f"resilience.compile_ms.{rung}", ms)
     if profiles is not None and fingerprint:
-        profiles.record_compile(fingerprint, rung, ms, sql=sql)
+        profiles.record_compile(fingerprint, rung, ms, sql=sql,
+                                family=family)
     return out

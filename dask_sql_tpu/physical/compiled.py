@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import logging
 import re
+import threading
 from typing import Callable, Dict, List, Optional, Tuple
 
 import jax
@@ -47,13 +48,22 @@ from ..planner.expressions import (
     Expr,
     InArrayExpr,
     InListExpr,
+    InParamExpr,
     Literal,
+    ParamRef,
     ScalarFunc,
     transform,
     walk,
 )
 
 logger = logging.getLogger(__name__)
+
+#: reserved slot-dict key the per-call runtime parameter vector rides in
+#: (column slots are ints, so a string key can never collide).  Threading
+#: params through the slots dict — instead of mutating evaluator state —
+#: keeps concurrent traces of the same pipeline (solo + batched variants
+#: on different worker threads) race-free.
+PARAMS_SLOT = "__params__"
 
 
 _SUPPORTED_AGGS = {"sum", "count", "avg", "min", "max", "count_star",
@@ -429,6 +439,13 @@ class _TraceEval:
     def eval(self, expr: Expr, slots):
         if isinstance(expr, ColumnRef) and type(expr) is ColumnRef:
             return slots[expr.index]
+        if isinstance(expr, ParamRef):
+            # runtime query parameter (families/parameterize.py): a traced
+            # scalar argument instead of a baked constant, so one compiled
+            # executable serves every literal of the family
+            return (slots[PARAMS_SLOT][expr.index], None)
+        if isinstance(expr, InParamExpr):
+            return self._in_param(expr, slots)
         if isinstance(expr, Literal):
             if expr.value is None:
                 return (jnp.zeros((), dtype=jnp.float64), jnp.zeros((), dtype=bool))
@@ -510,6 +527,18 @@ class _TraceEval:
         if expr.negated:
             hit = ~hit
         return (hit, av)
+
+    def _in_param(self, expr: InParamExpr, slots):
+        """Membership against a runtime parameter vector: the value list is
+        a traced (sorted, pow2-padded) argument, so IN lists of different
+        values — and different lengths within one bucket — share the
+        executable.  Same search the host-constant path uses."""
+        ad, av = self.eval(expr.arg, slots)
+        sv = slots[PARAMS_SLOT][expr.index]
+        d = ad.astype(sv.dtype)
+        idx = jnp.clip(jnp.searchsorted(sv, d), 0, expr.length - 1)
+        hit = jnp.take(sv, idx) == d
+        return (~hit if expr.negated else hit, av)
 
     def _in_array(self, expr: InArrayExpr, slots):
         src = self._string_source(expr.arg)
@@ -788,9 +817,21 @@ class CompiledAggregate:
             from ..ops.pallas_kernels import choose_segsum_impl
 
             self.segsum_mode = choose_segsum_impl(config, self.domain)
-        #: (kind, np.dtype) per packed output row; filled when _fn traces
+        #: (kind, np.dtype) per packed output row; rebound atomically each
+        #: time a variant traces (solo and batched traces on concurrent
+        #: threads produce identical tags — rebinding instead of clearing
+        #: in place keeps a concurrent decoder's snapshot intact)
         self._pack_tags: List[Tuple[str, np.dtype]] = []
-        self._fn = jax.jit(self._build())
+        #: the raw traced callable, kept for the batcher's vmap variant —
+        #: `_build` closes over the construction table's metadata, which is
+        #: nulled once the pipeline enters the plugin cache
+        self._fn_raw = self._build()
+        self._fn = jax.jit(self._fn_raw)
+        #: lazily-built vmapped variant for the family batcher (one stacked
+        #: launch over the params' leading axis); compiled per pow2 batch
+        #: bucket, tracked in _warm_batch for the compile watchdog
+        self._fn_batched = None
+        self._warm_batch: set = set()
         # warming is left to the caller; tracing happens on first call
         #: True once _fn compiled for this table's shapes — the compile
         #: watchdog only watches calls that may compile
@@ -809,8 +850,9 @@ class CompiledAggregate:
         n_rows = self.table.num_rows
         segsum_mode = self.segsum_mode
 
-        def fn(datas, valids, row_valid):
+        def fn(datas, valids, row_valid, params=()):
             slots = {i: (datas[i], valids[i]) for i in range(n_cols)}
+            slots[PARAMS_SLOT] = params
             nr = (datas[0].shape[0] if datas
                   else row_valid.shape[0] if row_valid is not None
                   else n_rows)
@@ -853,11 +895,23 @@ class CompiledAggregate:
             for d, v in outs:
                 flat.append(d)
                 flat.append(v if v is not None else jnp.ones_like(hit))
-            return pack_flat(flat, self._pack_tags)
+            tags: List[Tuple[str, np.dtype]] = []
+            out = pack_flat(flat, tags)
+            self._pack_tags = tags
+            return out
 
         return fn
 
-    def run(self, table: Optional[Table] = None) -> Table:
+    @property
+    def batchable(self) -> bool:
+        """Eligible for the family batcher's stacked (vmapped) launch: the
+        whole packed matrix must ride one host pull per member, and only
+        the scatter segment-sum mode is known vmap-clean (the pallas /
+        blocked-matmul kernels are not batched here)."""
+        return self.domain <= HOST_PULL_DOMAIN \
+            and self.segsum_mode == "scatter"
+
+    def run(self, table: Optional[Table] = None, params: Tuple = ()) -> Table:
         from ..observability import timed_jit_call
 
         # the input table is a PARAMETER, not shared object state: cached
@@ -869,11 +923,46 @@ class CompiledAggregate:
         valids = [table.columns[n].validity for n in table.column_names]
         packed = timed_jit_call("compiled_aggregate", self._fn,
                                 tuple(datas), tuple(valids),
-                                table.row_valid,
+                                table.row_valid, tuple(params),
                                 may_compile=not self._warm)
         self._warm = True
         tags = self._pack_tags
         host, present = fetch_packed(packed, self.domain)
+        return self._decode(host, present, tags)
+
+    def run_batched(self, table: Table, params_list: List[Tuple]
+                    ) -> List[Table]:
+        """One stacked launch for several same-family queries: member
+        parameter vectors stack along a new leading axis (padded to the
+        pow2 batch bucket by repeating the last member — padding work is
+        discarded), the vmapped kernel reads the scan ONCE, and each
+        member decodes its slice of the packed output."""
+        from ..families import stack_params
+        from ..observability import timed_jit_call
+        from ..utils import count_d2h
+
+        n = len(params_list)
+        stacked, bucket = stack_params(params_list)
+        if self._fn_batched is None:
+            self._fn_batched = jax.jit(
+                jax.vmap(self._fn_raw, in_axes=(None, None, None, 0)))
+        datas = tuple(table.columns[c].data for c in table.column_names)
+        valids = tuple(table.columns[c].validity for c in table.column_names)
+        packed = timed_jit_call("compiled_aggregate", self._fn_batched,
+                                datas, valids, table.row_valid, stacked,
+                                may_compile=bucket not in self._warm_batch)
+        self._warm_batch.add(bucket)
+        tags = self._pack_tags
+        count_d2h()
+        host_all = np.asarray(jax.device_get(packed))  # (bucket, R, domain)
+        out = []
+        for b in range(n):
+            host = host_all[b]
+            present = np.nonzero(host[0] != 0.0)[0]
+            out.append(self._decode(host[:, present], present, tags))
+        return out
+
+    def _decode(self, host: np.ndarray, present: np.ndarray, tags) -> Table:
         if not self.gcols and present.shape[0] == 0:
             # SQL: a global aggregate over zero input rows still yields one
             # row (COUNT=0, other aggs NULL via their cnt>0 validity)
@@ -946,6 +1035,66 @@ def _bucket_of(key: Tuple) -> Tuple:
     return (key[0], key[-3], key[-2])
 
 
+#: in-flight constructions, key -> Event: concurrent same-family misses
+#: wait for the first builder instead of paying duplicate XLA compiles
+#: (cold fan-in of a family is exactly the batcher's target workload)
+_building: Dict[Tuple, threading.Event] = {}
+_building_lock = threading.Lock()
+_BUILD_WAIT_S = 300.0
+
+
+def singleflight_begin(key: Tuple):
+    """(is_builder, event) for a compiled-cache miss; a non-builder should
+    ``event.wait`` then re-check the cache.  Builders MUST call
+    `singleflight_done(key)` in a finally."""
+    with _building_lock:
+        ev = _building.get(key)
+        if ev is None:
+            ev = _building[key] = threading.Event()
+            return True, ev
+        return False, ev
+
+
+def singleflight_done(key: Tuple) -> None:
+    with _building_lock:
+        ev = _building.pop(key, None)
+    if ev is not None:
+        ev.set()
+
+
+def singleflight_get_or_build(ctx, cache: "OrderedDict", key: Tuple, build):
+    """THE miss-handling protocol of every compiled-pipeline cache, shared
+    so the three pipelines cannot drift: lock-guarded lookup; on a miss,
+    one builder constructs while concurrent same-key misses wait and
+    reuse; a waiter whose builder failed or declined falls through and
+    builds under its own query's policy.  `build()` constructs, inserts
+    into `cache` and returns the pipeline — or None to decline (e.g. the
+    background-recompile deferral).  Returns (compiled_or_None,
+    built_here): built_here=False means this query REUSED an executable
+    another query paid for (the family-hit accounting hook)."""
+    with ctx._plan_lock:
+        compiled = cache.get(key)
+        if compiled is not None:
+            cache.move_to_end(key)
+            return compiled, False
+    builder, build_ev = singleflight_begin(key)
+    if not builder:
+        build_ev.wait(_BUILD_WAIT_S)
+        with ctx._plan_lock:
+            compiled = cache.get(key)
+            if compiled is not None:
+                cache.move_to_end(key)
+                return compiled, False
+        # the builder failed or declined; build here so the failure
+        # surfaces under this query's own policy
+        builder, build_ev = singleflight_begin(key)
+    try:
+        return build(), True
+    finally:
+        if builder:
+            singleflight_done(key)
+
+
 def try_compiled_aggregate(rel: p.Aggregate, executor) -> Optional[Table]:
     """Attempt the compiled path for an Aggregate subtree; None to fall back."""
     if not executor.config.get("sql.compile", True):
@@ -962,6 +1111,15 @@ def try_compiled_aggregate(rel: p.Aggregate, executor) -> Optional[Table]:
         dc = ctx.schema[scan.schema_name].tables.get(scan.table_name)
         if dc is None:
             return None  # view-backed scans take the eager path
+        # parameterize (families/): literals in filters and aggregate
+        # arguments become runtime parameters, so the cache key — and the
+        # compiled executable — is shared by the whole query family
+        from .. import families
+
+        pz = families.pipeline_parameterizer(executor.config)
+        filters = [pz.rewrite(f) for f in filters]
+        agg_exprs = [pz.rewrite_agg(a) for a in agg_exprs]
+        params = pz.params
         key = (
             dc.uid,
             scan.schema_name, scan.table_name,
@@ -975,30 +1133,46 @@ def try_compiled_aggregate(rel: p.Aggregate, executor) -> Optional[Table]:
         mode = str(executor.config.get("sql.compile.segsum", "auto"))
         key = key + (mode,)
         # the plugin cache (and the background compiler's swap) are guarded
-        # by the plan-cache lock: server worker threads share these dicts
-        with ctx._plan_lock:
-            compiled = _cache.get(key)
-            if compiled is not None:
-                _cache.move_to_end(key)
-        if compiled is None:
+        # by the plan-cache lock: server worker threads share these dicts;
+        # concurrent cold misses of one family single-flight the build
+        def build():
             if _defer_to_background(ctx, rel, key, table, scan, filters,
-                                    group_exprs, agg_exprs, executor.config):
+                                    group_exprs, agg_exprs,
+                                    executor.config, params):
                 return None  # served on a lower rung this time
-            compiled = CompiledAggregate(rel, table, scan, filters,
-                                         group_exprs, agg_exprs,
-                                         executor.config)
+            obj = CompiledAggregate(rel, table, scan, filters, group_exprs,
+                                    agg_exprs, executor.config)
             # cached pipelines must not pin the construction table's HBM
-            compiled.table = None
+            obj.table = None
             with ctx._plan_lock:
-                _cache[key] = compiled
+                _cache[key] = obj
                 while len(_cache) > _CACHE_CAP:
                     _cache.popitem(last=False)
                 _remember_family_locked(ctx, _family_of(key),
                                         _bucket_of(key))
+            return obj
+
+        compiled, built_here = singleflight_get_or_build(ctx, _cache, key,
+                                                         build)
+        if compiled is None:
+            return None  # deferred to the background compiler
+        if not built_here and params:
+            # executable reuse across literals: the family discipline at work
+            ctx.metrics.inc("families.hit")
+            from ..observability import trace_event
+
+            trace_event("family_hit", rung="compiled_aggregate",
+                        params=len(params))
         from ..resilience import faults
 
         faults.maybe_inject("oom", executor.config)
-        return compiled.run(table)
+        batcher = families.batcher_of(ctx)
+        if batcher is not None and params and compiled.batchable:
+            return batcher.run(
+                ("compiled_aggregate",) + key, params,
+                solo=lambda: compiled.run(table, params),
+                batched=lambda members: compiled.run_batched(table, members))
+        return compiled.run(table, params)
     except _Unsupported as e:
         logger.debug("compiled pipeline unsupported: %s", e)
         return None
@@ -1017,7 +1191,7 @@ def _remember_family_locked(ctx, family: Tuple, bucket: Tuple) -> None:
 
 
 def _defer_to_background(ctx, rel, key, table, scan, filters, group_exprs,
-                         agg_exprs, config) -> bool:
+                         agg_exprs, config, params=()) -> bool:
     """Background-recompile hook: when this plan FAMILY compiled before but
     the table's bucket changed (growth / replacement), build-and-compile
     the new pipeline on the background thread and decline the rung now —
@@ -1048,7 +1222,9 @@ def _defer_to_background(ctx, rel, key, table, scan, filters, group_exprs,
                 obj = CompiledAggregate(rel, table, scan, filters,
                                         group_exprs, agg_exprs, config)
                 with observability.compile_sink(ctx.metrics):
-                    obj.run(table)  # compiles every kernel; result discarded
+                    # compiles every kernel with the triggering query's
+                    # params as runtime args; result discarded
+                    obj.run(table, params)
             obj.table = None
             obj._warm = True
             with ctx._plan_lock:

@@ -45,6 +45,7 @@ from ..planner.expressions import (
     walk,
 )
 from .compiled import (
+    PARAMS_SLOT,
     _ColMeta,
     _TraceEval,
     _Unsupported,
@@ -358,11 +359,13 @@ class CompiledJoinAggregate:
         n_joins = len(self.ext.joins)
         rmins = [rmin for rmin, _ in self.luts]
 
-        def fn(probe_datas, probe_valids, luts, build_cols, row_valid):
+        def fn(probe_datas, probe_valids, luts, build_cols, row_valid,
+               params=()):
             # build_cols: {(k,col): (data, valid_or_None)} full build tables
             n_rows = probe_datas[0].shape[0] if probe_datas else 0
             slots: Dict[int, Tuple] = {
                 i: (probe_datas[i], probe_valids[i]) for i in range(n_probe)}
+            slots[PARAMS_SLOT] = params
             # padded sharded probe: the row mask keeps pad rows out of every
             # join match, filter, and reduction (exact-spec sharding)
             mask = jnp.ones(n_rows, dtype=bool) if row_valid is None \
@@ -458,13 +461,16 @@ class CompiledJoinAggregate:
             for d, v in outs:
                 flat.append(d)
                 flat.append(v if v is not None else jnp.ones_like(hit))
-            return pack_flat(flat, self._pack_tags)
+            tags: List[Tuple[str, np.dtype]] = []
+            out = pack_flat(flat, tags)
+            self._pack_tags = tags
+            return out
 
         # domains are python ints (build table row counts) — bind them now
         build_domains = [bt.num_rows for bt in self.build_tables]
         return fn
 
-    def run(self) -> Table:
+    def run(self, params: Tuple = ()) -> Table:
         pt = self.probe_table
         probe_datas = tuple(pt.columns[n].data for n in pt.column_names)
         probe_valids = tuple(pt.columns[n].validity for n in pt.column_names)
@@ -484,7 +490,8 @@ class CompiledJoinAggregate:
 
         packed = timed_jit_call("compiled_join_aggregate", self._fn,
                                 probe_datas, probe_valids, luts, build_cols,
-                                pt.row_valid, may_compile=not self._warm)
+                                pt.row_valid, tuple(params),
+                                may_compile=not self._warm)
         self._warm = True
         from .compiled import fetch_packed, unpack_row
 
@@ -610,6 +617,17 @@ def try_compiled_join_aggregate(rel: p.Aggregate, executor) -> Optional[Table]:
         # cheap plan-only checks BEFORE any build-side execution (ADVICE r2:
         # an ineligible query used to pay for its build subtrees twice)
         check_agg_static_support(agg_exprs)
+        # parameterize (families/): literals in the PROBE-side conjuncts
+        # and aggregate arguments become runtime parameters.  Build-side
+        # literals stay baked — they shape the eagerly-executed build
+        # tables and their LUTs — and key the cache via the build plans'
+        # reprs, so a build-side literal change is a different family.
+        from .. import families
+
+        pz = families.pipeline_parameterizer(executor.config)
+        ext.conjuncts = [pz.rewrite(e) for e in ext.conjuncts]
+        agg_exprs = [pz.rewrite_agg(a) for a in agg_exprs]
+        params = pz.params
         probe_table = executor.get_table(ext.scan.schema_name,
                                          ext.scan.table_name)
         if ext.scan.projection is not None:
@@ -620,28 +638,48 @@ def try_compiled_join_aggregate(rel: p.Aggregate, executor) -> Optional[Table]:
         # be filtered scans, nested joins, anything) — compacted eagerly
         build_tables = [executor.execute(j["plan"]) for j in ext.joins]
         key = (
-            tuple(uids), str(rel),
+            tuple(uids),
+            ext.scan.schema_name, ext.scan.table_name,
+            tuple(ext.scan.projection or ()),
+            tuple(repr(j["plan"]) for j in ext.joins),
+            tuple(str(j["lkey"]) + "=" + str(j["rkey"]) for j in ext.joins),
+            tuple(str(e) for e in ext.conjuncts),
+            tuple(str(e) for e in group_exprs),
+            tuple(str(a) for a in agg_exprs),
+            tuple((f.name, f.sql_type) for f in rel.schema),
             probe_table.num_rows,
             probe_table.padded_rows,
             tuple(bt.num_rows for bt in build_tables),
         )
-        compiled = _cache.get(key)
-        if compiled is None:
-            compiled = CompiledJoinAggregate(rel, ext, group_exprs, agg_exprs,
-                                             probe_table, build_tables,
-                                             executor)
-            _cache[key] = compiled
-            while len(_cache) > _CACHE_CAP:
-                _cache.popitem(last=False)
-        else:
-            _cache.move_to_end(key)
+        from .compiled import singleflight_get_or_build
+
+        ctx = executor.context
+
+        def build():
+            obj = CompiledJoinAggregate(rel, ext, group_exprs, agg_exprs,
+                                        probe_table, build_tables, executor)
+            with ctx._plan_lock:
+                _cache[key] = obj
+                while len(_cache) > _CACHE_CAP:
+                    _cache.popitem(last=False)
+            return obj
+
+        compiled, built_here = singleflight_get_or_build(ctx, _cache, key,
+                                                         build)
+        if not built_here:
             compiled.probe_table = probe_table
             compiled.build_tables = build_tables
+            if params:
+                ctx.metrics.inc("families.hit")
+                from ..observability import trace_event
+
+                trace_event("family_hit", rung="compiled_join_aggregate",
+                            params=len(params))
         try:
             from ..resilience import faults
 
             faults.maybe_inject("oom", executor.config)
-            return compiled.run()
+            return compiled.run(params)
         finally:
             # the LUTs/dictionaries stay warm; the (large) table refs do not
             compiled.probe_table = None

@@ -37,10 +37,12 @@ from ..columnar.table import Table
 from ..planner import plan as p
 from ..planner.expressions import ColumnRef
 from .compiled import (
+    PARAMS_SLOT,
     _TableMeta,
     _TraceEval,
     _Unsupported,
     pack_flat,
+    singleflight_get_or_build,
 )
 
 logger = logging.getLogger(__name__)
@@ -92,8 +94,9 @@ def _extract(root):
 
 
 class CompiledSelect:
-    def __init__(self, table: Table, scan, upper_filters, proj, sort_keys,
-                 sort_fetch, limit, inner_limit):
+    def __init__(self, table: Table, scan, upper_filters, scan_filters,
+                 proj, proj_exprs, sort_keys, sort_fetch, limit, inner_limit,
+                 params=()):
         self.scan = scan
         self.proj = proj
         self.sort_keys = sort_keys
@@ -107,7 +110,7 @@ class CompiledSelect:
         # be output positions over non-string columns (host lexsort order on
         # dictionary codes is only lexicographic for sorted dictionaries)
         self.out_meta: List[Tuple[str, SqlType, Optional[object]]] = []
-        for e, f in zip(proj.exprs, proj.schema):
+        for e, f in zip(proj_exprs, proj.schema):
             if f.sql_type in STRING_TYPES:
                 if not (isinstance(e, ColumnRef) and type(e) is ColumnRef):
                     raise _Unsupported("computed string output")
@@ -127,15 +130,16 @@ class CompiledSelect:
 
         ev = _TraceEval(_TableMeta(table))
         n_cols = len(table.column_names)
-        exprs = list(proj.exprs)
+        exprs = list(proj_exprs)
         upper_flts = list(upper_filters)
-        scan_flts = list(scan.filters)
+        scan_flts = list(scan_filters)
         self._pack_tags: List[Tuple[str, np.dtype]] = []
 
         inner_limit = self.inner_limit
 
-        def mask_fn(datas, valids, row_valid):
+        def mask_fn(datas, valids, row_valid, params=()):
             slots = {i: (datas[i], valids[i]) for i in range(n_cols)}
+            slots[PARAMS_SLOT] = params
             nr = datas[0].shape[0] if datas else 0
 
             def fold(mask, f):
@@ -172,7 +176,7 @@ class CompiledSelect:
             mask = as_rows(mask)
             return mask, jnp.sum(mask.astype(jnp.int64))
 
-        def gather_fn(datas, valids, mask, bucket):
+        def gather_fn(datas, valids, mask, params, bucket):
             # bucket is static per trace: sized nonzero keeps shapes static,
             # and jit re-specializes per distinct bucket (<= log2 n traces)
             (idx,) = jnp.nonzero(mask, size=bucket, fill_value=0)
@@ -181,6 +185,7 @@ class CompiledSelect:
                 d = datas[i][idx]
                 v = valids[i][idx] if valids[i] is not None else None
                 slots[i] = (d, v)
+            slots[PARAMS_SLOT] = params
             flat = []
             for e in exprs:
                 d, v = ev.eval(e, slots)
@@ -192,40 +197,86 @@ class CompiledSelect:
                     v = jnp.broadcast_to(v, (bucket,))
                 flat.append(d)
                 flat.append(v if v is not None else jnp.ones(bucket, dtype=bool))
-            return pack_flat(flat, self._pack_tags)
+            tags: List[Tuple[str, np.dtype]] = []
+            out = pack_flat(flat, tags)
+            self._pack_tags = tags
+            return out
 
         # trace-check now so ineligible expressions fall back BEFORE the
         # plugin cache ever sees this object
         datas_s = tuple(table.columns[n].data for n in table.column_names)
         valids_s = tuple(table.columns[n].validity for n in table.column_names)
-        jax.eval_shape(mask_fn, datas_s, valids_s, table.row_valid)
-        jax.eval_shape(lambda d, v, m: gather_fn(d, v, m, 8), datas_s,
+        params_s = tuple(np.asarray(v) for v in params)
+        jax.eval_shape(mask_fn, datas_s, valids_s, table.row_valid, params_s)
+        jax.eval_shape(lambda d, v, m, q: gather_fn(d, v, m, q, 8), datas_s,
                        valids_s,
-                       jax.ShapeDtypeStruct((table.padded_rows,), jnp.bool_))
+                       jax.ShapeDtypeStruct((table.padded_rows,), jnp.bool_),
+                       params_s)
+        self._mask_fn_raw = mask_fn
         self._mask_fn = jax.jit(mask_fn)
         self._gather_fn = jax.jit(gather_fn, static_argnames=("bucket",))
+        #: lazily-built vmapped mask variant for the family batcher: ONE
+        #: stacked launch evaluates every co-admitted member's filter over
+        #: a single scan; compiled per pow2 batch bucket
+        self._mask_batched = None
+        self._warm_mask_batch: set = set()
         #: compile-watchdog hints: the mask kernel compiles once, the
         #: gather kernel once per distinct pow2 survivor bucket
         self._mask_warm = False
         self._warm_buckets: set = set()
 
-    def run(self, table: Optional[Table] = None) -> Table:
+    def run(self, table: Optional[Table] = None, params: Tuple = ()) -> Table:
         from ..utils import count_d2h
-        from .compiled import unpack_row
+        from ..observability import timed_jit_call
 
         # parameter, not shared state: cached pipelines serve concurrent
         # worker threads (see CompiledAggregate.run)
         t = table if table is not None else self.table
         datas = tuple(t.columns[n].data for n in t.column_names)
         valids = tuple(t.columns[n].validity for n in t.column_names)
-        from ..observability import timed_jit_call
-
         mask, count_dev = timed_jit_call(
             "compiled_select", self._mask_fn, datas, valids, t.row_valid,
-            may_compile=not self._mask_warm)
+            tuple(params), may_compile=not self._mask_warm)
         self._mask_warm = True
         count_d2h()
         count = int(count_dev)  # one scalar round trip
+        return self._finish(datas, valids, mask, count, tuple(params))
+
+    def run_batched(self, table: Table, params_list: List[Tuple]
+                    ) -> List[Table]:
+        """Family-batched execution: member literal vectors stack along a
+        new leading axis and ONE vmapped launch computes every member's
+        selection mask over a single shared scan (batch padded to the pow2
+        bucket by repeating the last member).  Survivor gathers then run
+        per member — they share the per-bucket gather executables."""
+        from ..families import stack_params
+        from ..utils import count_d2h
+        from ..observability import timed_jit_call
+
+        n = len(params_list)
+        stacked, bucket = stack_params(params_list)
+        if self._mask_batched is None:
+            self._mask_batched = jax.jit(
+                jax.vmap(self._mask_fn_raw, in_axes=(None, None, None, 0)))
+        datas = tuple(table.columns[c].data for c in table.column_names)
+        valids = tuple(table.columns[c].validity
+                       for c in table.column_names)
+        masks, counts_dev = timed_jit_call(
+            "compiled_select", self._mask_batched, datas, valids,
+            table.row_valid, stacked,
+            may_compile=bucket not in self._warm_mask_batch)
+        self._warm_mask_batch.add(bucket)
+        count_d2h()
+        counts = np.asarray(jax.device_get(counts_dev))
+        return [self._finish(datas, valids, masks[b], int(counts[b]),
+                             params_list[b]) for b in range(n)]
+
+    def _finish(self, datas, valids, mask, count: int,
+                params: Tuple) -> Table:
+        from ..utils import count_d2h
+        from ..observability import timed_jit_call
+        from .compiled import unpack_row
+
         # without an ORDER BY, a LIMIT caps how many survivors we even pull:
         # sized nonzero returns ascending indices, so the first `want` rows
         # ARE the eager path's first `want` rows
@@ -243,7 +294,8 @@ class CompiledSelect:
             # jit re-specializes per bucket: each new bucket is a fresh
             # XLA compile the observability layer records per rung
             packed = timed_jit_call("compiled_select", self._gather_fn,
-                                    datas, valids, mask, bucket=bucket,
+                                    datas, valids, mask, params,
+                                    bucket=bucket,
                                     may_compile=bucket not in
                                     self._warm_buckets)
             self._warm_buckets.add(bucket)
@@ -351,12 +403,24 @@ def try_compiled_select(root, executor) -> Optional[Table]:
             # partition sort leaves results sharded in sort order; pulling
             # the whole table to one host defeats the layout)
             return None
+        # parameterize (families/): filter and projection literals become
+        # runtime parameters so the cache key — and the mask/gather
+        # executables — are shared by the whole query family.  LIMIT /
+        # sort-fetch windows stay static (they steer host slicing and the
+        # survivor pull), so each window is its own family.
+        from .. import families
+
+        pz = families.pipeline_parameterizer(executor.config)
+        p_upper = [pz.rewrite(f) for f in upper_filters]
+        p_scan_flts = [pz.rewrite(f) for f in scan.filters]
+        p_exprs = [pz.rewrite(e) for e in proj.exprs]
+        params = pz.params
         key = (
             dc.uid,
             tuple(scan.projection or ()),
-            tuple(str(f) for f in upper_filters),
-            tuple(str(f) for f in scan.filters),
-            tuple(str(e) for e in proj.exprs),
+            tuple(str(f) for f in p_upper),
+            tuple(str(f) for f in p_scan_flts),
+            tuple(str(e) for e in p_exprs),
             tuple(str(k.expr) + str(k.ascending) + str(k.nulls_first)
                   for k in sort_keys) if sort_keys else None,
             sort_fetch,
@@ -366,32 +430,47 @@ def try_compiled_select(root, executor) -> Optional[Table]:
             table.padded_rows,
         )
         ctx = executor.context
-        with ctx._plan_lock:
-            compiled = _cache.get(key)
-            if compiled is not None:
-                _cache.move_to_end(key)
-        if compiled is None:
-            if _defer_to_background(ctx, key, table, scan, upper_filters,
-                                    proj, sort_keys, sort_fetch, limit,
-                                    inner_limit):
+
+        def build():
+            if _defer_to_background(ctx, key, table, scan, p_upper,
+                                    p_scan_flts, proj, p_exprs, sort_keys,
+                                    sort_fetch, limit, inner_limit, params):
                 return None  # served on a lower rung this time
-            compiled = CompiledSelect(table, scan, upper_filters, proj,
-                                      sort_keys, sort_fetch, limit,
-                                      inner_limit)
+            obj = CompiledSelect(table, scan, p_upper, p_scan_flts, proj,
+                                 p_exprs, sort_keys, sort_fetch, limit,
+                                 inner_limit, params)
             # cached pipelines must not pin the construction table's HBM
-            compiled.table = None
+            obj.table = None
             from .compiled import _remember_family_locked
 
             with ctx._plan_lock:
-                _cache[key] = compiled
+                _cache[key] = obj
                 while len(_cache) > _CACHE_CAP:
                     _cache.popitem(last=False)
                 _remember_family_locked(ctx, _family_of(key),
                                         _bucket_of(key))
+            return obj
+
+        compiled, built_here = singleflight_get_or_build(ctx, _cache, key,
+                                                         build)
+        if compiled is None:
+            return None  # deferred to the background compiler
+        if not built_here and params:
+            ctx.metrics.inc("families.hit")
+            from ..observability import trace_event
+
+            trace_event("family_hit", rung="compiled_select",
+                        params=len(params))
         from ..resilience import faults
 
         faults.maybe_inject("oom", executor.config)
-        return compiled.run(table)
+        batcher = families.batcher_of(ctx)
+        if batcher is not None and params:
+            return batcher.run(
+                ("compiled_select",) + key, params,
+                solo=lambda: compiled.run(table, params),
+                batched=lambda members: compiled.run_batched(table, members))
+        return compiled.run(table, params)
     except _Unsupported as e:
         logger.debug("compiled select unsupported: %s", e)
         return None
@@ -402,8 +481,9 @@ def try_compiled_select(root, executor) -> Optional[Table]:
         return None
 
 
-def _defer_to_background(ctx, key, table, scan, upper_filters, proj,
-                         sort_keys, sort_fetch, limit, inner_limit) -> bool:
+def _defer_to_background(ctx, key, table, scan, upper_filters, scan_filters,
+                         proj, proj_exprs, sort_keys, sort_fetch, limit,
+                         inner_limit, params=()) -> bool:
     """Background-recompile hook for root select chains — same policy as
     physical/compiled.py `_defer_to_background`: a seen family whose table
     bucket changed compiles off the critical path while this query runs
@@ -428,11 +508,12 @@ def _defer_to_background(ctx, key, table, scan, upper_filters, proj,
             from .. import observability
 
             with ctx.config.set(effective):
-                obj = CompiledSelect(table, scan, upper_filters, proj,
+                obj = CompiledSelect(table, scan, upper_filters,
+                                     scan_filters, proj, proj_exprs,
                                      sort_keys, sort_fetch, limit,
-                                     inner_limit)
+                                     inner_limit, params)
                 with observability.compile_sink(ctx.metrics):
-                    obj.run(table)  # compiles mask + first-bucket gather
+                    obj.run(table, params)  # compiles mask + first gather
             obj.table = None
             with ctx._plan_lock:
                 _cache[key] = obj

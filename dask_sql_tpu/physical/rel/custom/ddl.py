@@ -262,9 +262,10 @@ class ShowMetricsPlugin(BaseRelPlugin):
 class ShowProfilesPlugin(BaseRelPlugin):
     """SHOW PROFILES [LIKE 'pat'] — the per-fingerprint profile store
     (observability/profiles.py) as a result set: hit counts, rolling
-    exec wall times, result bytes, and per-ladder-rung compile wall times.
-    LIKE filters on the fingerprint OR the metric name, so both
-    ``LIKE 'deadbeef%'`` and ``LIKE 'compile.%'`` narrow usefully."""
+    exec wall times, result bytes, per-ladder-rung compile wall times,
+    and the plan-family fingerprint (families/) the entry rolls up under.
+    LIKE filters on the fingerprint, the family OR the metric name, so
+    ``LIKE 'deadbeef%'`` and ``LIKE 'compile.%'`` both narrow usefully."""
 
     class_name = "ShowProfilesNode"
 
@@ -272,10 +273,13 @@ class ShowProfilesPlugin(BaseRelPlugin):
         rows = executor.context.profiles.rows()
         if rel.like:
             rows = [r for r in rows
-                    if _like_match(rel.like, r[0]) or _like_match(rel.like, r[1])]
+                    if _like_match(rel.like, r[0])
+                    or _like_match(rel.like, r[1])
+                    or _like_match(rel.like, r[2])]
         return _string_table({"Fingerprint": [r[0] for r in rows],
-                              "Metric": [r[1] for r in rows],
-                              "Value": [r[2] for r in rows]})
+                              "Family": [r[1] for r in rows],
+                              "Metric": [r[2] for r in rows],
+                              "Value": [r[3] for r in rows]})
 
 
 @Executor.add_plugin_class

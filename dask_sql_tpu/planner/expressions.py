@@ -77,6 +77,59 @@ class Literal(Expr):
 
 
 @dataclass(frozen=True)
+class ParamRef(Expr):
+    """A runtime query parameter: slot `index` of the per-query parameter
+    vector (families/parameterize.py lifts eligible literals into these).
+
+    Never produced by the binder — the parameterization pass creates them
+    post-optimize so one compiled executable can serve a whole *family* of
+    queries that differ only in literal values.  The repr/str carries the
+    slot and the SQL type but NOT the value: two plans that differ only in
+    parameterized literals stringify identically, which is exactly what
+    keys the family fingerprint and the compiled-pipeline caches."""
+
+    index: int
+    sql_type: SqlType
+
+    @property
+    def rex_type(self) -> str:
+        return RexType.LITERAL
+
+    def __str__(self):
+        return f"?{self.index}:{self.sql_type.name}"
+
+
+@dataclass(frozen=True)
+class InParamExpr(Expr):
+    """Membership test against a runtime parameter *vector*: the
+    parameterized form of an all-literal ``IN (...)`` list.
+
+    The value list itself lives in the query's parameter vector (slot
+    `index`), host-normalized to `cmp_dtype` and padded to the power-of-two
+    `length` bucket — so IN lists of 5, 6 and 8 values share one compiled
+    kernel (bucket 8) while a 9-value list is its own family.  Padding
+    repeats an existing member, which cannot change membership."""
+
+    arg: Expr
+    index: int
+    length: int  # pow2 value-vector length (the family's bucket)
+    cmp_dtype: str  # numpy dtype name the comparison runs in
+    negated: bool = False
+    sql_type: SqlType = SqlType.BOOLEAN
+
+    def children(self):
+        return [self.arg]
+
+    def with_children(self, children):
+        return replace(self, arg=children[0])
+
+    def __str__(self):
+        neg = " negated" if self.negated else ""
+        return (f"in_param({self.arg}, ?{self.index}x{self.length}"
+                f":{self.cmp_dtype}{neg})")
+
+
+@dataclass(frozen=True)
 class ScalarFunc(Expr):
     """A call of a named kernel op — the unit the physical rex layer maps.
 

@@ -54,9 +54,18 @@ def plan_fingerprint(rel) -> str:
 
 
 def _fingerprint_of(executor, rel) -> str:
+    """Breaker/trace identity of the executing (sub)plan: the literal-
+    stripped FAMILY fingerprint when plan families are enabled — a rung
+    that dies for ``user_id = 17`` is the same hazard for ``user_id = 404``,
+    so verdicts, skips and cooldowns apply family-wide — else the exact
+    literal-baked plan fingerprint."""
     fp = getattr(executor, "_resilience_fp", None)
     if fp is None:
-        fp = plan_fingerprint(rel)
+        from ..families import family_of
+
+        info = family_of(rel, executor.config,
+                         metrics=executor.context.metrics)
+        fp = info.fingerprint if info is not None else plan_fingerprint(rel)
         executor._resilience_fp = fp
     return fp
 

@@ -37,6 +37,15 @@ DOCUMENTED_METRICS = frozenset({
     "analysis.estimate.rows_hi",
     "analysis.estimate.rung_proof",
     "analysis.estimate.internal_error",
+    # families/ — parameterized plan families + inter-query batching
+    "families.parameterized",
+    "families.hit",
+    "families.estimate.hit",
+    "families.internal_error",
+    "serving.batch.launches",
+    "serving.batch.queries",
+    "serving.batch.solo",
+    "serving.batch.size",
     # observability/ — lifecycle tracing + slow-query log
     "observability.slow_query",
     # planner

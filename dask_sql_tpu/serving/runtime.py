@@ -56,7 +56,9 @@ class ServingRuntime:
                  retry_after_s: float = 1.0,
                  default_deadline_s: Optional[float] = None,
                  metrics: Optional[MetricsRegistry] = None,
-                 retry_policy: Optional[BackoffPolicy] = None):
+                 retry_policy: Optional[BackoffPolicy] = None,
+                 batch_queries: int = 8,
+                 batch_window_ms: float = 2.0):
         self.workers = max(1, int(workers))
         self.metrics = metrics if metrics is not None else MetricsRegistry()
         #: backoff policy for taxonomy-retryable failures (resilience/retry.py)
@@ -65,6 +67,15 @@ class ServingRuntime:
         self.admission = AdmissionController(
             bounds or {"interactive": 32, "batch": 64}, self.workers,
             retry_after_s=retry_after_s, metrics=self.metrics)
+        from ..families.batcher import FamilyBatcher
+
+        #: family batcher (families/batcher.py): concurrently admitted
+        #: same-family queries coalesce into one stacked kernel launch.
+        #: The busy probe gates the leader's rendezvous window on OTHER
+        #: queries actually being in flight, so idle traffic pays nothing.
+        self.batcher = FamilyBatcher(
+            max_queries=batch_queries, window_ms=batch_window_ms,
+            metrics=self.metrics, busy=self._others_in_flight)
         # 0 is a legitimate setting (pause batch entirely), so only None
         # falls back to the workers-1 default
         self.batch_max_running = int(batch_max_running) \
@@ -103,7 +114,21 @@ class ServingRuntime:
             default_deadline_s=config.get("serving.deadline_s"),
             metrics=metrics,
             retry_policy=BackoffPolicy.from_config(config),
+            batch_queries=int(config.get("serving.batch.max_queries", 8) or 1),
+            batch_window_ms=float(
+                config.get("serving.batch.window_ms", 2.0) or 0.0),
         )
+
+    def _others_in_flight(self) -> bool:
+        """True when any OTHER query is admitted right now (running on a
+        worker or still waiting in a class queue) — the only situation
+        where a batch leader's rendezvous window can pay off.  Waiting
+        queries count: a burst submits faster than workers wake, so an
+        early leader would otherwise see running == 1 and skip the window
+        its own batch-mates are about to fill."""
+        with self.admission._lock:
+            return (sum(self.admission.running.values())
+                    + sum(self.admission.waiting.values())) > 1
 
     # -------------------------------------------------------------- submit
     def submit(self, fn: Callable[[QueryTicket], object],
@@ -276,4 +301,5 @@ class ServingRuntime:
             "batchMaxRunning": self.batch_max_running,
             "queues": {c: len(self._queues[c]) for c in CLASSES},
             "admission": adm,
+            "familyBatcher": self.batcher.snapshot(),
         }

@@ -18,9 +18,9 @@ def spy(monkeypatch):
     hits = []
     orig = cj.CompiledJoinAggregate.run
 
-    def wrapper(self):
+    def wrapper(self, params=()):
         hits.append(self)
-        return orig(self)
+        return orig(self, params)
 
     monkeypatch.setattr(cj.CompiledJoinAggregate, "run", wrapper)
     return hits

@@ -68,8 +68,8 @@ def test_limit_without_sort_caps_transfer(c, big):
     pulled = {}
     orig = CS.CompiledSelect.run
 
-    def spy(self, table=None):
-        out = orig(self, table)
+    def spy(self, table=None, params=()):
+        out = orig(self, table, params)
         pulled["rows"] = out.num_rows
         return out
 

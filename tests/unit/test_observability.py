@@ -225,7 +225,8 @@ def test_show_profiles_statement_both_parsers():
         config_module.config.update({"sql.native.binder": native})
         try:
             df = c.sql("SHOW PROFILES", return_futures=False)
-            assert list(df.columns) == ["Fingerprint", "Metric", "Value"]
+            assert list(df.columns) == ["Fingerprint", "Family", "Metric",
+                                        "Value"]
             metrics = set(df["Metric"])
             assert {"sql", "hits", "exec_ms.p50"} <= metrics
         finally:
@@ -416,8 +417,9 @@ def test_compile_metrics_survive_tracing_disabled():
         snap = c.metrics.snapshot()
         assert "resilience.compile_ms.compiled_select" in snap["histograms"]
         rows = c.profiles.rows()
-        assert any(m == "compile.compiled_select.count" for _, m, _ in rows)
-        assert any(m == "hits" for _, m, _ in rows)
+        assert any(m == "compile.compiled_select.count"
+                   for _, _, m, _ in rows)
+        assert any(m == "hits" for _, _, m, _ in rows)
     finally:
         config_module.config.update({"observability.trace.enabled": True})
 
