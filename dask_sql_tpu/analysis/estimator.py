@@ -231,6 +231,9 @@ class _Estimator:
             = []  # (node, packed-matrix lower bound)
         self._memo: Dict[int, Tuple[Interval, Interval]] = {}
         self._scan_lo: Dict[Tuple[str, str], int] = {}
+        #: id(TableScan) -> exact resident bytes when the scanned table is
+        #: registered with compressed encodings (columnar/encodings.py)
+        self._scan_actual: Dict[int, int] = {}
 
     # ------------------------------------------------------------- walking
     def estimate(self, node: p.LogicalPlan) -> Tuple[Interval, Interval]:
@@ -252,7 +255,15 @@ class _Estimator:
             # subquery plans execute too: their outputs join the footprint
             self.estimate(sub)
         rows = self._rows(node, [r for r, _ in child])
-        out_bytes = _table_bytes(list(node.schema), rows)
+        actual = self._scan_actual.get(id(node))
+        if actual is not None:
+            # a registered table with compressed encodings: the scan's
+            # output IS the stored buffers, whose bytes are exact — both
+            # bounds tighten to the encoded widths, which is how encodings
+            # shrink peak_bytes.hi and admit bigger working sets
+            out_bytes = Interval(actual, actual)
+        else:
+            out_bytes = _table_bytes(list(node.schema), rows)
         scratch_hi: Optional[int] = 0
         if isinstance(node, p.Aggregate):
             scratch_hi = self._aggregate_scratch(node, child)
@@ -272,11 +283,17 @@ class _Estimator:
                 return Interval.unknown()
             # the base table is HBM-resident at its FULL row count whatever
             # the scan's pushed filters keep — its projected columns are a
-            # provable part of peak device bytes
+            # provable part of peak device bytes.  When the stored table
+            # carries compressed encodings, its ACTUAL (encoded) bytes are
+            # both the provable floor and the exact output size.
             key = (node.schema_name, node.table_name)
-            self._scan_lo[key] = max(
-                self._scan_lo.get(key, 0),
-                int(n) * _row_bytes(list(node.schema))[0])
+            actual = self._scan_actual_bytes(node)
+            if actual is not None:
+                self._scan_actual[id(node)] = actual
+                scan_lo = actual
+            else:
+                scan_lo = int(n) * _row_bytes(list(node.schema))[0]
+            self._scan_lo[key] = max(self._scan_lo.get(key, 0), scan_lo)
             rows = Interval.exact(int(n))
             if node.filters:
                 rows = rows.drop_lo()  # pushed-down filters: selectivity 0..1
@@ -355,6 +372,24 @@ class _Estimator:
         if isinstance(node, p.CustomNode):
             return Interval(0, None)
         return child_rows[0] if child_rows else Interval.unknown()
+
+    def _scan_actual_bytes(self, node: p.TableScan) -> Optional[int]:
+        """Exact resident bytes of the scan's projected columns when the
+        registered table carries compressed encodings; None keeps the
+        declared-width formula (byte-identical estimates for PLAIN tables).
+        Encoded widths are what the compiled paths actually read, so both
+        peak bounds tighten — the admission gate sheds less and the
+        device-budget rung proofs skip fewer rungs."""
+        from ..columnar.encodings import encoded_nbytes, resolve_encoded_scan
+
+        got = resolve_encoded_scan(self.context, node)
+        if got is None:
+            return None
+        table, names = got
+        total = sum(encoded_nbytes(table.columns[n]) for n in names)
+        if table.row_valid is not None:
+            total += int(table.row_valid.nbytes)
+        return total
 
     # --------------------------------------------------------- intermediates
     def _aggregate_scratch(self, node: p.Aggregate,

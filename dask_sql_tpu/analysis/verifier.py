@@ -307,6 +307,8 @@ class _Verifier:
         if self.collect_info and rows is not None:
             self.add("shape-bucket", SEV_INFO, node,
                      f"rows={rows} bucket={_pow2_bucket(rows)}")
+        if self.collect_info:
+            self._scan_encoding_info(node)
         if fields is None:
             return rows
         by_name = {f.name: f for f in fields}
@@ -334,6 +336,31 @@ class _Verifier:
             self._require_boolean(node, f, "pushed-down filter")
             self._expr_type(f, node.schema, node)
         return rows
+
+    def _scan_encoding_info(self, node: p.TableScan) -> None:
+        """ENCODING advisory per scan (the EXPLAIN LINT encoding column):
+        which compressed encoding each projected column is stored under and
+        the encoded-vs-decoded byte ratio — only when anything is actually
+        encoded, so PLAIN catalogs lint unchanged."""
+        from ..columnar.encodings import (Encoding, resolve_encoded_scan,
+                                          scan_bytes)
+
+        got = resolve_encoded_scan(self.context, node)
+        if got is None:
+            return
+        table, names = got
+        parts = []
+        for n in names:
+            c = table.columns[n]
+            tag = c.encoding.value
+            if c.encoding is Encoding.DICT:
+                tag += f"({len(c.enc_values)})"
+            parts.append(f"{n}={tag}")
+        enc_b, dec_b = scan_bytes(table, names)
+        ratio = enc_b / dec_b if dec_b else 1.0
+        self.add("encoding", SEV_INFO, node,
+                 " ".join(parts) + f"; encoded={enc_b}B decoded={dec_b}B "
+                 f"ratio={ratio:.2f}")
 
     def _check_projection(self, node: p.Projection) -> None:
         if len(node.exprs) != len(node.schema):

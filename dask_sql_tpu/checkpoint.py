@@ -66,6 +66,10 @@ def _write_table(table, path: str) -> list:
 
     arrays, names, specs = [], [], []
     for name, col in table.columns.items():
+        # snapshots stay portable/plain: compressed columns (DICT/FOR/RLE)
+        # decode at write and re-encode at restore (load_state is a load
+        # boundary like registration)
+        col = col.decode()
         if col.sql_type in STRING_TYPES:
             arrays.append(pa.array(col.to_numpy(), type=pa.string()))
             specs.append({"name": name, "sql_type": col.sql_type.value,
@@ -106,8 +110,14 @@ def _read_table(path: str, specs: list, num_rows: int):
             fill = False if pa.types.is_boolean(arr.type) else 0
             vals = arr.fill_null(fill).to_numpy(
                 zero_copy_only=False).astype(dt)
-            validity = None if not nulls.any() else jnp.asarray(~nulls)
-            col = Column(jnp.asarray(vals), sql_type, validity)
+            valid = None if not nulls.any() else ~nulls
+            from .columnar.encodings import maybe_encode, should_auto_encode
+
+            col = maybe_encode(vals, valid, sql_type) \
+                if should_auto_encode() else None
+            if col is None:
+                validity = None if valid is None else jnp.asarray(valid)
+                col = Column(jnp.asarray(vals), sql_type, validity)
         cols[name] = col
     return Table(cols, num_rows)
 
@@ -226,8 +236,11 @@ def load_state(context: "Context", location: str) -> dict:
                 context.create_table(tname, spec["path"],
                                      schema_name=schema_name)
             else:
-                table = _read_table(os.path.join(snap_dir, spec["file"]),
-                                    spec["columns"], spec["num_rows"])
+                from .columnar.encodings import load_scope
+
+                with load_scope():  # restore = load boundary: re-encode
+                    table = _read_table(os.path.join(snap_dir, spec["file"]),
+                                        spec["columns"], spec["num_rows"])
                 context.schema[schema_name].tables[tname] = DataContainer(table)
                 context._views.get(schema_name, {}).pop(tname, None)
         for m in entry["models"]:

@@ -1,9 +1,11 @@
 from .column import Column
 from .dtypes import SqlType, np_to_sql, parse_sql_type, promote, python_to_sql_type, similar_type, sql_to_np
+from .encodings import Encoding
 from .table import Table
 
 __all__ = [
     "Column",
+    "Encoding",
     "Table",
     "SqlType",
     "np_to_sql",

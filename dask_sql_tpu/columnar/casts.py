@@ -23,6 +23,9 @@ def cast_column(col: Column, target: SqlType) -> Column:
     src = col.sql_type
     if src == target:
         return col
+    col = _cast_encoded(col, target)
+    if col.sql_type == target:
+        return col
     # string -> anything: decode on host (dictionary is small)
     if src in STRING_TYPES:
         if target in STRING_TYPES:
@@ -61,6 +64,31 @@ def cast_column(col: Column, target: SqlType) -> Column:
             np.array([""], dtype=object) if target in STRING_TYPES else None,
         )
     raise NotImplementedError(f"cast {src} -> {target}")
+
+
+def _cast_encoded(col: Column, target: SqlType) -> Column:
+    """Casts over compressed columns (columnar/encodings.py).
+
+    DICT fast path: cast the (tiny, host-side) value array through the
+    normal cast rules and keep the codes untouched — the cast never touches
+    the row-sized device buffer.  Sound only while the casted values stay
+    STRICTLY increasing (code-space predicates rely on sorted uniqueness);
+    a collapsing cast (e.g. float -> int truncation merging 1.2 and 1.8)
+    decodes first.  FOR/RLE and every other shape decode first too."""
+    from dataclasses import replace
+    from .encodings import Encoding
+
+    if col.encoding is Encoding.PLAIN:
+        return col
+    if col.encoding is Encoding.DICT and target not in STRING_TYPES \
+            and col.sql_type not in STRING_TYPES:
+        casted = cast_column(
+            Column(jnp.asarray(col.enc_values), col.sql_type, None), target)
+        if casted.dictionary is None and casted.validity is None:
+            vals = np.asarray(casted.data)
+            if len(vals) <= 1 or bool(np.all(vals[1:] > vals[:-1])):
+                return replace(col, sql_type=target, enc_values=vals)
+    return col.decode()
 
 
 def _cast_from_string(col: Column, target: SqlType) -> Column:

@@ -11,6 +11,11 @@ Role parity: a single pandas Series inside a dask partition (reference
   runs on the MXU/VPU as integer ops; only regex-ish ops (LIKE) touch the host
   dictionary (which is tiny compared to the data).
 - datetimes are int64 nanoseconds since epoch.
+- numeric/datetime columns may additionally carry a compressed ``encoding``
+  (DICT / FOR / RLE, columnar/encodings.py): the device buffer then holds
+  codes (or run values) and ``enc_*`` metadata describes the mapping.
+  Encoding-aware consumers (the compiled pipelines, the estimator, host
+  decode) operate on the codes; everyone else calls ``decode()`` first.
 """
 from __future__ import annotations
 
@@ -28,32 +33,61 @@ from .dtypes import (
     np_to_sql,
     sql_to_np,
 )
+from .encodings import Encoding
 
 _NS_PER_DAY = 86_400_000_000_000
 
 
 @dataclass(frozen=True)
 class Column:
-    data: jnp.ndarray  # 1-D device buffer
+    data: jnp.ndarray  # 1-D device buffer (values, or codes when encoded)
     sql_type: SqlType
     validity: Optional[jnp.ndarray] = None  # bool, True = valid; None = all-valid
     dictionary: Optional[np.ndarray] = None  # host uniques for STRING_TYPES
+    #: physical encoding of `data` (columnar/encodings.py); PLAIN = dense
+    encoding: Encoding = Encoding.PLAIN
+    #: DICT: host-side SORTED unique values in the device representation
+    enc_values: Optional[np.ndarray] = None
+    #: FOR: value = code * enc_scale + enc_ref
+    enc_ref: int = 0
+    enc_scale: int = 1
+    #: RLE: int32 run lengths (device) + the logical row count; `data` holds
+    #: the run values and `validity` is per-RUN for RLE columns
+    enc_lengths: Optional[jnp.ndarray] = None
+    enc_rows: Optional[int] = None
 
     # -- construction -------------------------------------------------------
     @staticmethod
-    def from_numpy(arr: np.ndarray, mask: Optional[np.ndarray] = None) -> "Column":
-        """Build a Column from a host numpy array (+ optional validity mask)."""
+    def from_numpy(arr: np.ndarray, mask: Optional[np.ndarray] = None,
+                   encode: Optional[bool] = None) -> "Column":
+        """Build a Column from a host numpy array (+ optional validity mask).
+
+        ``encode`` controls load-time compression (columnar/encodings.py):
+        None consults the registration load-scope + ``columnar.encoding``
+        config (so only table ingest auto-encodes), True forces the
+        heuristics to run, False never encodes.  When an encoding is
+        selected the dense buffer is never uploaded at all."""
+        from . import encodings
+
+        def finish(vals, msk, sql_type):
+            if encode is not False:
+                col = encodings.maybe_encode(vals, msk, sql_type,
+                                             force=bool(encode))
+                if col is not None:
+                    return col
+            return Column(jnp.asarray(vals), sql_type, _dev_mask(msk))
+
         kind = arr.dtype.kind
         if kind == "M":  # datetime64 -> ns int64
             ns = arr.astype("datetime64[ns]").view("int64")
             nat = ns == np.iinfo(np.int64).min
             mask = _merge_mask(mask, ~nat)
-            return Column(jnp.asarray(ns), SqlType.TIMESTAMP, _dev_mask(mask))
+            return finish(ns, mask, SqlType.TIMESTAMP)
         if kind == "m":  # timedelta64 -> ns int64
             ns = arr.astype("timedelta64[ns]").view("int64")
             nat = ns == np.iinfo(np.int64).min
             mask = _merge_mask(mask, ~nat)
-            return Column(jnp.asarray(ns), SqlType.INTERVAL_DAY_TIME, _dev_mask(mask))
+            return finish(ns, mask, SqlType.INTERVAL_DAY_TIME)
         if kind in ("O", "U", "S"):
             return Column._encode_strings(arr, mask)
         if kind == "f":
@@ -61,7 +95,7 @@ class Column:
             if nan.any():
                 mask = _merge_mask(mask, ~nan)
         sql_type = np_to_sql(arr.dtype)
-        return Column(jnp.asarray(arr), sql_type, _dev_mask(mask))
+        return finish(arr, mask, sql_type)
 
     @staticmethod
     def _encode_strings(arr: np.ndarray, mask: Optional[np.ndarray]) -> "Column":
@@ -107,6 +141,8 @@ class Column:
 
     # -- basic properties ---------------------------------------------------
     def __len__(self) -> int:
+        if self.encoding is Encoding.RLE:
+            return int(self.enc_rows)
         return int(self.data.shape[0])
 
     @property
@@ -114,27 +150,55 @@ class Column:
         return self.validity is not None and not bool(jnp.all(self.validity))
 
     def valid_mask(self) -> jnp.ndarray:
-        """Always-materialized validity mask."""
+        """Always-materialized ROW-length validity mask."""
         if self.validity is None:
             return jnp.ones(len(self), dtype=bool)
+        if self.encoding is Encoding.RLE:  # per-run mask: expand to rows
+            return jnp.repeat(self.validity, self.enc_lengths,
+                              total_repeat_length=self.enc_rows)
         return self.validity
+
+    # -- encoding -----------------------------------------------------------
+    def decode(self) -> "Column":
+        """Materialize a compressed column as PLAIN (identity if already)."""
+        from . import encodings
+
+        return encodings.decode_column(self)
+
+    def device_nbytes(self) -> int:
+        """Resident bytes of this column as stored (encoded widths)."""
+        from . import encodings
+
+        return encodings.encoded_nbytes(self)
 
     # -- transformations ----------------------------------------------------
     def with_data(self, data: jnp.ndarray, sql_type: Optional[SqlType] = None) -> "Column":
-        return replace(self, data=data, sql_type=sql_type or self.sql_type)
+        # replaced data is computed VALUES: any code-space encoding no
+        # longer describes it
+        return replace(self, data=data, sql_type=sql_type or self.sql_type,
+                       encoding=Encoding.PLAIN, enc_values=None, enc_ref=0,
+                       enc_scale=1, enc_lengths=None, enc_rows=None)
 
     def take(self, indices: jnp.ndarray) -> "Column":
-        """Row gather (join/materialize/sort primitive)."""
+        """Row gather (join/materialize/sort primitive).  DICT/FOR codes
+        gather like values (the encoding survives); RLE is run-aligned, so
+        positional access decodes first."""
+        if self.encoding is Encoding.RLE:
+            return self.decode().take(indices)
         validity = None if self.validity is None else self.validity[indices]
         return replace(self, data=self.data[indices], validity=validity)
 
     def filter(self, mask) -> "Column":
         """Keep rows where mask is True (eager, data-dependent shape)."""
+        if self.encoding is Encoding.RLE:
+            return self.decode().filter(mask)
         mask = jnp.asarray(mask)
         validity = None if self.validity is None else self.validity[mask]
         return replace(self, data=self.data[mask], validity=validity)
 
     def slice(self, start: int, stop: int) -> "Column":
+        if self.encoding is Encoding.RLE:
+            return self.decode().slice(start, stop)
         validity = None if self.validity is None else self.validity[start:stop]
         return replace(self, data=self.data[start:stop], validity=validity)
 
@@ -175,7 +239,13 @@ class Column:
         """Host decode of already-transferred buffers (mask = ~validity).
 
         Split from to_numpy so Table.to_pandas can pull every column in ONE
-        packed device transfer and decode here."""
+        packed device transfer and decode here.  Encoded columns transfer
+        their NARROW codes and late-materialize on the host — the d2h wire
+        moves encoded bytes."""
+        if self.encoding is not Encoding.PLAIN:
+            from .encodings import decode_host_buffers
+
+            data, mask = decode_host_buffers(self, data, mask)
         if self.sql_type in STRING_TYPES:
             codes = np.clip(data, 0, max(len(self.dictionary) - 1, 0))
             out = self.dictionary[codes].astype(object) if len(self.dictionary) else np.full(len(data), "", dtype=object)

@@ -13,6 +13,9 @@ from .table import Table
 
 def concat_columns(cols: Sequence[Column]) -> Column:
     """Concatenate columns, promoting types and merging string dictionaries."""
+    # compressed codes from different tables live in different code spaces;
+    # decode first (identity for PLAIN, strings keep their dictionaries)
+    cols = [c.decode() for c in cols]
     target = cols[0].sql_type
     for c in cols[1:]:
         target = promote(target, c.sql_type)

@@ -45,13 +45,13 @@ def _arrow_array_to_column(arr) -> Column:
         return _arrow_array_to_column(enc)
     if pa.types.is_timestamp(t):
         ns = np.asarray(arr.cast(pa.timestamp("ns")).fill_null(0)).astype("datetime64[ns]").view(np.int64)
-        return Column(jnp.asarray(ns), SqlType.TIMESTAMP, _mask(mask))
+        return _build(ns, mask, SqlType.TIMESTAMP)
     if pa.types.is_date(t):
         ns = np.asarray(arr.cast(pa.timestamp("ns")).fill_null(0)).astype("datetime64[ns]").view(np.int64)
-        return Column(jnp.asarray(ns), SqlType.DATE, _mask(mask))
+        return _build(ns, mask, SqlType.DATE)
     if pa.types.is_decimal(t):
         vals = np.asarray(arr.cast(pa.float64()).fill_null(0.0))
-        return Column(jnp.asarray(vals), SqlType.DECIMAL, _mask(mask))
+        return _build(vals, mask, SqlType.DECIMAL)
     if pa.types.is_boolean(t):
         vals = np.asarray(arr.fill_null(False))
         return Column(jnp.asarray(vals), SqlType.BOOLEAN, _mask(mask))
@@ -63,6 +63,17 @@ def _mask(mask):
     if mask is None or mask.all():
         return None
     return jnp.asarray(mask)
+
+
+def _build(vals, mask, sql_type) -> Column:
+    """Device column from an already-device-repr host array; the load scope
+    may pick a compressed encoding (columnar/encodings.py)."""
+    from .encodings import maybe_encode
+
+    col = maybe_encode(vals, mask, sql_type)
+    if col is not None:
+        return col
+    return Column(jnp.asarray(vals), sql_type, _mask(mask))
 
 
 def table_to_arrow(table: Table):

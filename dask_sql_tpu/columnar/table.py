@@ -64,7 +64,10 @@ class Table:
 
     # -- construction -------------------------------------------------------
     @staticmethod
-    def from_pandas(df) -> "Table":
+    def from_pandas(df, encode=None) -> "Table":
+        """``encode``: load-time compressed encodings (columnar/encodings.py)
+        — None consults the registration load-scope + config, True forces
+        the selection heuristics, False stays dense."""
         cols = {}
         for name in df.columns:
             ser = df[name]
@@ -78,7 +81,7 @@ class Table:
                 values = ser.astype(object).to_numpy()
             elif values.dtype.kind not in ("O", "U", "S", "M", "m", "f", "i", "u", "b"):
                 values = ser.astype(object).to_numpy()
-            cols[str(name)] = Column.from_numpy(values, mask)
+            cols[str(name)] = Column.from_numpy(values, mask, encode=encode)
         return Table(cols, len(df))
 
     @staticmethod
@@ -118,6 +121,22 @@ class Table:
     def rename(self, mapping: Dict[str, str]) -> "Table":
         return Table({mapping.get(n, n): c for n, c in self.columns.items()},
                      self._num_rows, self.row_valid)
+
+    def decode(self) -> "Table":
+        """Materialize every encoded column as PLAIN (eager-operator view).
+        Identity when nothing is encoded — the common case stays free."""
+        from .encodings import Encoding
+
+        if all(c.encoding is Encoding.PLAIN for c in self.columns.values()):
+            return self
+        return Table({n: c.decode() for n, c in self.columns.items()},
+                     self._num_rows, self.row_valid)
+
+    def has_encoded_columns(self) -> bool:
+        from .encodings import Encoding
+
+        return any(c.encoding is not Encoding.PLAIN
+                   for c in self.columns.values())
 
     def filter(self, mask) -> "Table":
         # one nonzero for the whole table, then integer gathers per column —

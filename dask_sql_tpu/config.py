@@ -50,6 +50,25 @@ DEFAULTS: Dict[str, Any] = {
     "sql.distributed.join": "auto",
     "sql.distributed.sort": "auto",  # range-partition sort over the mesh
     "sql.debug.validate_take": False,  # assert gather-index invariants (host sync per gather)
+    # Compressed column encodings (columnar/encodings.py, docs/columnar.md):
+    # load-time auto-selection of DICT / FOR / RLE storage for
+    # numeric/datetime columns at table registration.
+    #   "auto" pick the smallest encoding per column (heuristics in
+    #          encodings.maybe_encode); compiled pipelines then evaluate
+    #          predicates in code space and decode late
+    #   "off"  every column stays PLAIN (dense device buffers, pre-encoding
+    #          behavior, byte-identical results)
+    "columnar.encoding": "auto",
+    # columns shorter than this stay PLAIN: tiny tables gain nothing and
+    # the selection pass (host np.unique/gcd) isn't free
+    "columnar.encoding.min_rows": 1024,
+    # per-encoding toggles (all subject to the master switch above)
+    "columnar.encoding.dict": True,  # sorted-dictionary codes (int16/int32)
+    "columnar.encoding.for": True,  # frame-of-reference affine narrow ints
+    "columnar.encoding.rle": True,  # run-length (storage-at-rest only)
+    # DICT is only selected up to this cardinality (sorted host dictionary;
+    # beyond it the per-predicate searchsorted constants stop paying off)
+    "columnar.encoding.dict_max_card": 1 << 15,
     # Static plan verification (analysis/verifier.py, docs/analysis.md):
     #   "on"     cross-check every bound plan; error findings raise a
     #            taxonomy PlanError at bind time, doomed compiled rungs are

@@ -501,6 +501,21 @@ class Context:
         filepath = getattr(dc, "filepath", None)
         if filepath:
             self.schema[schema_name].filepaths[table_name] = filepath
+        # LazyParquetContainer.table is a LOADING property — peeking it here
+        # would defeat lazy registration; lazy scans are PLAIN anyway
+        table = None if isinstance(dc, LazyParquetContainer) \
+            else getattr(dc, "table", None)
+        if table is not None and table.has_encoded_columns():
+            # compressed-encoding accounting (columnar/encodings.py):
+            # encoded vs would-be-dense resident bytes of this registration
+            from .columnar.encodings import Encoding, scan_bytes
+
+            n_enc = sum(1 for c in table.columns.values()
+                        if c.encoding is not Encoding.PLAIN)
+            enc_b, dec_b = scan_bytes(table)
+            self.metrics.inc("columnar.encoding.encoded_columns", n_enc)
+            self.metrics.observe("columnar.encoding.encoded_bytes", enc_b)
+            self.metrics.observe("columnar.encoding.decoded_bytes", dec_b)
         if self._views.setdefault(schema_name, {}).pop(table_name, None) is not None:
             self._catalog_serial += 1
         self._on_catalog_change()

@@ -44,8 +44,14 @@ class InputUtil:
                 # declining (or crashing) just means "not my input type"
                 matches = False
             if matches:
-                dc = plugin.to_dc(input_item, table_name, format=format,
-                                  persist=persist, **kwargs)
+                from ..columnar import encodings
+
+                # registration is THE load boundary: host->device column
+                # conversions inside the plugin may pick a compressed
+                # encoding (columnar/encodings.py) per `columnar.encoding`
+                with encodings.load_scope():
+                    dc = plugin.to_dc(input_item, table_name, format=format,
+                                      persist=persist, **kwargs)
                 dc.filepath = filepath  # plan-time pruning hook (DaskTable.filepath parity)
                 return dc
         raise ValueError(f"Do not understand the input type {type(input_item)}")

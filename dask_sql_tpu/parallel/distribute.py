@@ -44,11 +44,19 @@ def shard_table(table: Table, mesh=None) -> Table:
         padded, _ = pad_to_multiple(arr, ndev)
         return make_global_array(padded, sharding)
 
+    from dataclasses import replace as _replace
+
+    from ..columnar.encodings import Encoding
+
     cols = {}
     for name, col in table.columns.items():
+        if col.encoding is Encoding.RLE:
+            # RLE runs are not row-partitionable; DICT/FOR codes shard like
+            # values (their host metadata replicates implicitly)
+            col = col.decode()
         data = place(col.data)
         validity = None if col.validity is None else place(col.validity)
-        cols[name] = Column(data, col.sql_type, validity, col.dictionary)
+        cols[name] = _replace(col, data=data, validity=validity)
     row_valid = None
     if target != n:
         mask = jnp.concatenate([jnp.ones(n, dtype=bool),

@@ -35,6 +35,7 @@ from ..columnar.dtypes import (
     SqlType,
     sql_to_np,
 )
+from ..columnar.encodings import FLIP_CMP, Encoding, dict_literal_bounds
 from ..columnar.table import Table
 from ..ops import datetime as dt_ops
 from ..ops import strings as str_ops
@@ -97,6 +98,48 @@ def padded_int_bounds(data, row_valid):
         return jnp.min(data), jnp.max(data)
     safe = jnp.where(row_valid, data, data[0])
     return jnp.min(safe), jnp.max(safe)
+
+
+def check_no_rle(table) -> None:
+    """RLE columns are run-aligned (storage-at-rest); the row-positional
+    compiled pipelines decline them so the eager path decodes once at scan.
+    Shared eligibility guard — raises _Unsupported."""
+    for c in table.columns.values():
+        if getattr(c, "encoding", Encoding.PLAIN) is Encoding.RLE:
+            raise _Unsupported("rle-encoded column in compiled pipeline")
+
+
+def count_codespace_predicates(exprs, table) -> int:
+    """Static count of predicates a pipeline over `table` evaluates in CODE
+    space (comparison/IN against a raw DICT-column ref): the
+    ``columnar.encoding.codespace_pred`` accounting, computed from the plan
+    so the metric is trace-independent."""
+    ev = _TraceEval(table)
+    n = 0
+    for e in exprs:
+        if e is None:
+            continue
+        for sub in walk(e):
+            if isinstance(sub, ScalarFunc) and sub.op in (
+                    "eq", "ne", "lt", "le", "gt", "ge") \
+                    and len(sub.args) == 2:
+                a, b = sub.args
+                for colarg, litarg in ((a, b), (b, a)):
+                    try:
+                        c = ev._dict_source(colarg)
+                    except (IndexError, KeyError):
+                        c = None
+                    if c is not None and isinstance(litarg,
+                                                    (Literal, ParamRef)):
+                        n += 1
+                        break
+            elif isinstance(sub, (InListExpr, InArrayExpr)):
+                try:
+                    if ev._dict_source(sub.arg) is not None:
+                        n += 1
+                except (IndexError, KeyError):
+                    pass
+    return n
 
 
 def check_agg_static_support(agg_exprs):
@@ -389,21 +432,50 @@ def segment_agg_outputs(ev, slots, agg_exprs, sel, gid, domain, reducer):
     return outs
 
 
+def decode_radix_group_key(col, code: np.ndarray, off,
+                           validity) -> Column:
+    """Host decode of one radix group-key column (shared by the scan- and
+    join-aggregate pipelines): `code` is the extracted radix digit (already
+    clamped below the NULL slot), `col` the _ColMeta of the key source.
+    Encoded keys map codes back through their dictionary / affine."""
+    if col.sql_type in STRING_TYPES:
+        return Column(code.astype(np.int32), col.sql_type, validity,
+                      col.dictionary)
+    enc = getattr(col, "encoding", Encoding.PLAIN)
+    if enc is Encoding.DICT:
+        vals = col.enc_values[np.minimum(code, len(col.enc_values) - 1)]
+        return Column(vals, col.sql_type, validity)
+    if col.data.dtype == np.bool_:
+        return Column(code == 1, col.sql_type, validity)
+    raw = code + off
+    if enc is Encoding.FOR:
+        vals = (raw.astype(np.int64) * col.enc_scale + col.enc_ref).astype(
+            sql_to_np(col.sql_type))
+        return Column(vals, col.sql_type, validity)
+    return Column(raw.astype(col.data.dtype), col.sql_type, validity)
+
+
 class _ColMeta:
     """Trace-time stand-in for a Column: metadata + dictionary only.
 
     The jitted kernel's closure holds its _TraceEval forever; giving it the
     real Columns would pin every input table's device buffers for the cache
     entry's lifetime (ADVICE r2).  Only the dtype (as an empty host array),
-    the SQL type and the (host, numpy) string dictionary are retained."""
+    the SQL type, the (host, numpy) string dictionary and the compressed-
+    encoding metadata (host-side) are retained."""
 
-    __slots__ = ("sql_type", "dictionary", "data", "_len")
+    __slots__ = ("sql_type", "dictionary", "data", "_len", "encoding",
+                 "enc_values", "enc_ref", "enc_scale")
 
     def __init__(self, col):
         self.sql_type = col.sql_type
         self.dictionary = col.dictionary
         self.data = np.empty(0, dtype=np.dtype(col.data.dtype))
         self._len = col.data.shape[0]
+        self.encoding = getattr(col, "encoding", Encoding.PLAIN)
+        self.enc_values = getattr(col, "enc_values", None)
+        self.enc_ref = getattr(col, "enc_ref", 0)
+        self.enc_scale = getattr(col, "enc_scale", 1)
 
     def __len__(self):
         return self._len
@@ -438,7 +510,7 @@ class _TraceEval:
 
     def eval(self, expr: Expr, slots):
         if isinstance(expr, ColumnRef) and type(expr) is ColumnRef:
-            return slots[expr.index]
+            return self._decode_slot(expr.index, slots)
         if isinstance(expr, ParamRef):
             # runtime query parameter (families/parameterize.py): a traced
             # scalar argument instead of a baked constant, so one compiled
@@ -493,6 +565,98 @@ class _TraceEval:
             return self._call(expr, slots)
         raise _Unsupported(f"expr {type(expr).__name__}")
 
+    # -- compressed-domain column access ------------------------------------
+    def _decode_slot(self, index: int, slots):
+        """Slot value as VALUES: DICT gathers through the (tiny, constant)
+        value LUT, FOR applies its fused affine — either way the HBM read
+        was the narrow code array; the decode lives in registers.  PLAIN
+        (and string codes, whose dictionary IS the representation) pass
+        through untouched."""
+        d, v = slots[index]
+        c = self.col(index)
+        enc = getattr(c, "encoding", Encoding.PLAIN)
+        if enc is Encoding.DICT and c.sql_type not in STRING_TYPES:
+            lut = jnp.asarray(c.enc_values)
+            d = lut[jnp.clip(d, 0, len(c.enc_values) - 1)]
+        elif enc is Encoding.FOR:
+            d = d.astype(sql_to_np(c.sql_type))
+            if c.enc_scale != 1:
+                d = d * c.enc_scale
+            if c.enc_ref:
+                d = d + jnp.asarray(c.enc_ref, dtype=d.dtype)
+        return (d, v)
+
+    def _dict_source(self, expr: Expr):
+        """The column meta when `expr` is a raw ref to a numeric
+        DICT-encoded column (the code-space predicate target)."""
+        if isinstance(expr, ColumnRef) and type(expr) is ColumnRef:
+            c = self.col(expr.index)
+            if getattr(c, "encoding", Encoding.PLAIN) is Encoding.DICT \
+                    and c.sql_type not in STRING_TYPES:
+                return c
+        return None
+
+    def _encoded_compare(self, op: str, args, slots):
+        """``dict_col CMP literal/param`` rewritten into CODE space.
+
+        The dictionary is sorted, so order predicates translate through a
+        searchsorted boundary — host-side for literals (a static int enters
+        the program), in-kernel over the (tiny) value-constant for runtime
+        params, which keeps ONE executable per plan family.  Returns None
+        when the shape doesn't match (caller evaluates in value space)."""
+        a, b = args
+        for colarg, litarg, o in ((a, b, op), (b, a, FLIP_CMP[op])):
+            c = self._dict_source(colarg)
+            if c is None:
+                continue
+            codes, valid = slots[colarg.index]
+            vals = c.enc_values
+            if isinstance(litarg, Literal) and not isinstance(
+                    litarg.value, bool) and isinstance(
+                    litarg.value, (int, float, np.integer, np.floating)):
+                kind, code = dict_literal_bounds(vals, o, litarg.value)
+                if kind == "lt":
+                    hit = codes < code
+                elif kind == "ge":
+                    hit = codes >= code
+                elif kind == "eq":
+                    hit = codes == code
+                elif kind == "ne":
+                    hit = codes != code
+                elif kind == "all":
+                    hit = jnp.ones(codes.shape, dtype=bool)
+                else:  # "none"
+                    hit = jnp.zeros(codes.shape, dtype=bool)
+                return (hit, valid)
+            if isinstance(litarg, ParamRef):
+                vj = jnp.asarray(vals)
+                p = slots[PARAMS_SLOT][litarg.index]
+                if np.dtype(vj.dtype).kind != np.dtype(p.dtype).kind:
+                    # cross-kind literal (float vs int dictionary): compare
+                    # in f64 — exact for every dictionary this path serves
+                    vj = vj.astype(jnp.float64)
+                    p = p.astype(jnp.float64)
+                left = jnp.searchsorted(vj, p, side="left")
+                if o in ("lt", "ge"):
+                    bound = left
+                else:
+                    bound = jnp.searchsorted(vj, p, side="right")
+                if o == "lt":
+                    hit = codes < bound
+                elif o == "le":
+                    hit = codes < bound
+                elif o == "gt":
+                    hit = codes >= bound
+                elif o == "ge":
+                    hit = codes >= bound
+                else:  # eq / ne: exact-member test
+                    present = (left < len(vals)) & \
+                        (vj[jnp.clip(left, 0, len(vals) - 1)] == p)
+                    eq = present & (codes == left)
+                    hit = eq if o == "eq" else ~eq
+                return (hit, valid)
+        return None
+
     # -- compile-time string handling --------------------------------------
     def _string_source(self, expr: Expr) -> Optional[Column]:
         if isinstance(expr, ColumnRef) and type(expr) is ColumnRef:
@@ -500,6 +664,29 @@ class _TraceEval:
             if c.sql_type in STRING_TYPES:
                 return c
         return None
+
+    def _dict_membership(self, expr, slots, values):
+        """IN over a numeric DICT column: map the value list through the
+        sorted dictionary on the host (absent values drop out) and test
+        CODE membership on device."""
+        c = self._dict_source(expr.arg)
+        if c is None:
+            return None
+        code_list = []
+        for v in values:
+            if isinstance(v, bool) or not isinstance(
+                    v, (int, float, np.integer, np.floating)):
+                return None
+            i = int(np.searchsorted(c.enc_values, v))
+            if i < len(c.enc_values) and c.enc_values[i] == v:
+                code_list.append(i)
+        codes, valid = slots[expr.arg.index]
+        if code_list:
+            hit = sorted_membership(codes, np.asarray(code_list,
+                                                      dtype=np.int32))
+        else:
+            hit = jnp.zeros(codes.shape, dtype=bool)
+        return (~hit if expr.negated else hit, valid)
 
     def _in_list(self, expr: InListExpr, slots):
         src = self._string_source(expr.arg)
@@ -512,6 +699,12 @@ class _TraceEval:
             if expr.negated:
                 hit = ~hit
             return (hit, valid)
+        if all(isinstance(it, Literal) for it in expr.items):
+            got = self._dict_membership(
+                expr, slots, [it.value for it in expr.items
+                              if it.value is not None])
+            if got is not None:
+                return got
         ad, av = self.eval(expr.arg, slots)
         if not all(isinstance(it, Literal) for it in expr.items):
             raise _Unsupported("non-literal IN list")
@@ -546,6 +739,9 @@ class _TraceEval:
             codes, valid = slots[expr.arg.index]
             hit = dictionary_membership(codes, src.dictionary, expr.values)
             return (~hit if expr.negated else hit, valid)
+        got = self._dict_membership(expr, slots, list(np.asarray(expr.values)))
+        if got is not None:
+            return got
         ad, av = self.eval(expr.arg, slots)
         hit = sorted_membership(ad, expr.values)
         return (~hit if expr.negated else hit, av)
@@ -575,6 +771,12 @@ class _TraceEval:
                 if op == "ne":
                     hit = ~hit
                 return (hit, valid)
+
+        # numeric comparisons against DICT-encoded columns run in CODE space
+        if op in ("eq", "ne", "lt", "le", "gt", "ge") and len(args) == 2:
+            got = self._encoded_compare(op, args, slots)
+            if got is not None:
+                return got
 
         vals = [self.eval(a, slots) for a in args]
         if op in _NUMERIC_BINOPS:
@@ -775,6 +977,7 @@ class CompiledAggregate:
         offsets = []
         gcols: List[Column] = []
         pending = []  # (slot, device min, device max): ONE pull for all keys
+        check_no_rle(table)
         for e in group_exprs:
             if not (isinstance(e, ColumnRef) and type(e) is ColumnRef):
                 raise _Unsupported("non-column group key")
@@ -782,10 +985,17 @@ class CompiledAggregate:
             if c.sql_type in STRING_TYPES and c.dictionary is not None:
                 radices.append(len(c.dictionary) + 1)
                 offsets.append(0)
+            elif getattr(c, "encoding", Encoding.PLAIN) is Encoding.DICT:
+                # dictionary codes ARE the radix domain — no device min/max
+                # pull, no decode, and float/datetime keys become groupable
+                radices.append(len(c.enc_values) + 1)
+                offsets.append(0)
             elif c.data.dtype == jnp.bool_:
                 radices.append(3)
                 offsets.append(0)
             elif jnp.issubdtype(c.data.dtype, jnp.integer) and len(c):
+                # PLAIN ints and FOR codes alike: codes are ints; FOR keys
+                # decode through their affine only at host group decode
                 lo, hi = padded_int_bounds(c.data, table.row_valid)
                 pending.append((len(radices), lo, hi))
                 radices.append(None)
@@ -817,6 +1027,15 @@ class CompiledAggregate:
             from ..ops.pallas_kernels import choose_segsum_impl
 
             self.segsum_mode = choose_segsum_impl(config, self.domain)
+        #: compressed-domain accounting (columnar.encoding.* metrics)
+        self.has_encoded = any(
+            getattr(c, "encoding", Encoding.PLAIN) is not Encoding.PLAIN
+            for c in table.columns.values())
+        self.codespace_preds = count_codespace_predicates(
+            list(filters) + [x for a in agg_exprs
+                             for x in list(a.args)
+                             + ([a.filter] if a.filter is not None else [])],
+            table) if self.has_encoded else 0
         #: (kind, np.dtype) per packed output row; rebound atomically each
         #: time a variant traces (solo and batched traces on concurrent
         #: threads produce identical tags — rebinding instead of clearing
@@ -994,14 +1213,7 @@ class CompiledAggregate:
             is_null = code == (r - 1)
             validity = ~is_null if bool(is_null.any()) else None
             code = np.minimum(code, r - 2)
-            if col.sql_type in STRING_TYPES:
-                out[name] = Column(code.astype(np.int32), col.sql_type, validity,
-                                   col.dictionary)
-            elif col.data.dtype == np.bool_:
-                out[name] = Column(code == 1, col.sql_type, validity)
-            else:
-                out[name] = Column((code + off).astype(col.data.dtype),
-                                   col.sql_type, validity)
+            out[name] = decode_radix_group_key(col, code, off, validity)
         for i, (a, f) in enumerate(zip(self.agg_exprs,
                                        self.agg.schema[len(self.gcols):])):
             d = unpack(1 + 2 * i)
@@ -1163,16 +1375,24 @@ def try_compiled_aggregate(rel: p.Aggregate, executor) -> Optional[Table]:
 
             trace_event("family_hit", rung="compiled_aggregate",
                         params=len(params))
+        if built_here and compiled.codespace_preds:
+            ctx.metrics.inc("columnar.encoding.codespace_pred",
+                            compiled.codespace_preds)
         from ..resilience import faults
 
         faults.maybe_inject("oom", executor.config)
         batcher = families.batcher_of(ctx)
         if batcher is not None and params and compiled.batchable:
-            return batcher.run(
+            result = batcher.run(
                 ("compiled_aggregate",) + key, params,
                 solo=lambda: compiled.run(table, params),
                 batched=lambda members: compiled.run_batched(table, members))
-        return compiled.run(table, params)
+        else:
+            result = compiled.run(table, params)
+        if compiled.has_encoded:
+            # late materialization: only the group table's rows ever decode
+            ctx.metrics.inc("columnar.encoding.late_rows", result.num_rows)
+        return result
     except _Unsupported as e:
         logger.debug("compiled pipeline unsupported: %s", e)
         return None

@@ -44,12 +44,16 @@ from ..planner.expressions import (
     transform,
     walk,
 )
+from ..columnar.encodings import Encoding
 from .compiled import (
     PARAMS_SLOT,
     _ColMeta,
     _TraceEval,
     _Unsupported,
     check_agg_static_support,
+    check_no_rle,
+    count_codespace_predicates,
+    decode_radix_group_key,
     segment_agg_outputs,
 )
 
@@ -206,6 +210,11 @@ class CompiledJoinAggregate:
         self.build_tables = build_tables
 
         check_agg_static_support(agg_exprs)
+        check_no_rle(probe_table)
+        #: compressed-domain accounting: probe-side scans read encoded bytes
+        self.has_encoded = any(
+            getattr(c, "encoding", Encoding.PLAIN) is not Encoding.PLAIN
+            for c in probe_table.columns.values())
 
         choice = _choose_gid_join(ext, group_exprs)
         if choice is not None:
@@ -277,6 +286,11 @@ class CompiledJoinAggregate:
             meta_cols.append(_ColMeta(bt.columns[bt.column_names[col]]))
             meta_names.append(f"__b{k}_{col}")
         self._ev = _TraceEval(_SlotMeta(meta_cols, meta_names))
+        self.codespace_preds = count_codespace_predicates(
+            list(self.conjuncts)
+            + [x for a in self.agg_exprs for x in list(a.args)
+               + ([a.filter] if a.filter is not None else [])],
+            self._ev.table) if self.has_encoded else 0
         # segment-reduction strategy: one mode per pipeline, chosen from the
         # (static) group domain — radix product, or the gid build table's
         # row count for pointer gids
@@ -320,16 +334,27 @@ class CompiledJoinAggregate:
                 spec.append({"ref": g, "kind": "str",
                              "r": len(col.dictionary) + 1, "off": 0,
                              "col": col})
+            elif getattr(col, "encoding", Encoding.PLAIN) is Encoding.DICT:
+                # numeric dictionary codes are the radix domain directly
+                spec.append({"ref": g, "kind": "dict", "raw": True,
+                             "r": len(col.enc_values) + 1, "off": 0,
+                             "col": col})
             elif col.data.dtype == jnp.bool_:
                 spec.append({"ref": g, "kind": "bool", "r": 3, "off": 0,
                              "col": col})
             elif jnp.issubdtype(col.data.dtype, jnp.integer) and len(col):
                 from .compiled import padded_int_bounds
 
+                # PLAIN values and FOR codes alike: bounds are over the
+                # STORED ints (the kernel reads the raw slot for encoded
+                # keys; host decode maps codes back through the affine)
                 lo, hi = padded_int_bounds(col.data, row_valid)
                 pending.append((len(spec), lo, hi))
-                spec.append({"ref": g, "kind": "int", "r": None,
-                             "off": None, "col": col})
+                spec.append({
+                    "ref": g, "kind": "int", "r": None, "off": None,
+                    "col": col,
+                    "raw": getattr(col, "encoding",
+                                   Encoding.PLAIN) is Encoding.FOR})
             else:
                 raise _Unsupported("group key not radix-encodable")
         from ..ops.grouping import RADIX_DOMAIN_LIMIT, resolve_int_bounds
@@ -426,7 +451,12 @@ class CompiledJoinAggregate:
                 gid = jnp.zeros(n_rows, dtype=jnp.int32)
                 domain = 1
                 for s in radix_spec:
-                    d, v = ev.eval(s["ref"], slots)
+                    if s.get("raw"):
+                        # encoded key: the CODES are the radix digits —
+                        # never decode inside the kernel
+                        d, v = slots[s["ref"].index]
+                    else:
+                        d, v = ev.eval(s["ref"], slots)
                     r = s["r"]
                     if s["kind"] == "bool":
                         code = d.astype(jnp.int32)
@@ -527,16 +557,10 @@ class CompiledJoinAggregate:
                 is_null = code == (r - 1)
                 validity = ~is_null if bool(is_null.any()) else None
                 code = np.minimum(code, r - 2)
-                col = spec["col"]
-                if spec["kind"] == "str":
-                    out[name] = Column(code.astype(np.int32), col.sql_type,
-                                       validity, col.dictionary)
-                elif spec["kind"] == "bool":
-                    out[name] = Column(code == 1, col.sql_type, validity)
-                else:
-                    out[name] = Column(
-                        (code + spec["off"]).astype(np.dtype(col.data.dtype)),
-                        col.sql_type, validity)
+                # shared host decode handles str/bool/plain-int AND the
+                # encoded (DICT/FOR) key kinds
+                out[name] = decode_radix_group_key(spec["col"], code,
+                                                   spec["off"], validity)
             n_groups = len(self.radix_spec)
         elif self.gid_join is not None and self.gid_join >= 0:
             bt = self.build_tables[self.gid_join]
@@ -675,11 +699,18 @@ def try_compiled_join_aggregate(rel: p.Aggregate, executor) -> Optional[Table]:
 
                 trace_event("family_hit", rung="compiled_join_aggregate",
                             params=len(params))
+        if built_here and compiled.codespace_preds:
+            ctx.metrics.inc("columnar.encoding.codespace_pred",
+                            compiled.codespace_preds)
         try:
             from ..resilience import faults
 
             faults.maybe_inject("oom", executor.config)
-            return compiled.run(params)
+            result = compiled.run(params)
+            if compiled.has_encoded:
+                ctx.metrics.inc("columnar.encoding.late_rows",
+                                result.num_rows)
+            return result
         finally:
             # the LUTs/dictionaries stay warm; the (large) table refs do not
             compiled.probe_table = None

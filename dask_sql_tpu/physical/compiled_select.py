@@ -41,6 +41,8 @@ from .compiled import (
     _TableMeta,
     _TraceEval,
     _Unsupported,
+    check_no_rle,
+    count_codespace_predicates,
     pack_flat,
     singleflight_get_or_build,
 )
@@ -109,6 +111,16 @@ class CompiledSelect:
         # plain column refs (codes + dictionary pass through); sort keys must
         # be output positions over non-string columns (host lexsort order on
         # dictionary codes is only lexicographic for sorted dictionaries)
+        check_no_rle(table)
+        from ..columnar.encodings import Encoding
+
+        #: compressed-domain accounting: encoded inputs mean the mask phase
+        #: reads codes and the survivor gather late-materializes values
+        self.has_encoded = any(
+            c.encoding is not Encoding.PLAIN for c in table.columns.values())
+        self.codespace_preds = count_codespace_predicates(
+            list(upper_filters) + list(scan_filters) + list(proj_exprs),
+            table) if self.has_encoded else 0
         self.out_meta: List[Tuple[str, SqlType, Optional[object]]] = []
         for e, f in zip(proj_exprs, proj.schema):
             if f.sql_type in STRING_TYPES:
@@ -461,16 +473,25 @@ def try_compiled_select(root, executor) -> Optional[Table]:
 
             trace_event("family_hit", rung="compiled_select",
                         params=len(params))
+        if built_here and compiled.codespace_preds:
+            ctx.metrics.inc("columnar.encoding.codespace_pred",
+                            compiled.codespace_preds)
         from ..resilience import faults
 
         faults.maybe_inject("oom", executor.config)
         batcher = families.batcher_of(ctx)
         if batcher is not None and params:
-            return batcher.run(
+            result = batcher.run(
                 ("compiled_select",) + key, params,
                 solo=lambda: compiled.run(table, params),
                 batched=lambda members: compiled.run_batched(table, members))
-        return compiled.run(table, params)
+        else:
+            result = compiled.run(table, params)
+        if compiled.has_encoded:
+            # late materialization: only surviving rows decoded (in the
+            # per-bucket gather), and only at the root
+            ctx.metrics.inc("columnar.encoding.late_rows", result.num_rows)
+        return result
     except _Unsupported as e:
         logger.debug("compiled select unsupported: %s", e)
         return None

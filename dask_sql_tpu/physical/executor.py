@@ -43,6 +43,10 @@ class Executor:
         """Entry for the plan ROOT: the result goes straight to the host, so
         root select chains compile to one kernel + one packed transfer
         (physical/compiled_select.py) before the recursive converter runs.
+        Compressed-domain scans (columnar/encodings.py) late-materialize
+        here: the compiled paths keep DICT/FOR codes end-to-end and decode
+        only survivors at the root / d2h boundary, while the interpreted
+        walk below decodes once at its TableScan.
 
         Resilience (resilience/ladder.py): the compiled fast path is a
         degradation-ladder rung — a compile failure or device OOM inside it

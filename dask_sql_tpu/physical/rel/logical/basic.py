@@ -57,6 +57,12 @@ class TableScanPlugin(BaseRelPlugin):
             # eager operators index rows positionally: exact-length view
             # (padding-aware consumers bypass this plugin entirely)
             table = table.depad()
+        if table.has_encoded_columns():
+            # eager operators work in value space: compressed columns
+            # (columnar/encodings.py) materialize ONCE at the scan — the
+            # encoding-aware compiled pipelines never reach this plugin
+            executor.context.metrics.inc("columnar.encoding.decode")
+            table = table.decode()
         if rel.filters:
             # filters are bound against the *projected* schema
             mask = None

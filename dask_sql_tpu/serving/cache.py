@@ -25,19 +25,13 @@ from typing import Any, Dict, Hashable, Optional, Tuple
 
 def table_nbytes(table) -> int:
     """Estimated resident bytes of a columnar Table (device buffers +
-    validity masks + host dictionaries)."""
-    total = 0
-    for col in table.columns.values():
-        data = getattr(col, "data", None)
-        if data is not None:
-            total += int(getattr(data, "nbytes", 0) or 0)
-        validity = getattr(col, "validity", None)
-        if validity is not None:
-            total += int(getattr(validity, "nbytes", 0) or 0)
-        dictionary = getattr(col, "dictionary", None)
-        if dictionary is not None:
-            # host object array of uniques: nbytes only counts pointers
-            total += sum(len(str(v)) for v in dictionary) + dictionary.nbytes
+    validity masks + host dictionaries + compressed-encoding metadata).
+    Per-column accounting delegates to `encodings.encoded_nbytes` — the
+    one rule the estimator's scan bounds also use, so measured-vs-estimate
+    byte comparisons can never drift."""
+    from ..columnar.encodings import encoded_nbytes
+
+    total = sum(encoded_nbytes(col) for col in table.columns.values())
     if table.row_valid is not None:
         total += int(table.row_valid.nbytes)
     return total

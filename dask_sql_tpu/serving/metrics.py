@@ -37,6 +37,13 @@ DOCUMENTED_METRICS = frozenset({
     "analysis.estimate.rows_hi",
     "analysis.estimate.rung_proof",
     "analysis.estimate.internal_error",
+    # columnar/ — compressed column encodings (encodings.py, docs/columnar.md)
+    "columnar.encoding.encoded_columns",
+    "columnar.encoding.encoded_bytes",
+    "columnar.encoding.decoded_bytes",
+    "columnar.encoding.codespace_pred",
+    "columnar.encoding.late_rows",
+    "columnar.encoding.decode",
     # families/ — parameterized plan families + inter-query batching
     "families.parameterized",
     "families.hit",

@@ -97,19 +97,42 @@ def duckdb_query(conn, sql: str) -> pd.DataFrame:
     return conn.execute(sql).df()
 
 
+#: error substrings that mean the ORACLE ENGINE cannot run the query at
+#: all — a test-infrastructure capability gap, not an engine result diff.
+#: sqlite grew FULL/RIGHT OUTER JOIN only in 3.39 (2022-06); older images
+#: (this container ships 3.34) refuse the q51/q97 shapes outright, so the
+#: pre-PR-3 "q51/q97 sqlite-oracle diffs" were never engine bugs.
+ORACLE_CAPABILITY_ERRORS = (
+    "RIGHT and FULL OUTER JOINs are not currently supported",
+)
+
+
 def cross_check(got: pd.DataFrame, oracles, sql: str, qnum,
                 rtol: float = 1e-4, inf_is_null: bool = False):
     """Assert `got` matches EVERY available oracle; an engine result that
     satisfies one oracle but not another surfaces as a failure naming the
     disagreeing oracle (VERDICT r4 #7 dual-oracle mode).
 
+    An oracle that cannot PARSE/RUN the query (ORACLE_CAPABILITY_ERRORS)
+    drops out instead of failing; if no capable oracle remains the test
+    skips with the root cause — an xfail here would go stale the moment
+    the image ships a newer sqlite, and the engine result is simply
+    uncheckable, not wrong.
+
     `oracles` is a list of ("name", callable sql -> DataFrame) pairs."""
+    import sqlite3
+
     failures = []
+    incapable = []
     for name, run in oracles:
         try:
             expected = run(sql)
         except Exception as e:  # oracle itself failed: attribute, keep going
-            failures.append(f"[{name}] oracle errored: {type(e).__name__}: {e}")
+            msg = f"{type(e).__name__}: {e}"
+            if any(cap in msg for cap in ORACLE_CAPABILITY_ERRORS):
+                incapable.append(name)
+                continue
+            failures.append(f"[{name}] oracle errored: {msg}")
             continue
         try:
             assert_same_result(got, expected, qnum, rtol=rtol,
@@ -120,6 +143,14 @@ def cross_check(got: pd.DataFrame, oracles, sql: str, qnum,
         raise AssertionError(
             f"q{qnum}: engine result disagrees with "
             f"{len(failures)}/{len(oracles)} oracles:\n" + "\n".join(failures))
+    if incapable and len(incapable) == len(oracles):
+        import pytest
+
+        pytest.skip(
+            f"q{qnum}: no capable oracle — {', '.join(incapable)} cannot run "
+            f"this shape (sqlite {sqlite3.sqlite_version} predates FULL "
+            f"OUTER JOIN support, added in 3.39); engine executed fine but "
+            f"the result is uncheckable here")
 
 
 # ----------------------------------------------------------- translation

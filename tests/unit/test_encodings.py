@@ -1,0 +1,368 @@
+"""Compressed-domain column encodings (columnar/encodings.py, ISSUE 10).
+
+Covers: encode/decode round-trips per SqlType incl. NULL validity,
+auto-selection heuristics, code-space predicate equivalence vs decoded
+execution (property-style over random literals), plan-family
+zero-recompile over an encoded table, estimator interval shrinkage,
+casts over encoded columns, EXPLAIN LINT encoding advisories, and the
+eager-path decode fallback.
+"""
+import numpy as np
+import pandas as pd
+import pytest
+
+from dask_sql_tpu.columnar import Column, Encoding, Table
+from dask_sql_tpu.columnar import encodings
+
+pytestmark = pytest.mark.compressed
+
+N = 4096  # >= columnar.encoding.min_rows so auto-selection engages
+
+
+def _lineitem(n=N, seed=0):
+    rng = np.random.RandomState(seed)
+    start = np.datetime64("1992-01-01")
+    return pd.DataFrame({
+        "l_returnflag": rng.choice(["A", "N", "R"], n),
+        "l_orderkey": (rng.randint(0, 1_500_000, n) * 4).astype(np.int64),
+        "l_linenumber": rng.randint(1, 8, n).astype(np.int64),
+        "l_quantity": rng.randint(1, 51, n).astype(np.float64),
+        "l_extendedprice": rng.rand(n) * 100000.0,
+        "l_discount": rng.randint(0, 11, n) / 100.0,
+        "l_shipdate": start + rng.randint(0, 2526, n).astype("timedelta64[D]"),
+    })
+
+
+def _context(df, **config):
+    """Context with `df` registered as lineitem.  Encoding-related options
+    apply as a SCOPED overlay around registration only (encoding is a
+    load-time property) — the process-global config stays untouched so
+    tests cannot contaminate each other."""
+    from dask_sql_tpu import Context
+    from dask_sql_tpu import config as config_module
+
+    c = Context()
+    with config_module.set(dict(config)):
+        c.create_table("lineitem", df)
+    return c
+
+
+# ---------------------------------------------------------------- round trips
+@pytest.mark.parametrize("dtype,vals", [
+    ("int8", [1, 2, 3, 1]),
+    ("int16", [100, 200, 100, 300]),
+    ("int32", [10**6, 2 * 10**6, 10**6, 0]),
+    ("int64", [10**12, 2 * 10**12, 10**12, 0]),
+    ("float64", [0.05, 0.07, 0.05, 0.0]),
+    ("float32", [1.5, 2.5, 1.5, 0.5]),
+])
+def test_roundtrip_per_dtype_with_nulls(dtype, vals):
+    n = N
+    base = np.tile(np.asarray(vals, dtype=dtype), n // len(vals))
+    ser = pd.Series(base).astype("object")
+    ser[::7] = None  # NULLs ride the validity mask through encode/decode
+    df = pd.DataFrame({"x": pd.Series(ser).astype("float64")})
+    enc = Table.from_pandas(df, encode=True)
+    plain = Table.from_pandas(df, encode=False)
+    a, b = enc.columns["x"].to_numpy(), plain.columns["x"].to_numpy()
+    assert np.allclose(a, b, equal_nan=True)
+
+
+def test_roundtrip_datetime_with_nat():
+    n = N
+    dates = np.datetime64("1995-01-01") + np.tile(
+        np.arange(30), n // 30 + 1)[:n].astype("timedelta64[D]")
+    ser = pd.Series(dates)
+    ser[::11] = pd.NaT
+    df = pd.DataFrame({"d": ser})
+    enc = Table.from_pandas(df, encode=True)
+    assert enc.columns["d"].encoding in (Encoding.DICT, Encoding.FOR,
+                                         Encoding.RLE)
+    pd.testing.assert_series_equal(
+        pd.Series(enc.columns["d"].to_numpy()),
+        pd.Series(Table.from_pandas(df, encode=False).columns["d"].to_numpy()))
+
+
+def test_rle_roundtrip_with_nulls():
+    n = N
+    vals = np.repeat(np.arange(8, dtype=np.int64), n // 8).astype("float64")
+    mask = np.ones(n, dtype=bool)
+    mask[: n // 8] = False  # a whole NULL run
+    col = encodings.maybe_encode(vals, mask, Column.from_numpy(
+        vals).sql_type, force=True)
+    # force RLE specifically: disable the competing encodings
+    from dask_sql_tpu import config as config_module
+
+    with config_module.set({"columnar.encoding.dict": False,
+                            "columnar.encoding.for": False}):
+        col = encodings.maybe_encode(vals, mask,
+                                     Column.from_numpy(vals).sql_type,
+                                     force=True)
+    assert col is not None and col.encoding is Encoding.RLE
+    assert len(col) == n
+    out = col.to_numpy()
+    assert np.isnan(out[: n // 8]).all()
+    assert np.array_equal(out[n // 8:], vals[n // 8:])
+    # positional access decodes first and stays correct
+    taken = col.take(np.asarray([0, n // 8, n - 1]))
+    assert taken.encoding is Encoding.PLAIN
+    assert np.isnan(taken.to_numpy()[0]) and taken.to_numpy()[2] == vals[-1]
+
+
+# ------------------------------------------------------------- auto-selection
+def test_selection_heuristics():
+    t = Table.from_pandas(_lineitem(), encode=True)
+    enc = {n: c.encoding for n, c in t.columns.items()}
+    assert enc["l_discount"] is Encoding.DICT      # 11 uniques
+    assert enc["l_quantity"] is Encoding.DICT      # 50 uniques
+    assert enc["l_orderkey"] is Encoding.FOR       # wide range, stride 4
+    assert enc["l_extendedprice"] is Encoding.PLAIN  # continuous floats
+    assert enc["l_returnflag"] is Encoding.PLAIN   # strings keep their own
+    # DICT codes are int16 and the dictionary is sorted
+    disc = t.columns["l_discount"]
+    assert np.dtype(disc.data.dtype) == np.int16
+    assert np.all(np.diff(disc.enc_values) > 0)
+
+
+def test_selection_respects_min_rows_and_off_switch():
+    small = _lineitem(n=64)
+    t = Table.from_pandas(small, encode=True)
+    assert not t.has_encoded_columns()  # below columnar.encoding.min_rows
+    c = _context(_lineitem(), **{"columnar.encoding": "off"})
+    assert not c.schema["root"].tables["lineitem"].table.has_encoded_columns()
+
+
+def test_selection_rle_for_sorted_runs():
+    n = N
+    df = pd.DataFrame({"x": np.repeat(np.arange(16, dtype=np.int64), n // 16)})
+    from dask_sql_tpu import config as config_module
+
+    with config_module.set({"columnar.encoding.dict": False,
+                            "columnar.encoding.for": False}):
+        t = Table.from_pandas(df, encode=True)
+    assert t.columns["x"].encoding is Encoding.RLE
+    assert np.array_equal(t.columns["x"].to_numpy(), df["x"].to_numpy())
+
+
+# ------------------------------------------- code-space predicate equivalence
+def test_codespace_predicates_match_decoded_property():
+    """Property-style: random comparison/IN literals (members, non-members,
+    out-of-range) over DICT/FOR columns must match the encodings-off
+    context exactly, through the full SQL path."""
+    df = _lineitem()
+    c_enc = _context(df)
+    c_off = _context(df, **{"columnar.encoding": "off"})
+    t = c_enc.schema["root"].tables["lineitem"].table
+    assert t.columns["l_discount"].encoding is Encoding.DICT
+
+    rng = np.random.RandomState(7)
+    literals = [0.05, 0.07, 0.051, -1.0, 2.0]  # members + absent + OOR
+    literals += [round(float(rng.uniform(-0.05, 0.15)), 3) for _ in range(4)]
+    ops = ["<", "<=", ">", ">=", "=", "<>"]
+    for lit in literals:
+        for op in (ops if lit in (0.05, 0.051) else
+                   [ops[rng.randint(len(ops))]]):
+            sql = (f"SELECT COUNT(*) AS n, SUM(l_quantity) AS s "
+                   f"FROM lineitem WHERE l_discount {op} {lit}")
+            got = c_enc.sql(sql, return_futures=False)
+            ref = c_off.sql(sql, return_futures=False)
+            assert int(got["n"][0]) == int(ref["n"][0]), (op, lit)
+            assert np.array_equal(got["s"].to_numpy(np.float64),
+                                  ref["s"].to_numpy(np.float64),
+                                  equal_nan=True), (op, lit)
+    # IN lists incl. absent members; and a FOR-column range predicate
+    for sql in (
+        "SELECT COUNT(*) AS n FROM lineitem WHERE l_discount IN (0.02, 0.05, 0.99)",
+        "SELECT COUNT(*) AS n FROM lineitem WHERE l_discount NOT IN (0.02, 0.05)",
+        "SELECT COUNT(*) AS n FROM lineitem WHERE l_quantity IN (1, 2, 3.5)",
+        "SELECT COUNT(*) AS n FROM lineitem WHERE l_orderkey < 3000000",
+        "SELECT COUNT(*) AS n FROM lineitem "
+        "WHERE l_shipdate >= DATE '1994-01-01' AND l_shipdate < DATE '1995-01-01'",
+    ):
+        got = c_enc.sql(sql, return_futures=False)
+        ref = c_off.sql(sql, return_futures=False)
+        assert int(got["n"][0]) == int(ref["n"][0]), sql
+    assert c_enc.metrics.counter("columnar.encoding.codespace_pred") >= 1
+    assert c_enc.metrics.counter("columnar.encoding.decode") == 0
+
+
+def test_groupby_on_encoded_keys_matches_decoded():
+    df = _lineitem()
+    c_enc = _context(df)
+    c_off = _context(df, **{"columnar.encoding": "off"})
+    for sql in (
+        "SELECT l_discount, COUNT(*) AS n FROM lineitem "
+        "GROUP BY l_discount ORDER BY l_discount",
+        "SELECT l_linenumber, SUM(l_extendedprice) AS s FROM lineitem "
+        "GROUP BY l_linenumber ORDER BY l_linenumber",
+    ):
+        got = c_enc.sql(sql, return_futures=False)
+        ref = c_off.sql(sql, return_futures=False)
+        for col in got.columns:
+            assert np.array_equal(got[col].to_numpy(), ref[col].to_numpy()), \
+                (sql, col)
+
+
+def test_eager_path_decodes_once_and_matches():
+    df = _lineitem()
+    c_enc = _context(df)
+    sql = ("SELECT l_linenumber, COUNT(*) AS n FROM lineitem "
+           "WHERE l_discount > 0.03 GROUP BY l_linenumber ORDER BY l_linenumber")
+    with c_enc.config.set({"sql.compile": False}):
+        got = c_enc.sql(sql, return_futures=False)
+    assert c_enc.metrics.counter("columnar.encoding.decode") >= 1
+    sel = df[df.l_discount > 0.03]
+    exp = sel.groupby("l_linenumber").size()
+    assert np.array_equal(got["n"].to_numpy(np.int64), exp.to_numpy())
+
+
+# ------------------------------------------------------- families interaction
+def test_family_zero_recompile_on_encoded_table():
+    """The second literal variant over an encoded table pays ZERO foreground
+    compiles: code-space param translation happens in-kernel (searchsorted
+    over the dictionary constant), so one executable serves the family."""
+    df = _lineitem()
+    c = _context(df)
+
+    def q(lit):
+        return ("SELECT l_linenumber, SUM(l_quantity) AS s, COUNT(*) AS n "
+                f"FROM lineitem WHERE l_discount > {lit} GROUP BY l_linenumber")
+
+    def compiles(tr):
+        return [s.name for s in tr.spans if s.name.startswith("compile:")]
+
+    first = c.sql(q(0.02), return_futures=False)
+    assert len(compiles(c.last_trace)) >= 1
+    second = c.sql(q(0.06), return_futures=False)
+    assert compiles(c.last_trace) == []
+    # and the params really steer the result
+    exp2 = df[df.l_discount > 0.06].groupby("l_linenumber").l_quantity.sum()
+    got2 = second.set_index(second.columns[0])["s"]
+    assert np.allclose(sorted(got2.to_numpy(np.float64)),
+                       sorted(exp2.to_numpy()))
+    assert len(first) == len(second)
+
+
+# ------------------------------------------------------------------ estimator
+def test_estimator_interval_shrinkage():
+    from dask_sql_tpu.analysis import estimator
+    from dask_sql_tpu.planner.parser import parse_sql
+
+    df = _lineitem()
+    c_enc = _context(df)
+    c_off = _context(df, **{"columnar.encoding": "off"})
+    sql = ("SELECT SUM(l_extendedprice) AS s FROM lineitem "
+           "WHERE l_discount > 0.05")
+    e_enc = estimator.estimate_plan(
+        c_enc._get_ral(parse_sql(sql)[0], sql_text=sql), context=c_enc)
+    e_off = estimator.estimate_plan(
+        c_off._get_ral(parse_sql(sql)[0], sql_text=sql), context=c_off)
+    assert e_enc.peak_bytes.hi < e_off.peak_bytes.hi
+    assert e_enc.peak_bytes.lo < e_off.peak_bytes.lo
+    # the tightened lower bound stays sound: it never exceeds actual bytes
+    from dask_sql_tpu.serving.cache import table_nbytes
+
+    resident = table_nbytes(c_enc.schema["root"].tables["lineitem"].table)
+    assert e_enc.peak_bytes.lo <= resident + 10_000
+
+
+def test_admission_gate_admits_more_when_encoded():
+    """The same budget rejects the PLAIN table's scan but admits the
+    encoded one — compression as admission headroom, not just footprint."""
+    df = _lineitem()
+    c_enc = _context(df)
+    c_off = _context(df, **{"columnar.encoding": "off"})
+    from dask_sql_tpu.analysis import estimator
+    from dask_sql_tpu.planner.parser import parse_sql
+
+    sql = "SELECT SUM(l_quantity) AS s FROM lineitem"
+    lo_enc = estimator.estimate_plan(
+        c_enc._get_ral(parse_sql(sql)[0], sql_text=sql),
+        context=c_enc).peak_bytes.lo
+    lo_off = estimator.estimate_plan(
+        c_off._get_ral(parse_sql(sql)[0], sql_text=sql),
+        context=c_off).peak_bytes.lo
+    budget = (lo_enc + lo_off) // 2  # between the two provable floors
+    from dask_sql_tpu.exceptions import QueryError
+
+    with c_enc.config.set({"serving.admission.max_estimated_bytes": budget}):
+        c_enc.sql(sql, return_futures=False)  # admits
+    with c_off.config.set({"serving.admission.max_estimated_bytes": budget}):
+        with pytest.raises(QueryError):
+            c_off.sql(sql, return_futures=False)  # sheds
+
+
+# ---------------------------------------------------------------------- casts
+def test_casts_on_encoded_columns():
+    from dask_sql_tpu.columnar.dtypes import SqlType
+
+    df = _lineitem()
+    t = Table.from_pandas(df, encode=True)
+    # DICT int -> DOUBLE: strictly-increasing value cast keeps the codes
+    ln = t.columns["l_linenumber"]
+    assert ln.encoding is Encoding.DICT
+    as_double = ln.cast(SqlType.DOUBLE)
+    assert as_double.encoding is Encoding.DICT
+    assert np.array_equal(as_double.to_numpy(),
+                          df["l_linenumber"].to_numpy().astype(np.float64))
+    # DICT datetime -> DATE (collapsing-safe here: already midnight)
+    ship = t.columns["l_shipdate"]
+    as_date = ship.cast(SqlType.DATE)
+    assert np.array_equal(
+        pd.to_datetime(as_date.to_numpy()).values.astype("datetime64[D]"),
+        df["l_shipdate"].to_numpy().astype("datetime64[D]"))
+    # FOR -> DOUBLE decodes then casts
+    ok = t.columns["l_orderkey"]
+    assert ok.encoding is Encoding.FOR
+    as_d = ok.cast(SqlType.DOUBLE)
+    assert np.array_equal(as_d.to_numpy(),
+                          df["l_orderkey"].to_numpy().astype(np.float64))
+    # collapsing cast (DOUBLE dict -> INTEGER truncation merges values)
+    # must fall back to decode, not keep a broken code space
+    disc = t.columns["l_quantity"]
+    as_int = disc.cast(SqlType.INTEGER)
+    assert np.array_equal(as_int.to_numpy(),
+                          df["l_quantity"].to_numpy().astype(np.int32))
+    # full-SQL cast path over encoded columns
+    c = _context(df)
+    got = c.sql("SELECT CAST(l_discount AS VARCHAR) AS s FROM lineitem "
+                "WHERE l_discount = 0.05 LIMIT 3", return_futures=False)
+    assert all(v == "0.05" for v in got["s"])
+
+
+# -------------------------------------------------------------- lint / pandas
+def test_explain_lint_encoding_rows():
+    c = _context(_lineitem())
+    rows = list(c.sql("EXPLAIN LINT SELECT SUM(l_quantity) FROM lineitem",
+                      return_futures=False)["LINT"])
+    enc_rows = [r for r in rows if r.startswith("info[encoding]")]
+    assert enc_rows, rows
+    assert "DICT" in enc_rows[0] and "ratio=" in enc_rows[0]
+
+
+def test_to_pandas_packed_transfer_with_encoded(monkeypatch):
+    monkeypatch.setenv("DSQL_PACK_TO_PANDAS", "1")
+    df = _lineitem()
+    t = Table.from_pandas(df, encode=True)
+    out = t.to_pandas()
+    for col in ("l_quantity", "l_discount", "l_orderkey"):
+        assert np.allclose(out[col].to_numpy(np.float64),
+                           df[col].to_numpy(np.float64)), col
+    assert np.array_equal(pd.to_datetime(out["l_shipdate"]).values,
+                          df["l_shipdate"].to_numpy())
+
+
+def test_checkpoint_roundtrip_reencodes(tmp_path):
+    from dask_sql_tpu import Context
+
+    df = _lineitem()
+    c1 = _context(df)
+    snap = str(tmp_path / "snap")
+    c1.save_state(snap)
+    c2 = Context()
+    c2.load_state(snap)
+    t2 = c2.schema["root"].tables["lineitem"].table
+    assert t2.has_encoded_columns()
+    got = c2.sql("SELECT SUM(l_quantity) AS s FROM lineitem",
+                 return_futures=False)
+    assert float(got["s"][0]) == float(df["l_quantity"].sum())
