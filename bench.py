@@ -623,6 +623,93 @@ def run_compressed_smoke():
         raise SystemExit(1)
 
 
+def run_spmd_smoke():
+    """`bench.py --spmd`: SPMD sharded-execution smoke (ISSUE 11).
+
+    Shards lineitem over the local mesh, runs the Q1 shape on the sharded
+    and the single-chip context, and asserts: the spmd_aggregate rung
+    fired (trace span attr), results match pandas, and — on >= 2 REAL
+    devices — sharded rows/s is at least the single-chip run.  On the CPU
+    backend the mesh is virtual (every "device" shares the same cores), so
+    the perf bar is reported but not enforced.  Exit 1 on violation."""
+    import os
+
+    # the virtual mesh must exist BEFORE jax initializes
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8")
+    _ensure_backend()
+    import jax
+
+    from dask_sql_tpu import Context
+
+    ndev = len(jax.devices())
+    if ndev < 2:
+        print(json.dumps({"metric": "spmd_smoke", "ok": True,
+                          "skipped": "single-device environment"}),
+              flush=True)
+        return
+
+    n = min(N_ROWS, 2_000_000)
+    df = gen_lineitem(n, seed=0)
+    expected = run_pandas(df)
+
+    def timed(ctx):
+        ctx.sql(QUERY).compute()  # warm (compile)
+        t0 = time.perf_counter()
+        res = ctx.sql(QUERY).compute()
+        return res, n / (time.perf_counter() - t0)
+
+    single = Context()
+    single.config.update({"serving.cache.enabled": False})
+    single.create_table("lineitem", df)
+    _, single_rate = timed(single)
+
+    sharded = Context()
+    sharded.config.update({"serving.cache.enabled": False})
+    sharded.create_table("lineitem", df, distributed=True)
+    res, spmd_rate = timed(sharded)
+
+    tr = sharded.last_trace
+    rung_spans = [s for s in tr.spans if s.name == "rung:spmd_aggregate"
+                  and s.attrs.get("spmd")]
+    rung_fired = bool(rung_spans) and \
+        sharded.metrics.counter("resilience.rung.spmd_aggregate") >= 1
+
+    res = res.sort_values(["l_returnflag", "l_linestatus"]).reset_index(
+        drop=True)
+    exp = expected.reset_index(drop=True)
+    try:
+        np.testing.assert_allclose(
+            res["sum_qty"].to_numpy(np.float64),
+            exp["sum_qty"].to_numpy(np.float64), rtol=1e-6)
+        np.testing.assert_allclose(
+            res["count_order"].to_numpy(np.float64),
+            exp["count_order"].to_numpy(np.float64))
+        pd_ok = list(res["l_returnflag"]) == list(exp["l_returnflag"])
+    except AssertionError:
+        pd_ok = False
+
+    perf_enforced = jax.default_backend() != "cpu"
+    perf_ok = (not perf_enforced) or spmd_rate >= single_rate
+    ok = rung_fired and pd_ok and perf_ok
+    print(json.dumps({
+        "metric": "spmd_smoke",
+        "backend": jax.default_backend(),
+        "ok": bool(ok),
+        "devices": ndev,
+        "spmd_rung_fired": bool(rung_fired),
+        "results_match_pandas": bool(pd_ok),
+        "spmd_rows_per_sec": round(spmd_rate, 1),
+        "single_chip_rows_per_sec": round(single_rate, 1),
+        "speedup": round(spmd_rate / single_rate, 3) if single_rate else None,
+        "perf_enforced": bool(perf_enforced),
+    }), flush=True)
+    if not ok:
+        raise SystemExit(1)
+
+
 def run_lint_smoke():
     """`bench.py --lint`: static-analysis smoke.
 
@@ -678,6 +765,9 @@ def main():
         return
     if "--compressed" in sys.argv:
         run_compressed_smoke()
+        return
+    if "--spmd" in sys.argv:
+        run_spmd_smoke()
         return
 
     import jax

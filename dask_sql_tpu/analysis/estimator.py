@@ -187,6 +187,12 @@ class PlanEstimate:
     #: [(Aggregate node, rungs, intermediate lower bound)] proofs attached
     #: by apply() — compiled rungs whose buffers provably cannot fit
     rung_proofs: List[Tuple[p.LogicalPlan, frozenset, int]]
+    #: mesh width backing the estimate: >1 when a scanned table is
+    #: row-sharded, in which case resident-scan LOWER bounds are PER-DEVICE
+    #: bytes (the admission gate then budgets per-chip HBM instead of
+    #: shedding queries that fit the mesh) — upper bounds stay global,
+    #: which is conservative either way
+    devices: int = 1
 
     def format_rows(self) -> List[str]:
         rows = [
@@ -198,6 +204,9 @@ class PlanEstimate:
                 else self.peak_bytes.hi),
             f"result: bytes={self.result_bytes.fmt()} (d2h transfer)",
         ]
+        if self.devices > 1:
+            rows.insert(1, f"mesh: devices={self.devices} "
+                           "(sharded scans budgeted per device)")
         for n in self.nodes:
             if n.scratch_hi is None:
                 # the node whose transients made bytes_hi unbounded must be
@@ -234,6 +243,8 @@ class _Estimator:
         #: id(TableScan) -> exact resident bytes when the scanned table is
         #: registered with compressed encodings (columnar/encodings.py)
         self._scan_actual: Dict[int, int] = {}
+        #: mesh width: max devices any scanned sharded table spans
+        self.devices: int = 1
 
     # ------------------------------------------------------------- walking
     def estimate(self, node: p.LogicalPlan) -> Tuple[Interval, Interval]:
@@ -293,6 +304,14 @@ class _Estimator:
                 scan_lo = actual
             else:
                 scan_lo = int(n) * _row_bytes(list(node.schema))[0]
+            ndev = self._scan_mesh_devices(node)
+            if ndev > 1:
+                # row-sharded table: each chip holds ~1/ndev of the scan, so
+                # the PER-DEVICE provable floor (what admission sheds on)
+                # divides — the mesh serves working sets a single chip
+                # cannot.  Upper bounds stay global (conservative).
+                scan_lo = -(-scan_lo // ndev)
+                self.devices = max(self.devices, ndev)
             self._scan_lo[key] = max(self._scan_lo.get(key, 0), scan_lo)
             rows = Interval.exact(int(n))
             if node.filters:
@@ -373,6 +392,19 @@ class _Estimator:
             return Interval(0, None)
         return child_rows[0] if child_rows else Interval.unknown()
 
+    def _scan_mesh_devices(self, node: p.TableScan) -> int:
+        """Mesh width of the scanned table's storage: the number of devices
+        its buffers are row-sharded over, or 1 (single-device / lazy /
+        unknown) — the shared spmd.core resolution rule."""
+        try:
+            from ..spmd.core import resolve_sharded_scan
+
+            got = resolve_sharded_scan(self.context, node)
+            return int(got[1].devices.size) if got is not None else 1
+        except Exception:  # dsql: allow-broad-except — backend teardown /
+            # deleted buffers mid-estimate: single-device is the safe claim
+            return 1
+
     def _scan_actual_bytes(self, node: p.TableScan) -> Optional[int]:
         """Exact resident bytes of the scan's projected columns when the
         registered table carries compressed encodings; None keeps the
@@ -420,7 +452,48 @@ class _Estimator:
             else (_pow2_bucket(in_rows_hi) or 0) * 4
         if gid_hi is None:
             return None
-        return cap_hi + gid_hi
+        return cap_hi + gid_hi + self._exchange_scratch(node, domain,
+                                                        all_known)
+
+    def _exchange_scratch(self, node: p.Aggregate, domain, all_known) -> int:
+        """Per-device exchange-buffer bytes of the sharded aggregation
+        paths (spmd/dist): send + receive [ndev, cpeer] blocks of the
+        6-state layout, sized against the capacity ladder rung the group
+        domain lands on (parallel/dist_plan.py GROUP/PEER ladders).  Zero
+        on single-device plans AND on aggregates whose own input subtree
+        is unsharded (they execute single-chip even when another scan in
+        the plan is sharded), so those estimates are unchanged."""
+        ndev = self.devices
+        if ndev <= 1:
+            return 0
+        try:
+            from ..parallel.dist_plan import plan_has_sharded_scan
+
+            inputs = node.inputs()
+            if self.context is None or not inputs or \
+                    not plan_has_sharded_scan(inputs[0], self.context):
+                return 0
+        except Exception:  # dsql: allow-broad-except — probe failure keeps
+            # the conservative (charged) upper bound
+            pass
+        from ..parallel.dist_plan import (
+            GROUP_CAPACITY_LADDER,
+            N_FSTATE,
+            N_ISTATE,
+            PEER_CAPACITY_LADDER,
+            _ladder_at_least,
+        )
+
+        need = domain if (domain is not None and all_known) \
+            else RADIX_DOMAIN_LIMIT
+        cap = _ladder_at_least(GROUP_CAPACITY_LADDER,
+                               min(need, RADIX_DOMAIN_LIMIT))
+        cpeer = _ladder_at_least(PEER_CAPACITY_LADDER,
+                                 min(2 * cap // ndev + 256, cap))
+        nk = max(len(node.group_exprs), 1)
+        nv = max(len(node.agg_exprs), 1)
+        width = (nk + nv * (N_ISTATE + N_FSTATE) + 1) * 8
+        return 2 * ndev * cpeer * width
 
     # -------------------------------------------------------------- verdict
     def finish(self, root: p.LogicalPlan, root_rows: Interval,
@@ -449,6 +522,7 @@ class _Estimator:
             peak_bytes=Interval(peak_lo, peak_hi),
             nodes=list(reversed(self.nodes)),  # root first for display
             rung_proofs=[],
+            devices=self.devices,
         )
 
 

@@ -309,6 +309,7 @@ class _Verifier:
                      f"rows={rows} bucket={_pow2_bucket(rows)}")
         if self.collect_info:
             self._scan_encoding_info(node)
+            self._scan_spmd_info(node)
         if fields is None:
             return rows
         by_name = {f.name: f for f in fields}
@@ -361,6 +362,44 @@ class _Verifier:
         self.add("encoding", SEV_INFO, node,
                  " ".join(parts) + f"; encoded={enc_b}B decoded={dec_b}B "
                  f"ratio={ratio:.2f}")
+
+    def _scan_spmd_info(self, node: p.TableScan) -> None:
+        """SPMD advisory per scan over a mesh-sharded table (the EXPLAIN
+        LINT row ISSUE 11 asks for): devices, per-device resident bytes,
+        and whether an SPMD rung is eligible — or the specific reason it is
+        not.  Single-device tables lint unchanged."""
+        ctx = self.context
+        if ctx is None:
+            return
+        try:
+            from ..spmd.core import resolve_sharded_scan, spmd_enabled
+
+            got = resolve_sharded_scan(ctx, node)
+            if got is None:
+                return
+            table, mesh = got
+            ndev = int(mesh.devices.size)
+            total = sum(int(c.data.nbytes)
+                        + (int(c.validity.nbytes) if c.validity is not None
+                           else 0)
+                        for c in table.columns.values())
+            per_dev = -(-total // ndev)
+            from ..columnar.encodings import Encoding
+
+            config = getattr(ctx, "config", None)
+            if config is not None and not spmd_enabled(config):
+                why = "spmd rungs disabled (parallel.spmd=off)"
+            elif any(c.encoding is Encoding.RLE
+                     for c in table.columns.values()):
+                why = "rle-encoded column blocks the compiled rungs"
+            else:
+                why = "spmd rungs eligible"
+            self.add("spmd", SEV_INFO, node,
+                     f"sharded devices={ndev} per_device_bytes={per_dev}; "
+                     f"{why}")
+        except Exception:  # dsql: allow-broad-except — advisory only: a
+            # deleted buffer / torn-down backend must never fail EXPLAIN LINT
+            logger.debug("spmd scan advisory failed", exc_info=True)
 
     def _check_projection(self, node: p.Projection) -> None:
         if len(node.exprs) != len(node.schema):

@@ -49,6 +49,31 @@ DEFAULTS: Dict[str, Any] = {
     "sql.distributed.aggregate": "auto",  # collectives engine routing
     "sql.distributed.join": "auto",
     "sql.distributed.sort": "auto",  # range-partition sort over the mesh
+    # SPMD query execution (spmd/, docs/spmd.md): device-sharded storage +
+    # sharded compiled rungs.
+    #   parallel.auto_shard: row-shard eligible registrations over the
+    #   default mesh at create_table/load time (same mechanism as the
+    #   explicit `distributed=True` kwarg / CREATE TABLE WITH
+    #   (distributed=...) passthrough).  "off" (default) preserves
+    #   single-device registration; "on"/"auto" shards any non-lazy table
+    #   with at least `min_rows` rows when the mesh has >= 2 devices.
+    #   DICT/FOR encodings are preserved by sharding, so SPMD exchanges
+    #   move codes, not values.
+    "parallel.auto_shard": "off",
+    "parallel.auto_shard.min_rows": 32768,  # smaller registrations stay single-device
+    # the sharded compiled rungs (spmd_select / spmd_aggregate /
+    # spmd_join_aggregate): explicit shard_map SPMD programs over
+    # mesh-sharded scans, sitting ABOVE the single-chip compiled rungs in
+    # the degradation ladder.  "auto" fires whenever the scanned table is
+    # mesh-sharded; "off" keeps the pre-SPMD paths (GSPMD auto-layout /
+    # dist_* collectives engine).
+    "parallel.spmd": "auto",
+    "parallel.spmd.select": True,  # spmd_select rung for root select chains
+    "parallel.spmd.aggregate": True,  # spmd_aggregate rung (psum tree-reduce)
+    "parallel.spmd.join_aggregate": True,  # spmd_join_aggregate rung (broadcast builds)
+    # build sides up to this many rows broadcast (replicated LUT probe);
+    # larger build sides decline to the all_to_all hash-shuffle engine
+    "parallel.spmd.broadcast_rows": 1 << 20,
     "sql.debug.validate_take": False,  # assert gather-index invariants (host sync per gather)
     # Compressed column encodings (columnar/encodings.py, docs/columnar.md):
     # load-time auto-selection of DICT / FOR / RLE storage for

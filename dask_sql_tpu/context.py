@@ -463,20 +463,26 @@ class Context:
         statistics: Optional[Statistics] = None,
         backend: Optional[str] = None,
         gpu: bool = False,
-        distributed: bool = False,
+        distributed: Optional[bool] = None,
         **kwargs,
     ) -> None:
         """Register a table (parity: context.py:168).  `backend='tpu'`
         (default) lands columns in device HBM; the reference's `gpu=` flag is
         accepted and treated as a backend hint.  `distributed=True` shards the
         column buffers row-wise over the default device mesh so kernels run
-        SPMD with XLA-placed collectives."""
+        SPMD with XLA-placed collectives; an EXPLICIT `distributed=False`
+        also opts this table out of the `parallel.auto_shard` policy (None,
+        the default, leaves the policy in charge)."""
         schema_name = schema_name or self.schema_name
         if schema_name not in self.schema:
             raise KeyError(f"Schema {schema_name} not found")
         dc = InputUtil.to_dc(input_table, table_name, format=format,
                              persist=persist, **kwargs)
-        if distributed:
+        # normalize: the CREATE TABLE ... WITH (distributed=...) passthrough
+        # delivers SQL literals, and a string 'false' must not shard
+        from .spmd.storage import maybe_auto_shard, truthy_option
+
+        if truthy_option(distributed):
             from .datacontainer import LazyParquetContainer
             from .parallel.distribute import shard_table
 
@@ -486,6 +492,13 @@ class Context:
                 dc = DataContainer(shard_table(dc.table))
             else:
                 dc.table = shard_table(dc.table)
+        elif distributed is None:
+            # parallel.auto_shard policy (spmd/storage.py): eligible
+            # registrations row-shard over the default mesh without
+            # per-table opt-in, so the SPMD rungs serve plain create_table.
+            # An EXPLICIT distributed=False (or WITH (distributed='false'))
+            # is a per-table opt-out the policy must respect.
+            dc = maybe_auto_shard(dc, self.config, self.metrics)
         self.schema[schema_name].tables[table_name] = dc
         from .datacontainer import LazyParquetContainer
 

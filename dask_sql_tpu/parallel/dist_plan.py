@@ -733,6 +733,8 @@ def dist_sort_table(mesh: Mesh, table, sort_cols: List[Column],
         fn = get_sort_kernel(mesh, nk, nc, cpeer, cpeer2, rows_out)
         out, of1, of2 = fn(keys_mat, pay_mat, rowvalid, splitters)
         STATS["sort_kernel"] += 1
+        if metrics is not None:
+            metrics.inc("parallel.dist.sort_kernel")
         grew = False
         if bool(host_read(of1).any()):
             cpeer = _ladder_next_or_none(PEER_CAPACITY_LADDER, cpeer)
@@ -1029,6 +1031,7 @@ def try_dist_aggregate(rel, executor, inp) -> Optional[object]:
         fk, fv_, iout, fout, overflow = fn(keys_mat, ivals_mat, fvals_mat,
                                            vvalid_mat, rowvalid)
         STATS["agg_kernel"] += 1
+        executor.context.metrics.inc("parallel.dist.agg_kernel")
         if not bool(host_read(overflow).any()):
             break
         cap = _ladder_next(GROUP_CAPACITY_LADDER, cap)

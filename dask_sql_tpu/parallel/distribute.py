@@ -33,13 +33,20 @@ def shard_table(table: Table, mesh=None) -> Table:
     sharding = row_sharding(mesh)
     ndev = mesh.devices.size
     n = table.num_rows
-    target = ((n + ndev - 1) // ndev) * ndev
+    # pad from the PHYSICAL column length: a table that already carries a
+    # row_valid mask (re-sharding a padded table, streaming partitions) has
+    # columns longer than its logical row count, and its existing mask must
+    # thread through — the pre-fix code keyed everything off the logical
+    # count and rebuilt the mask only when new padding occurred, silently
+    # replacing a pre-masked table's mask with all-ones over its pad rows
+    phys = table.padded_rows
+    target = ((phys + ndev - 1) // ndev) * ndev
 
     from .bootstrap import make_global_array
     from .mesh import pad_to_multiple
 
     def place(arr):
-        if target == n:
+        if target == phys:
             return make_global_array(arr, sharding)
         padded, _ = pad_to_multiple(arr, ndev)
         return make_global_array(padded, sharding)
@@ -58,10 +65,13 @@ def shard_table(table: Table, mesh=None) -> Table:
         validity = None if col.validity is None else place(col.validity)
         cols[name] = _replace(col, data=data, validity=validity)
     row_valid = None
-    if target != n:
-        mask = jnp.concatenate([jnp.ones(n, dtype=bool),
-                                jnp.zeros(target - n, dtype=bool)])
-        row_valid = make_global_array(mask, sharding)
+    if target != n or table.row_valid is not None:
+        base = table.row_valid if table.row_valid is not None \
+            else jnp.ones(phys, dtype=bool)
+        if target != phys:
+            base = jnp.concatenate([jnp.asarray(base),
+                                    jnp.zeros(target - phys, dtype=bool)])
+        row_valid = make_global_array(base, sharding)
     return Table(cols, table.num_rows, row_valid)
 
 

@@ -295,6 +295,16 @@ class SegmentReducer:
         acc = data.astype(jnp.int64)
         return ("done", self._scatter(jnp.where(mask, acc, jnp.zeros_like(acc))))
 
+    def seg_min(self, contrib):
+        """Segment min of pre-filled contributions (absent rows carry the
+        identity fill).  Routed through the reducer — not called inline —
+        so the SPMD subclass (spmd/aggregate.py) can combine the per-shard
+        partials with a pmin collective."""
+        return ("done", jax.ops.segment_min(contrib, self.gid, self.domain))
+
+    def seg_max(self, contrib):
+        return ("done", jax.ops.segment_max(contrib, self.gid, self.domain))
+
     def _push(self, col):
         self._fcols.append(col)
         return ("f", len(self._fcols) - 1, None)
@@ -392,9 +402,9 @@ def segment_agg_outputs(ev, slots, agg_exprs, sel, gid, domain, reducer):
                 fill = jnp.array(info.max if a.func == "min" else info.min,
                                  dtype=ad.dtype)
             contrib = jnp.where(v, ad, fill)
-            red = (jax.ops.segment_min if a.func == "min"
-                   else jax.ops.segment_max)(contrib, reducer.gid, domain)
-            plans.append(("minmax", ("done", red), cnt_h))
+            h = (reducer.seg_min if a.func == "min"
+                 else reducer.seg_max)(contrib)
+            plans.append(("minmax", h, cnt_h))
             continue
         # variance family
         x = ad.astype(jnp.float64)
@@ -1067,7 +1077,6 @@ class CompiledAggregate:
         domain = self.domain
         n_cols = len(self.table.column_names)
         n_rows = self.table.num_rows
-        segsum_mode = self.segsum_mode
 
         def fn(datas, valids, row_valid, params=()):
             slots = {i: (datas[i], valids[i]) for i in range(n_cols)}
@@ -1105,7 +1114,7 @@ class CompiledAggregate:
             if first:
                 gid = jnp.zeros(nr, dtype=jnp.int32)
             sel = mask if mask is not None else jnp.ones(nr, dtype=bool)
-            reducer = SegmentReducer(gid, domain, segsum_mode, nr)
+            reducer = self._make_reducer(gid, domain, nr)
             hit_h = reducer.count(sel)
             outs = segment_agg_outputs(ev, slots, agg_exprs, sel, gid, domain,
                                        reducer)
@@ -1120,6 +1129,12 @@ class CompiledAggregate:
             return out
 
         return fn
+
+    def _make_reducer(self, gid, domain: int, n_rows: int) -> SegmentReducer:
+        """Reducer factory the traced kernel calls — the seam the SPMD
+        rung (spmd/aggregate.py) overrides to psum/pmin/pmax per-shard
+        partial states across the mesh before the shared finalize."""
+        return SegmentReducer(gid, domain, self.segsum_mode, n_rows)
 
     @property
     def batchable(self) -> bool:
@@ -1307,6 +1322,67 @@ def singleflight_get_or_build(ctx, cache: "OrderedDict", key: Tuple, build):
             singleflight_done(key)
 
 
+def defer_rebuild(ctx, rung: str, cache, cache_cap: int, key, family,
+                  bucket, build_and_warm) -> bool:
+    """THE background-recompile deferral shared by every compiled-pipeline
+    cache (single-chip and SPMD rungs alike), colocated with the
+    singleflight protocol so the two halves of the miss-handling policy
+    cannot drift: a SEEN family whose table bucket changed (growth /
+    replacement) rebuilds and compiles on the background thread while the
+    triggering query serves on a lower rung, instead of paying a
+    foreground XLA compile on the serving path.
+
+    ``build_and_warm()`` constructs the pipeline, runs it once to compile,
+    drops its table refs, and returns it; it executes under the captured
+    per-query config view and a metrics compile sink.  Returns True when
+    deferred (the caller's build() then declines the rung)."""
+    bg = ctx.background_compiler()
+    if bg is None:
+        return False
+    with ctx._plan_lock:
+        stored = ctx._compiled_families.get(family)
+    if stored is None or stored == bucket:
+        # first sight of the family, or plain LRU eviction of an unchanged
+        # table: foreground compile as before — deferral is only for
+        # actual growth/replacement
+        return False
+    # thread-local per-query config overlays are invisible on the bg
+    # thread; capture the effective view so the rebuild matches its key
+    effective = dict(ctx.config.effective_items())
+
+    def task():
+        try:
+            from .. import observability
+
+            with ctx.config.set(effective), \
+                    observability.compile_sink(ctx.metrics):
+                obj = build_and_warm()
+            with ctx._plan_lock:
+                cache[key] = obj
+                while len(cache) > cache_cap:
+                    cache.popitem(last=False)
+                _remember_family_locked(ctx, family, bucket)
+        except BaseException:
+            # un-mark the family: the next query takes the foreground path
+            # where the ladder/breaker apply their normal failure policy
+            with ctx._plan_lock:
+                ctx._compiled_families.pop(family, None)
+            raise
+
+    task_key = (rung, key)
+    # while the compile is pending, every query of the family keeps
+    # declining (still served on a lower rung) instead of compiling anyway
+    if not bg.pending(task_key) and not bg.submit(task_key, task):
+        return False
+    ctx.metrics.inc("serving.bg_compile.deferred")
+    from ..observability import trace_event
+
+    trace_event(f"bg_compile_deferred:{rung}")
+    logger.debug("%s family bucket changed; compiling in background and "
+                 "serving a lower rung", rung)
+    return True
+
+
 def try_compiled_aggregate(rel: p.Aggregate, executor) -> Optional[Table]:
     """Attempt the compiled path for an Aggregate subtree; None to fall back."""
     if not executor.config.get("sql.compile", True):
@@ -1412,62 +1488,19 @@ def _remember_family_locked(ctx, family: Tuple, bucket: Tuple) -> None:
 
 def _defer_to_background(ctx, rel, key, table, scan, filters, group_exprs,
                          agg_exprs, config, params=()) -> bool:
-    """Background-recompile hook: when this plan FAMILY compiled before but
-    the table's bucket changed (growth / replacement), build-and-compile
-    the new pipeline on the background thread and decline the rung now —
-    the ladder serves this query interpreted instead of paying a foreground
-    XLA compile on the serving path.  Returns True when deferred."""
-    bg = ctx.background_compiler()
-    if bg is None:
-        return False
-    family = _family_of(key)
-    bucket = _bucket_of(key)
-    with ctx._plan_lock:
-        stored = ctx._compiled_families.get(family)
-    if stored is None or stored == bucket:
-        # never compiled here, or same table identity (a plain LRU
-        # eviction): compile in the foreground as before — deferral is
-        # only for actual growth/replacement
-        return False
-    # the triggering thread's config overlays (per-query options, test
-    # scopes) are thread-local and invisible on the bg thread; capture the
-    # effective view now so the rebuilt pipeline matches its cache key
-    effective = dict(ctx.config.effective_items())
+    """Background-recompile hook: the shared `defer_rebuild` policy with
+    this rung's constructor.  Returns True when deferred (the query is
+    served interpreted this time)."""
 
-    def task():
-        try:
-            from .. import observability
+    def build_and_warm():
+        obj = CompiledAggregate(rel, table, scan, filters, group_exprs,
+                                agg_exprs, config)
+        # compiles every kernel with the triggering query's params as
+        # runtime args; result discarded
+        obj.run(table, params)
+        obj.table = None
+        obj._warm = True
+        return obj
 
-            with ctx.config.set(effective):
-                obj = CompiledAggregate(rel, table, scan, filters,
-                                        group_exprs, agg_exprs, config)
-                with observability.compile_sink(ctx.metrics):
-                    # compiles every kernel with the triggering query's
-                    # params as runtime args; result discarded
-                    obj.run(table, params)
-            obj.table = None
-            obj._warm = True
-            with ctx._plan_lock:
-                _cache[key] = obj
-                while len(_cache) > _CACHE_CAP:
-                    _cache.popitem(last=False)
-                _remember_family_locked(ctx, family, bucket)
-        except BaseException:
-            # un-mark the family: the next query takes the foreground path
-            # where the ladder/breaker apply their normal failure policy
-            with ctx._plan_lock:
-                ctx._compiled_families.pop(family, None)
-            raise
-
-    task_key = ("compiled_aggregate", key)
-    # while the compile is pending, every query of the family keeps
-    # declining (still served interpreted) instead of compiling anyway
-    if not bg.pending(task_key) and not bg.submit(task_key, task):
-        return False
-    ctx.metrics.inc("serving.bg_compile.deferred")
-    from ..observability import trace_event
-
-    trace_event("bg_compile_deferred:compiled_aggregate")
-    logger.debug("plan family bucket changed; compiling in background and "
-                 "serving interpreted")
-    return True
+    return defer_rebuild(ctx, "compiled_aggregate", _cache, _CACHE_CAP, key,
+                         _family_of(key), _bucket_of(key), build_and_warm)

@@ -380,7 +380,6 @@ class CompiledJoinAggregate:
         agg_exprs = self.agg_exprs
         gid_join = -1 if self.gid_join is None else self.gid_join
         radix_spec = self.radix_spec
-        segsum_mode = self.segsum_mode
         n_joins = len(self.ext.joins)
         rmins = [rmin for rmin, _ in self.luts]
 
@@ -480,9 +479,9 @@ class CompiledJoinAggregate:
             else:
                 gid = ri_safe[gid_join].astype(jnp.int32)
                 domain = build_domains[gid_join]
-            from .compiled import SegmentReducer, pack_flat
+            from .compiled import pack_flat
 
-            reducer = SegmentReducer(gid, domain, segsum_mode, n_rows)
+            reducer = self._make_reducer(gid, domain, n_rows)
             hit_h = reducer.count(mask)
             outs = segment_agg_outputs(ev, slots, agg_exprs, mask, gid, domain,
                                        reducer)
@@ -500,33 +499,55 @@ class CompiledJoinAggregate:
         build_domains = [bt.num_rows for bt in self.build_tables]
         return fn
 
-    def run(self, params: Tuple = ()) -> Table:
+    def _make_reducer(self, gid, domain: int, n_rows: int):
+        """Reducer factory seam — overridden by the SPMD join rung
+        (spmd/join.py) to combine per-shard partials with collectives."""
+        from .compiled import SegmentReducer
+
+        return SegmentReducer(gid, domain, self.segsum_mode, n_rows)
+
+    def _run_args(self, params: Tuple):
+        """The concrete kernel arguments for one run (shared with the SPMD
+        rung, spmd/join.py): (probe_datas, probe_valids, luts, build_cols,
+        row_valid, params)."""
         pt = self.probe_table
         probe_datas = tuple(pt.columns[n].data for n in pt.column_names)
         probe_valids = tuple(pt.columns[n].validity for n in pt.column_names)
-        from ..parallel import dist_plan as _dp
-
-        if any(_dp.array_is_sharded(d) for d in probe_datas):
-            # SPMD over the sharded probe: GSPMD inserts the all-reduce for
-            # the segment outputs; joined rows never materialize anywhere
-            _dp.STATS["sharded_join_agg"] += 1
         luts = tuple(lut for _, lut in self.luts)
         build_cols = {}
         for (k, col), _slot in self.used_build_slots.items():
             bt = self.build_tables[k]
             c = bt.columns[bt.column_names[col]]
             build_cols[(k, col)] = (c.data, c.validity)
+        return (probe_datas, probe_valids, luts, build_cols, pt.row_valid,
+                tuple(params))
+
+    def run(self, params: Tuple = ()) -> Table:
+        args = self._run_args(params)
+        from ..parallel import dist_plan as _dp
+
+        if any(_dp.array_is_sharded(d) for d in args[0]):
+            # SPMD over the sharded probe: GSPMD inserts the all-reduce for
+            # the segment outputs; joined rows never materialize anywhere
+            _dp.STATS["sharded_join_agg"] += 1
         from ..observability import timed_jit_call
 
-        packed = timed_jit_call("compiled_join_aggregate", self._fn,
-                                probe_datas, probe_valids, luts, build_cols,
-                                pt.row_valid, tuple(params),
+        packed = timed_jit_call("compiled_join_aggregate", self._fn, *args,
                                 may_compile=not self._warm)
         self._warm = True
-        from .compiled import fetch_packed, unpack_row
+        from .compiled import fetch_packed
 
         tags = self._pack_tags
         host, present = fetch_packed(packed, self.domain)
+        return self._decode_result(host, present, tags)
+
+    def _decode_result(self, host, present, tags, build_tables=None) -> Table:
+        from .compiled import unpack_row
+
+        # the SPMD rung passes tables per call (no shared rebinding); the
+        # single-chip path keeps its bound self state
+        if build_tables is None:
+            build_tables = self.build_tables
         is_global = self.radix_spec is None and (self.gid_join is None
                                                  or self.gid_join < 0)
         if is_global and present.shape[0] == 0:
@@ -563,7 +584,7 @@ class CompiledJoinAggregate:
                                                    spec["off"], validity)
             n_groups = len(self.radix_spec)
         elif self.gid_join is not None and self.gid_join >= 0:
-            bt = self.build_tables[self.gid_join]
+            bt = build_tables[self.gid_join]
             for name, col_idx in zip(names, self.group_cols):
                 c = bt.columns[bt.column_names[col_idx]]
                 out[name] = c.take(present)

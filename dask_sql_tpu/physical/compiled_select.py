@@ -178,7 +178,7 @@ class CompiledSelect:
                 # window a static-shape mask refinement.
                 mask = as_rows(mask)
                 skip_i, fetch_i = inner_limit
-                ordinal = jnp.cumsum(mask.astype(jnp.int64))
+                ordinal = self._survivor_ordinal(mask)
                 w = ordinal > skip_i
                 if fetch_i is not None:
                     w &= ordinal <= skip_i + fetch_i
@@ -226,6 +226,7 @@ class CompiledSelect:
                        params_s)
         self._mask_fn_raw = mask_fn
         self._mask_fn = jax.jit(mask_fn)
+        self._gather_fn_raw = gather_fn  # for the SPMD rung's shard_map
         self._gather_fn = jax.jit(gather_fn, static_argnames=("bucket",))
         #: lazily-built vmapped mask variant for the family batcher: ONE
         #: stacked launch evaluates every co-admitted member's filter over
@@ -236,6 +237,13 @@ class CompiledSelect:
         #: gather kernel once per distinct pow2 survivor bucket
         self._mask_warm = False
         self._warm_buckets: set = set()
+
+    def _survivor_ordinal(self, mask):
+        """1-based running survivor count the inner-LIMIT window slices.
+        Local cumsum on a single device; the SPMD rung (spmd/select.py)
+        overrides with a cross-shard prefix so the window stays a GLOBAL
+        row ordinal under shard_map."""
+        return jnp.cumsum(mask.astype(jnp.int64))
 
     def run(self, table: Optional[Table] = None, params: Tuple = ()) -> Table:
         from ..utils import count_d2h
@@ -287,20 +295,13 @@ class CompiledSelect:
                 params: Tuple) -> Table:
         from ..utils import count_d2h
         from ..observability import timed_jit_call
-        from .compiled import unpack_row
 
         # without an ORDER BY, a LIMIT caps how many survivors we even pull:
         # sized nonzero returns ascending indices, so the first `want` rows
         # ARE the eager path's first `want` rows
-        if self.sort_keys is None and self.limit is not None \
-                and self.limit[1] is not None:
-            count = min(count, self.limit[0] + self.limit[1])
-        cols: List[np.ndarray] = []
-        valid_arrs: List[Optional[np.ndarray]] = []
+        count = self._limit_trim(count)
         if count == 0:
-            for name, sql_type, dictionary in self.out_meta:
-                cols.append(np.zeros(0, dtype=sql_to_np(sql_type)))
-                valid_arrs.append(None)
+            host = None
         else:
             bucket = 1 << (count - 1).bit_length()
             # jit re-specializes per bucket: each new bucket is a fresh
@@ -313,16 +314,46 @@ class CompiledSelect:
             self._warm_buckets.add(bucket)
             count_d2h()
             host = np.asarray(jax.device_get(packed))
-            tags = self._pack_tags
-            for i, (name, sql_type, dictionary) in enumerate(self.out_meta):
-                d = unpack_row(host, 2 * i, tags)[:count]
-                v = unpack_row(host, 1 + 2 * i, tags).astype(bool)[:count]
-                target = sql_to_np(sql_type)
-                if d.dtype != target:
-                    d = d.astype(target)
-                cols.append(d)
-                valid_arrs.append(None if bool(v.all()) else v)
+        cols, valid_arrs = self._decode_packed(host, count)
+        return self._assemble(cols, valid_arrs, count)
 
+    def _limit_trim(self, count: int) -> int:
+        """Sort-free LIMIT: survivor indices ascend, so the first `want`
+        rows ARE the eager path's — cap the pull."""
+        if self.sort_keys is None and self.limit is not None \
+                and self.limit[1] is not None:
+            return min(count, self.limit[0] + self.limit[1])
+        return count
+
+    def _decode_packed(self, host: Optional[np.ndarray], count: int):
+        """Packed host matrix -> per-output (data, validity) numpy arrays.
+        `host` is None when there are zero survivors."""
+        from .compiled import unpack_row
+
+        cols: List[np.ndarray] = []
+        valid_arrs: List[Optional[np.ndarray]] = []
+        if count == 0 or host is None:
+            for name, sql_type, dictionary in self.out_meta:
+                cols.append(np.zeros(0, dtype=sql_to_np(sql_type)))
+                valid_arrs.append(None)
+            return cols, valid_arrs
+        tags = self._pack_tags
+        for i, (name, sql_type, dictionary) in enumerate(self.out_meta):
+            d = unpack_row(host, 2 * i, tags)[:count]
+            v = unpack_row(host, 1 + 2 * i, tags).astype(bool)[:count]
+            target = sql_to_np(sql_type)
+            if d.dtype != target:
+                d = d.astype(target)
+            cols.append(d)
+            valid_arrs.append(None if bool(v.all()) else v)
+        return cols, valid_arrs
+
+    def _assemble(self, cols: List[np.ndarray],
+                  valid_arrs: List[Optional[np.ndarray]],
+                  count: int) -> Table:
+        """Host-side tail shared with the SPMD rung (spmd/select.py):
+        ORDER BY + window slicing + output naming over decoded survivor
+        columns."""
         # host-side ORDER BY: the same host-numpy sort the engine uses for
         # tiny post-aggregate tables (ops/sorting.sort_permutation — NaN
         # sorts as +inf, NULL placement per nulls_first)
@@ -505,54 +536,18 @@ def try_compiled_select(root, executor) -> Optional[Table]:
 def _defer_to_background(ctx, key, table, scan, upper_filters, scan_filters,
                          proj, proj_exprs, sort_keys, sort_fetch, limit,
                          inner_limit, params=()) -> bool:
-    """Background-recompile hook for root select chains — same policy as
-    physical/compiled.py `_defer_to_background`: a seen family whose table
-    bucket changed compiles off the critical path while this query runs
-    interpreted.  Returns True when deferred."""
-    bg = ctx.background_compiler()
-    if bg is None:
-        return False
-    family = _family_of(key)
-    bucket = _bucket_of(key)
-    with ctx._plan_lock:
-        stored = ctx._compiled_families.get(family)
-    if stored is None or stored == bucket:
-        # never compiled here, or same table identity (plain LRU
-        # eviction): foreground compile as before
-        return False
-    effective = dict(ctx.config.effective_items())
+    """Background-recompile hook for root select chains: the shared
+    `defer_rebuild` policy (physical/compiled.py) with this rung's
+    constructor.  Returns True when deferred."""
+    from .compiled import defer_rebuild
 
-    def task():
-        from .compiled import _remember_family_locked
+    def build_and_warm():
+        obj = CompiledSelect(table, scan, upper_filters, scan_filters,
+                             proj, proj_exprs, sort_keys, sort_fetch,
+                             limit, inner_limit, params)
+        obj.run(table, params)  # compiles mask + first gather
+        obj.table = None
+        return obj
 
-        try:
-            from .. import observability
-
-            with ctx.config.set(effective):
-                obj = CompiledSelect(table, scan, upper_filters,
-                                     scan_filters, proj, proj_exprs,
-                                     sort_keys, sort_fetch, limit,
-                                     inner_limit, params)
-                with observability.compile_sink(ctx.metrics):
-                    obj.run(table, params)  # compiles mask + first gather
-            obj.table = None
-            with ctx._plan_lock:
-                _cache[key] = obj
-                while len(_cache) > _CACHE_CAP:
-                    _cache.popitem(last=False)
-                _remember_family_locked(ctx, family, bucket)
-        except BaseException:
-            with ctx._plan_lock:
-                ctx._compiled_families.pop(family, None)
-            raise
-
-    task_key = ("compiled_select", key)
-    if not bg.pending(task_key) and not bg.submit(task_key, task):
-        return False
-    ctx.metrics.inc("serving.bg_compile.deferred")
-    from ..observability import trace_event
-
-    trace_event("bg_compile_deferred:compiled_select")
-    logger.debug("select family bucket changed; compiling in background "
-                 "and serving interpreted")
-    return True
+    return defer_rebuild(ctx, "compiled_select", _cache, _CACHE_CAP, key,
+                         _family_of(key), _bucket_of(key), build_and_warm)

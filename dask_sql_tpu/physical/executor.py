@@ -56,13 +56,31 @@ class Executor:
         under it.  The `execute` fault-injection site fires here so the
         ServingRuntime's retry/backoff path is testable end to end."""
         from ..resilience import faults, ladder
+        from ..spmd import try_spmd_select
         from .compiled_select import try_compiled_select
 
         ticket = current_ticket()
         if ticket is not None:  # checkpoint before the one-kernel fast path
             ticket.checkpoint()
         faults.maybe_inject("execute", self.config)
+        # cheap pre-check (same gate AggregatePlugin uses): the SPMD rung is
+        # only worth attempting — plan extraction, table lookups, sharding
+        # probes — when the subtree actually scans a mesh-sharded table
+        from ..parallel.dist_plan import plan_has_sharded_scan
+
+        sharded = plan_has_sharded_scan(rel, self.context)
         if self.config.get("resilience.ladder.enabled", True):
+            if sharded:
+                # the SPMD rung sits above the single-chip one (which
+                # declines sharded tables); its failures degrade and
+                # breaker-charge per (family, spmd_select) without
+                # poisoning the family's single-chip rung
+                out = ladder.attempt(
+                    self, "spmd_select",
+                    lambda: try_spmd_select(rel, self),
+                    rel=rel, inject_site="spmd")
+                if out is not None:
+                    return out
             out = ladder.attempt(
                 self, "compiled_select",
                 lambda: try_compiled_select(rel, self),
@@ -72,6 +90,11 @@ class Executor:
             return ladder.execute_interpreted(self, rel)
         # ladder disabled: injection sites still fire (a forced compile
         # fault must propagate here — that is what disabling proves)
+        if sharded:
+            faults.maybe_inject("spmd", self.config)
+            out = try_spmd_select(rel, self)
+            if out is not None:
+                return out
         faults.maybe_inject("compile", self.config)
         out = try_compiled_select(rel, self)
         if out is not None:

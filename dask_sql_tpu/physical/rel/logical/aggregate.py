@@ -61,6 +61,23 @@ class AggregatePlugin(BaseRelPlugin):
         tried_join_pipeline = False
         tried_compiled = False
         if dist_plan.plan_has_sharded_scan(rel.input, executor.context):
+            from ....spmd import try_spmd_aggregate, try_spmd_join_aggregate
+
+            # SPMD rungs first (spmd/, docs/spmd.md): explicit shard_map
+            # programs with psum/pmin/pmax tree-reduced partial states and
+            # broadcast build sides.  Each is its own (family, rung)
+            # breaker entity — an induced SPMD failure degrades to the
+            # single-chip compiled rungs below without poisoning them.
+            spmd_joined = rung("spmd_join_aggregate",
+                               lambda: try_spmd_join_aggregate(rel, executor),
+                               inject="spmd")
+            if spmd_joined is not None:
+                return spmd_joined
+            spmd_agg = rung("spmd_aggregate",
+                            lambda: try_spmd_aggregate(rel, executor),
+                            inject="spmd")
+            if spmd_agg is not None:
+                return spmd_agg
             joined = rung("compiled_join_aggregate",
                           lambda: try_compiled_join_aggregate(rel, executor),
                           inject="compile")

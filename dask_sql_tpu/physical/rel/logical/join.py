@@ -280,9 +280,11 @@ class JoinPlugin(BaseRelPlugin):
                     or (broadcast not in (None, False)
                         and small <= float(broadcast)))
         auto = broadcast is None and small <= 65536 and small * 4 <= big
+        metrics = executor.context.metrics
         if explicit or auto:
             # never declines: unique-dense keys take the LUT, everything
             # else (string-keyed, duplicate, sparse) the sorted probe
+            metrics.inc("parallel.dist.broadcast_join")
             if right.num_rows <= left.num_rows:
                 return dist_plan.broadcast_inner_pairs(lgid, lvalid,
                                                        rgid, rvalid)
@@ -291,6 +293,7 @@ class JoinPlugin(BaseRelPlugin):
             lmatch = np.zeros(left.num_rows, dtype=bool)
             lmatch[np.asarray(li)] = True
             return li, ri, lmatch
+        metrics.inc("parallel.dist.join_kernel")
         return dist_plan.dist_inner_pairs(mesh, lgid, lvalid, rgid, rvalid)
 
 

@@ -14,6 +14,10 @@
 Sites wired through the engine (each raises the matching taxonomy error):
 
     compile     entry of the compiled planners (CompileError)
+    spmd        entry of the SPMD sharded rungs only (spmd_select /
+                spmd_aggregate / spmd_join_aggregate) — proves the
+                sharded->single-chip step-down without touching the
+                single-chip rungs (ResourceExhaustedError)
     oom         inside a compiled rung's device execution
                 (ResourceExhaustedError)
     exec_oom    the interpreted per-op path (ResourceExhaustedError — proves
@@ -69,6 +73,7 @@ class InjectedWriteError(InjectedFault, ExecutionError):
 #: site -> error class raised when the site arms
 SITE_ERRORS = {
     "compile": InjectedCompileError,
+    "spmd": InjectedOomError,
     "oom": InjectedOomError,
     "exec_oom": InjectedOomError,
     "execute": InjectedTransientError,

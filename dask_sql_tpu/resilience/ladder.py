@@ -21,8 +21,14 @@ module makes stepping down an explicit, observable policy:
   re-executes the plan on the CPU backend — host DRAM instead of HBM —
   before giving up.
 
-Rung names wired through the engine:
+Rung names wired through the engine (sharded SPMD rungs sit ABOVE their
+single-chip counterparts and fire only for mesh-sharded scans; each is its
+own breaker entity per (family, rung), so a flaky SPMD path degrades to
+single-chip without poisoning the family):
 
+    spmd_select             spmd/select.py shard_map root select chain
+    spmd_aggregate          spmd/aggregate.py psum tree-reduce aggregation
+    spmd_join_aggregate     spmd/join.py broadcast-join SPMD pipeline
     compiled_select         physical/compiled_select.py one-kernel root chain
     compiled_join_aggregate physical/compiled_join.py scan->joins->aggregate
     compiled_aggregate      physical/compiled.py whole-pipeline aggregate jit
@@ -141,6 +147,10 @@ def attempt(executor, rung: str, fn: Callable[[], Optional[T]],
         return None
     if out is not None:
         metrics.inc(f"resilience.rung.{rung}")
+        if rung.startswith("spmd_"):
+            # the acceptance-visible marker that a query executed on a
+            # sharded rung: a zero-duration span with spmd attrs
+            trace_event(f"rung:{rung}", rung=rung, spmd=True)
         if key is not None:
             breaker.record_success(key)
     return out

@@ -53,6 +53,18 @@ DOCUMENTED_METRICS = frozenset({
     "serving.batch.queries",
     "serving.batch.solo",
     "serving.batch.size",
+    # parallel/ + spmd/ — sharded storage, SPMD rungs, collectives engine.
+    # The parallel.dist.* names are the registry-visible counters of the
+    # dist_* kernel launches that historically lived only in the module
+    # STATS dict (predating the registry); parallel.spmd.* cover the
+    # sharded compiled rungs and the auto-shard registration policy.
+    "parallel.auto_shard.tables",
+    "parallel.spmd.launches",
+    "parallel.spmd.rows",
+    "parallel.dist.agg_kernel",
+    "parallel.dist.sort_kernel",
+    "parallel.dist.join_kernel",
+    "parallel.dist.broadcast_join",
     # observability/ — lifecycle tracing + slow-query log
     "observability.slow_query",
     # planner

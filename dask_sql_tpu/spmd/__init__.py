@@ -1,0 +1,40 @@
+"""SPMD query execution: device-sharded storage + sharded compiled rungs.
+
+The subsystem that turns the mesh from a proven-but-idle capability
+(`parallel/`'s 8-device suite) into the serving path's first-class compiled
+tier (ROADMAP item 1, docs/spmd.md):
+
+- `storage` — ``parallel.auto_shard``: row-shard eligible registrations
+  over the default mesh at create_table/load time, preserving DICT/FOR
+  encodings;
+- `select` / `aggregate` / `join` — the ``spmd_select`` /
+  ``spmd_aggregate`` / ``spmd_join_aggregate`` degradation-ladder rungs:
+  shard_map SPMD programs sharing the single-chip compiled pipelines'
+  traced bodies, with psum/pmin/pmax tree-reduced aggregation states and
+  broadcast build sides;
+- `core` — the shard_map wrapping shared by the rungs.
+
+Each rung sits ABOVE its single-chip counterpart in the ladder and is
+breaker-isolated per (family, rung): a flaky SPMD path degrades to the
+single-chip compiled rung without poisoning the family.
+"""
+from .aggregate import SpmdAggregate, try_spmd_aggregate
+from .core import mesh_of_sharded_table, rung_enabled, spmd_enabled
+from .join import SpmdJoinAggregate, try_spmd_join_aggregate
+from .select import SpmdSelect, try_spmd_select
+from .storage import auto_shard_enabled, maybe_auto_shard, truthy_option
+
+__all__ = [
+    "SpmdAggregate",
+    "SpmdJoinAggregate",
+    "SpmdSelect",
+    "auto_shard_enabled",
+    "maybe_auto_shard",
+    "mesh_of_sharded_table",
+    "rung_enabled",
+    "spmd_enabled",
+    "truthy_option",
+    "try_spmd_aggregate",
+    "try_spmd_join_aggregate",
+    "try_spmd_select",
+]

@@ -1,0 +1,280 @@
+"""spmd_aggregate: the sharded compiled scan->aggregate rung.
+
+One `shard_map` SPMD executable per plan family: every device computes the
+radix-gid partial aggregation states over ITS row block (the same traced
+body as the single-chip `CompiledAggregate` — same masks, same radix plan,
+same finalize arithmetic), and the per-shard partial states tree-reduce
+across the mesh with `psum`/`pmin`/`pmax` collectives before the shared
+finalize assembles outputs.  This is the reference engine's
+partial->shuffle->final aggregation tree (Dask `split_out`, PAPER.md layer
+4) expressed as XLA collectives (TQP arXiv:2203.01877), compiled into ONE
+native program per family (Flare arXiv:1703.08219).
+
+Because the cross-device combine happens on the RAW reduction states (sums,
+counts, mins, maxes) and the finalize code is literally shared with the
+single-chip rung, results are bit-equal to the unsharded path whenever the
+partial sums are exact (always for ints/counts/min/max; for floats up to
+addition-order rounding).  ParamRefs stay traced runtime arguments, so the
+second literal variant of a family pays zero foreground compiles, and the
+family batcher's stacked launches vmap over the leading parameter axis of
+the same SPMD program.
+"""
+from __future__ import annotations
+
+import logging
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from ..columnar.table import Table
+from ..parallel.mesh import AXIS
+from ..physical.compiled import (
+    CompiledAggregate,
+    SegmentReducer,
+    _extract_chain,
+    _Unsupported,
+    defer_rebuild,
+    fetch_packed,
+    singleflight_get_or_build,
+)
+from ..planner import plan as p
+from .core import ColumnSpmdWrap, mesh_key, mesh_of_sharded_table, rung_enabled
+
+logger = logging.getLogger(__name__)
+
+
+class SpmdSegmentReducer(SegmentReducer):
+    """SegmentReducer whose reductions combine across the mesh.
+
+    Scatter-mode only (the vmap-clean mode, and the one whose raw states
+    are collective-combinable): every segment sum/count psums, min/max
+    pmin/pmax — so `segment_agg_outputs`' finalize phase runs on GLOBAL
+    states and stays byte-for-byte the single-chip code path."""
+
+    def __init__(self, gid, domain: int, n_rows: int):
+        super().__init__(gid, domain, "scatter", n_rows)
+
+    def _scatter(self, x):
+        return jax.lax.psum(super()._scatter(x), AXIS)
+
+    def seg_min(self, contrib):
+        kind, red = super().seg_min(contrib)
+        return (kind, jax.lax.pmin(red, AXIS))
+
+    def seg_max(self, contrib):
+        kind, red = super().seg_max(contrib)
+        return (kind, jax.lax.pmax(red, AXIS))
+
+
+class SpmdAggregate(CompiledAggregate):
+    """CompiledAggregate over a mesh-sharded table: the same traced kernel
+    body, mapped per-shard with explicit collective state combines."""
+
+    def __init__(self, mesh, agg: p.Aggregate, table: Table, scan, filters,
+                 group_exprs, agg_exprs):
+        self.mesh = mesh
+        # config=None keeps segsum_mode "scatter" — the only mode whose raw
+        # states psum/pmin/pmax-combine (and the batcher-vmappable one)
+        super().__init__(agg, table, scan, filters, group_exprs, agg_exprs,
+                         config=None)
+        names = table.column_names
+        self._wrap = ColumnSpmdWrap(
+            self._fn_raw, mesh,
+            valid_present=[table.columns[n].validity is not None
+                           for n in names],
+            has_row_valid=table.row_valid is not None,
+            n_params=0,  # rebuilt lazily once the param arity is known
+            out_specs=(jax.sharding.PartitionSpec(None, None)),
+            check_rep=False)
+        self._wraps: Dict[int, ColumnSpmdWrap] = {0: self._wrap}
+        self._batched_jit = None
+
+    def _make_reducer(self, gid, domain: int, n_rows: int) -> SegmentReducer:
+        return SpmdSegmentReducer(gid, domain, n_rows)
+
+    def _wrap_for(self, n_params: int) -> ColumnSpmdWrap:
+        w = self._wraps.get(n_params)
+        if w is None:
+            base = self._wraps[0]
+            w = ColumnSpmdWrap(
+                self._fn_raw, self.mesh, base.valid_present,
+                base.has_row_valid, n_params,
+                out_specs=(jax.sharding.PartitionSpec(None, None)),
+                check_rep=False)
+            self._wraps[n_params] = w
+        return w
+
+    def run(self, table: Optional[Table] = None, params: Tuple = ()) -> Table:
+        from ..observability import timed_jit_call
+
+        table = table if table is not None else self.table
+        datas = [table.columns[n].data for n in table.column_names]
+        valids = [table.columns[n].validity for n in table.column_names]
+        wrap = self._wrap_for(len(params))
+        args = wrap.pack_args(datas, valids, table.row_valid, params)
+        packed = timed_jit_call("spmd_aggregate", wrap.jitted, *args,
+                                may_compile=not self._warm)
+        self._warm = True
+        tags = self._pack_tags
+        host, present = fetch_packed(packed, self.domain)
+        return self._decode(host, present, tags)
+
+    def run_batched(self, table: Table, params_list: List[Tuple]
+                    ) -> List[Table]:
+        """Family-batched stacked launch: the member literal vectors stack
+        along a new leading axis and ONE vmapped SPMD program evaluates
+        every member over a single sharded scan."""
+        from ..families import stack_params
+        from ..observability import timed_jit_call
+        from ..utils import count_d2h
+
+        n = len(params_list)
+        stacked, bucket = stack_params(params_list)
+        wrap = self._wrap_for(len(params_list[0]))
+        if self._batched_jit is None:
+            self._batched_jit = jax.jit(
+                jax.vmap(wrap.mapped, in_axes=(None, None, None, 0)))
+        datas = [table.columns[n_].data for n_ in table.column_names]
+        valids = [table.columns[n_].validity for n_ in table.column_names]
+        args = wrap.pack_args(datas, valids, table.row_valid, stacked)
+        packed = timed_jit_call("spmd_aggregate", self._batched_jit, *args,
+                                may_compile=bucket not in self._warm_batch)
+        self._warm_batch.add(bucket)
+        tags = self._pack_tags
+        count_d2h()
+        host_all = np.asarray(jax.device_get(packed))  # (bucket, R, domain)
+        out = []
+        for b in range(n):
+            host = host_all[b]
+            present = np.nonzero(host[0] != 0.0)[0]
+            out.append(self._decode(host[:, present], present, tags))
+        return out
+
+
+# bounded cache of compiled SPMD aggregate pipelines, keyed like the
+# single-chip cache plus the mesh device tuple
+_CACHE_CAP = 16
+_cache: "OrderedDict[Tuple, SpmdAggregate]" = OrderedDict()
+
+
+def _family_of(key: Tuple) -> Tuple:
+    # drop table identity: uid (index 2) and the trailing row buckets
+    return key[:2] + key[3:-2]
+
+
+def _bucket_of(key: Tuple) -> Tuple:
+    return (key[2], key[-2], key[-1])  # (uid, num_rows, padded_rows)
+
+
+def _defer_to_background(ctx, mesh, rel, key, table, scan, filters,
+                         group_exprs, agg_exprs, params=()) -> bool:
+    """Background-recompile hook — the shared `defer_rebuild` policy
+    (physical/compiled.py) with this rung's constructor; True = deferred."""
+
+    def build_and_warm():
+        obj = SpmdAggregate(mesh, rel, table, scan, filters, group_exprs,
+                            agg_exprs)
+        obj.run(table, params)  # compile; result discarded
+        obj.table = None
+        obj._warm = True
+        return obj
+
+    return defer_rebuild(ctx, "spmd_aggregate", _cache, _CACHE_CAP, key,
+                         _family_of(key), _bucket_of(key), build_and_warm)
+
+
+def try_spmd_aggregate(rel: p.Aggregate, executor) -> Optional[Table]:
+    """Attempt the sharded SPMD path for an Aggregate subtree; None falls
+    down the ladder (single-chip compiled rungs, then the all_to_all
+    collectives engine)."""
+    if not executor.config.get("sql.compile", True):
+        return None
+    if not rung_enabled(executor.config, "spmd_aggregate"):
+        return None
+    chain = _extract_chain(rel)
+    if chain is None:
+        return None
+    scan, filters, group_exprs, agg_exprs = chain
+    try:
+        ctx = executor.context
+        from ..datacontainer import LazyParquetContainer
+
+        dc = ctx.schema[scan.schema_name].tables.get(scan.table_name)
+        if dc is None or isinstance(dc, LazyParquetContainer):
+            return None
+        table = executor.get_table(scan.schema_name, scan.table_name)
+        if scan.projection is not None:
+            table = table.select(scan.projection)
+        mesh = mesh_of_sharded_table(table)
+        if mesh is None:
+            return None
+        from .. import families
+
+        pz = families.pipeline_parameterizer(executor.config)
+        filters = [pz.rewrite(f) for f in filters]
+        agg_exprs = [pz.rewrite_agg(a) for a in agg_exprs]
+        params = pz.params
+        key = (
+            "spmd_aggregate",
+            mesh_key(mesh),
+            dc.uid,
+            scan.schema_name, scan.table_name,
+            tuple(scan.projection or ()),
+            tuple(str(f) for f in filters),
+            tuple(str(e) for e in group_exprs),
+            tuple(str(a) for a in agg_exprs),
+            table.num_rows,
+            table.padded_rows,
+        )
+
+        def build():
+            if _defer_to_background(ctx, mesh, rel, key, table, scan,
+                                    filters, group_exprs, agg_exprs, params):
+                return None  # served on a lower rung this time
+            from ..physical.compiled import _remember_family_locked
+
+            obj = SpmdAggregate(mesh, rel, table, scan, filters,
+                                group_exprs, agg_exprs)
+            obj.table = None  # never pin the construction table's HBM
+            with ctx._plan_lock:
+                _cache[key] = obj
+                while len(_cache) > _CACHE_CAP:
+                    _cache.popitem(last=False)
+                _remember_family_locked(ctx, _family_of(key),
+                                        _bucket_of(key))
+            return obj
+
+        compiled, built_here = singleflight_get_or_build(ctx, _cache, key,
+                                                         build)
+        if compiled is None:
+            return None
+        if not built_here and params:
+            ctx.metrics.inc("families.hit")
+            from ..observability import trace_event
+
+            trace_event("family_hit", rung="spmd_aggregate",
+                        params=len(params))
+        ctx.metrics.inc("parallel.spmd.launches")
+        ctx.metrics.inc("parallel.spmd.rows", table.num_rows)
+        from ..resilience import faults
+
+        faults.maybe_inject("oom", executor.config)
+        batcher = families.batcher_of(ctx)
+        if batcher is not None and params and compiled.batchable:
+            result = batcher.run(
+                key, params,
+                solo=lambda: compiled.run(table, params),
+                batched=lambda members: compiled.run_batched(table, members))
+        else:
+            result = compiled.run(table, params)
+        return result
+    except _Unsupported as e:
+        logger.debug("spmd aggregate unsupported: %s", e)
+        return None
+    except (ValueError, TypeError, NotImplementedError) as e:
+        # a shape the shard_map wrap mis-handles must never sink the query
+        # — the single-chip rungs below are always correct
+        logger.debug("spmd aggregate declined: %s", e)
+        return None

@@ -1,0 +1,71 @@
+"""Sharded storage at registration: the `parallel.auto_shard` policy.
+
+`create_table(..., distributed=True)` (and the `CREATE TABLE ... WITH
+(distributed=...)` passthrough) has always sharded explicitly; this module
+adds the POLICY layer: with ``parallel.auto_shard`` on, every eligible
+registration row-shards over the default mesh automatically, so the SPMD
+rungs fire for plain `create_table` calls without per-table opt-in.
+
+Eligibility: a device-resident (non-lazy) table of at least
+``parallel.auto_shard.min_rows`` rows, on a process whose default mesh has
+two or more devices, that is not already sharded.  `shard_table` preserves
+DICT/FOR encodings, so sharded storage keeps the compressed-domain wins —
+exchanges move codes, not values.
+"""
+from __future__ import annotations
+
+import logging
+
+logger = logging.getLogger(__name__)
+
+
+def truthy_option(value) -> bool:
+    """Normalize a create_table kwarg that may arrive as a SQL WITH literal
+    (bool, number, or string) — a string ``'false'`` must not shard."""
+    if isinstance(value, str):
+        return value.strip().lower() in ("true", "1", "on", "yes")
+    return bool(value)
+
+
+def auto_shard_enabled(config) -> bool:
+    mode = str(config.get("parallel.auto_shard", "off")).lower()
+    return mode in ("on", "auto", "true", "1")
+
+
+def maybe_auto_shard(dc, config, metrics=None):
+    """Apply the auto-shard policy to a freshly built DataContainer;
+    returns the (possibly sharded) container.  Never raises: a sharding
+    failure keeps the single-device registration (policy, not contract)."""
+    if not auto_shard_enabled(config):
+        return dc
+    from ..datacontainer import LazyParquetContainer
+
+    if isinstance(dc, LazyParquetContainer):
+        return dc  # lazy scans keep IO pushdown; shard on materialization
+    table = getattr(dc, "table", None)
+    if table is None:
+        return dc
+    min_rows = int(config.get("parallel.auto_shard.min_rows", 32768) or 0)
+    if table.num_rows < min_rows:
+        return dc
+    try:
+        from ..parallel.dist_plan import table_is_sharded
+        from ..parallel.distribute import shard_table
+        from ..parallel.mesh import default_mesh
+
+        if table_is_sharded(table):
+            return dc
+        mesh = default_mesh()
+        if mesh.devices.size < 2:
+            return dc
+        dc.table = shard_table(table, mesh)
+        if metrics is not None:
+            metrics.inc("parallel.auto_shard.tables")
+        logger.debug("auto-sharded registration over %d devices",
+                     mesh.devices.size)
+    except Exception:  # dsql: allow-broad-except — policy layer: a backend
+        # without a mesh (or a mid-teardown runtime) keeps the registration
+        # single-device rather than failing CREATE TABLE
+        logger.warning("auto_shard failed; keeping single-device table",
+                       exc_info=True)
+    return dc
