@@ -742,6 +742,175 @@ def run_lint_smoke():
         raise SystemExit(1)
 
 
+def run_schedule_smoke():
+    """`bench.py --schedule`: packing-scheduler smoke, exit 1 on violation.
+
+    Mixed interactive+batch workload against a device budget that fits one
+    batch working set plus three interactive ones (floors from the REAL
+    estimator via `Context.cost_hint`):
+
+    1. *FIFO baseline* — `serving.scheduler.enabled=false` with ONE worker:
+       absent byte-aware packing, serial execution is the only provably
+       safe concurrency under a device budget, so this is the conservative
+       operator config the scheduler replaces.  Interactive queries queue
+       behind the batch scan (head-of-line blocking).
+    2. *Packing scheduler* — 4 workers, same budget: the batch scan and
+       interactive queries run CONCURRENTLY (`serving.scheduler.packed`
+       >= 1) because their floors fit, and interactive p95 latency must be
+       strictly below the FIFO baseline measured in this same process.
+    3. *Tenant quotas* — a greedy tenant flooding the queue must not starve
+       a victim tenant (victim completes within the leading completions)
+       while every greedy query still succeeds.
+    """
+    import json as _json
+
+    _ensure_backend()
+    import jax
+
+    from dask_sql_tpu import Context
+    from dask_sql_tpu.serving import QueryCost, ServingRuntime
+    from dask_sql_tpu.serving.metrics import nearest_rank
+
+    df = gen_lineitem(400_000, seed=0)
+    c = Context()
+    # result cache off: every interactive repeat must EXECUTE (the smoke
+    # measures scheduling, not cache lookups)
+    c.config.update({"serving.cache.enabled": False})
+    c.create_table("lineitem", df)
+    # the interactive working set is a small dimension table — the classic
+    # mixed workload: dashboards hitting point lookups while one report
+    # scans the fact table
+    c.create_table("dim", gen_lineitem(20_000, seed=1))
+    # the batch scan: a multi-branch report (UNION ALL of q1-shaped
+    # aggregates) — many kernel launches, so packed interactive queries
+    # interleave BETWEEN launches.  (A single fused kernel is
+    # non-preemptible on any backend: packing overlaps queue wait and
+    # host work, it cannot preempt a running launch.)
+    batch_q = " UNION ALL ".join(
+        f"SELECT l_returnflag, SUM(l_extendedprice * {1.0 + i / 10}) AS s, "
+        f"AVG(l_quantity) AS q FROM lineitem "
+        f"WHERE l_discount > 0.0{i} GROUP BY l_returnflag"
+        for i in range(1, 9))
+    inter_q = ("SELECT l_returnflag, l_extendedprice FROM dim "
+               "WHERE l_extendedprice > 99000.0 LIMIT 20")
+    # pre-warm: compile both families and populate plan cache + profiles
+    # (cost_hint reads both; the smoke measures warm serving, not compiles)
+    c.sql(batch_q, return_futures=False)
+    c.sql(inter_q, return_futures=False)
+    batch_cost = c.cost_hint(batch_q)
+    inter_cost = c.cost_hint(inter_q)
+    costs_ok = (batch_cost is not None and inter_cost is not None
+                and batch_cost.bytes_lo > 0 and inter_cost.bytes_lo > 0)
+    # the acceptance budget: one batch + three interactive provable floors
+    budget = (batch_cost.bytes_lo + 3 * inter_cost.bytes_lo
+              + (1 << 20)) if costs_ok else None
+
+    def run_phase(runtime, n_inter=6):
+        """One batch scan, then n interactive arrivals DURING it (the
+        head-of-line shape: the report is already on the device when the
+        dashboards land); returns interactive submit->completion seconds."""
+        import threading as _threading
+
+        done_at = {}
+        batch_running = _threading.Event()
+
+        def work(q, started=None):
+            def fn(_t):
+                if started is not None:
+                    started.set()
+                c.sql(q, return_futures=False)
+                return q
+            return fn
+
+        futs = []
+        _, bf, _ = runtime.submit(work(batch_q, batch_running),
+                                  priority_class="batch", cost=batch_cost)
+        batch_running.wait(60)
+        t0s = []
+        for i in range(n_inter):
+            t0 = time.perf_counter()
+            qid, f, _ = runtime.submit(work(inter_q), cost=inter_cost)
+            f.add_done_callback(
+                lambda _f, qid=qid: done_at.__setitem__(
+                    qid, time.perf_counter()))
+            t0s.append((qid, t0))
+            futs.append(f)
+        bf.result(300)
+        for f in futs:
+            f.result(300)
+        return [done_at[qid] - t0 for qid, t0 in t0s]
+
+    # -- phase 1: FIFO baseline (the byte-safe serial config) -------------
+    rt_fifo = ServingRuntime(workers=1, metrics=c.metrics,
+                             scheduler_enabled=False)
+    fifo_lat = run_phase(rt_fifo)
+    rt_fifo.shutdown(wait=True)
+    fifo_p95 = nearest_rank(sorted(fifo_lat), 0.95)
+
+    # -- phase 2: packing scheduler, same budget, same process ------------
+    # workers exceed what the budget admits: concurrency is bounded by the
+    # PACKER (batch + 3 interactive floors fit -> two packing waves for
+    # the 6 interactive arrivals), not by the pool size
+    rt_sched = ServingRuntime(workers=8, metrics=c.metrics,
+                              scheduler_budget_bytes=budget)
+    sched_lat = run_phase(rt_sched)
+    rt_sched.shutdown(wait=True)
+    sched_p95 = nearest_rank(sorted(sched_lat), 0.95)
+    packed = c.metrics.counter("serving.scheduler.packed")
+
+    # -- phase 3: tenant quotas under contention --------------------------
+    import threading as _threading
+
+    rt_q = ServingRuntime(workers=2, metrics=c.metrics,
+                          tenant_rate=0.001, tenant_burst=1)
+    completions = []
+    # hold both workers until the whole mixed backlog is queued, so the
+    # scheduler (not submission timing) decides the order
+    hold = _threading.Event()
+    held = _threading.Semaphore(0)
+    holders = [rt_q.submit(
+        lambda t: (held.release(), hold.wait(30)))[1] for _ in range(2)]
+    held.acquire()
+    held.acquire()
+    greedy_futs = [rt_q.submit(
+        lambda t, i=i: completions.append(f"greedy{i}") or i,
+        cost=QueryCost(tenant="greedy", pred_exec_ms=1.0))[1]
+        for i in range(6)]
+    victim_fut = rt_q.submit(
+        lambda t: completions.append("victim") or "v",
+        cost=QueryCost(tenant="victim", pred_exec_ms=1.0))[1]
+    hold.set()
+    greedy_ok = all(f.result(60) == i
+                    for i, f in enumerate(greedy_futs))
+    victim_ok = victim_fut.result(60) == "v" \
+        and "victim" in completions[:3]
+    for f in holders:
+        f.result(60)
+    rt_q.shutdown(wait=True)
+
+    ok = (costs_ok and packed >= 1 and sched_p95 < fifo_p95
+          and greedy_ok and victim_ok)
+    print(_json.dumps({
+        "metric": "packing_scheduler_smoke",
+        "backend": jax.default_backend(),
+        "ok": bool(ok),
+        "budget_bytes": budget,
+        "batch_floor_bytes": None if batch_cost is None
+        else batch_cost.bytes_lo,
+        "interactive_floor_bytes": None if inter_cost is None
+        else inter_cost.bytes_lo,
+        "fifo_interactive_p95_ms": round(fifo_p95 * 1000, 2),
+        "sched_interactive_p95_ms": round(sched_p95 * 1000, 2),
+        "packed_dispatches": packed,
+        "quota_throttled": c.metrics.counter(
+            "serving.scheduler.quota_throttled"),
+        "greedy_all_succeeded": bool(greedy_ok),
+        "victim_not_starved": bool(victim_ok),
+    }), flush=True)
+    if not ok:
+        raise SystemExit(1)
+
+
 def main():
     import sys
 
@@ -768,6 +937,9 @@ def main():
         return
     if "--spmd" in sys.argv:
         run_spmd_smoke()
+        return
+    if "--schedule" in sys.argv:
+        run_schedule_smoke()
         return
 
     import jax

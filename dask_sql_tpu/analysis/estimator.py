@@ -193,6 +193,12 @@ class PlanEstimate:
     #: shedding queries that fit the mesh) — upper bounds stay global,
     #: which is conservative either way
     devices: int = 1
+    #: True when profile feedback tightened the UPPER bounds
+    #: (`apply_feedback`): his are then empirical *predictions* (observed
+    #: family history x a safety margin), no longer worst-case claims.
+    #: Lower bounds are untouched — they stay provable, so the admission
+    #: shed and every rung proof keep their soundness regardless
+    feedback: bool = False
 
     def format_rows(self) -> List[str]:
         rows = [
@@ -207,6 +213,9 @@ class PlanEstimate:
         if self.devices > 1:
             rows.insert(1, f"mesh: devices={self.devices} "
                            "(sharded scans budgeted per device)")
+        if self.feedback:
+            rows.insert(1, "feedback: upper bounds tightened from observed "
+                           "family history (lower bounds stay provable)")
         for n in self.nodes:
             if n.scratch_hi is None:
                 # the node whose transients made bytes_hi unbounded must be
@@ -616,6 +625,70 @@ def collect_rung_proofs(verdict: PlanEstimate, budget: Optional[int]
     return [(node, _AGG_RUNGS, matrix_lo)
             for node, matrix_lo in getattr(verdict, "_agg_intermediates", [])
             if matrix_lo > budget]
+
+
+def _tighten(iv: Interval, pred_hi: int) -> Interval:
+    """One feedback-tightened interval: the upper bound drops to the
+    prediction but NEVER below the provable lower bound, and the lower
+    bound is untouched — the two invariants that keep feedback safe."""
+    hi = pred_hi if iv.hi is None else min(iv.hi, pred_hi)
+    return Interval(iv.lo, max(iv.lo, hi))
+
+
+def apply_feedback(verdict: PlanEstimate, profile: Optional[dict],
+                   config, metrics=None) -> PlanEstimate:
+    """Profile-feedback priors (``analysis.estimate.feedback``): tighten a
+    verdict's UPPER bounds from the family's observed history — closing the
+    loop from PR 5's profiles back into the estimator so packing density
+    and rung choice improve under real traffic instead of staying
+    static-analysis-only.
+
+    With at least ``feedback.min_obs`` observed executions:
+
+    - ``rows.hi`` / ``result_bytes.hi`` drop to ``margin x`` the maximum
+      observed output cardinality / result bytes;
+    - ``peak_bytes.hi`` drops to the provable resident floor plus
+      ``margin x`` the observed result footprint — the resident scans are
+      the floor, the materialized intermediates are what history predicts.
+
+    Bounded, never violating provable floors: lower bounds are copied
+    untouched and an upper bound never drops below its lower bound, so the
+    admission shed (lo-gated) and the rung proofs (lo-gated) are provably
+    unaffected.  The returned estimate is a NEW object — the family's
+    memoized static verdict stays pristine so feedback re-applies with
+    fresher history on every later member."""
+    if profile is None or not config.get("analysis.estimate.feedback", True):
+        return verdict
+    min_obs = max(1, int(config.get("analysis.estimate.feedback.min_obs", 2)))
+    margin = max(1.0, float(
+        config.get("analysis.estimate.feedback.margin", 2.0)))
+    obs_rows = profile.get("rows") or []
+    obs_bytes = profile.get("result_bytes") or []
+    rows = verdict.rows
+    result_bytes = verdict.result_bytes
+    peak = verdict.peak_bytes
+    changed = False
+    if len(obs_rows) >= min_obs:
+        tightened = _tighten(rows, int(margin * max(obs_rows)))
+        changed = changed or tightened != rows
+        rows = tightened
+    if len(obs_bytes) >= min_obs:
+        pred_result = int(margin * max(obs_bytes))
+        tightened = _tighten(result_bytes, pred_result)
+        changed = changed or tightened != result_bytes
+        result_bytes = tightened
+        tightened = _tighten(peak, peak.lo + pred_result)
+        changed = changed or tightened != peak
+        peak = tightened
+    if not changed:
+        return verdict
+    if metrics is not None:
+        metrics.inc("analysis.estimate.feedback")
+    import dataclasses
+
+    return dataclasses.replace(verdict, rows=rows,
+                               result_bytes=result_bytes,
+                               peak_bytes=peak, feedback=True)
 
 
 def estimate_and_apply(plan: p.LogicalPlan, context) -> PlanEstimate:

@@ -112,6 +112,15 @@ DEFAULTS: Dict[str, Any] = {
     # compiled_aggregate/compiled_join_aggregate pre-skipped (no attempt,
     # no breaker charge).  None disables the proof.
     "analysis.estimate.device_budget_bytes": None,
+    # Profile-feedback priors (estimator.apply_feedback): tighten a
+    # family's estimate UPPER bounds from its observed output rows /
+    # result bytes (margin x the observed max, after min_obs executions).
+    # Lower bounds are never touched — they stay provable, so the
+    # admission shed and rung proofs keep their soundness; the tightened
+    # his are predictions that improve packing density and drain hints.
+    "analysis.estimate.feedback": True,
+    "analysis.estimate.feedback.margin": 2.0,  # safety multiple over the observed max
+    "analysis.estimate.feedback.min_obs": 2,  # observed executions before feedback applies
     # Parameterized plan families (families/, docs/serving.md "Plan
     # families and batching"): post-optimize literal extraction into a
     # runtime parameter vector.  One XLA executable then serves every
@@ -152,6 +161,27 @@ DEFAULTS: Dict[str, Any] = {
     "serving.warmup.throttle_s": 0.0,  # pause between warm statements (rate-limit boot device load)
     "serving.bg_compile.enabled": False,  # recompile grown/replaced plan families off the critical path
     "serving.bg_compile.max_pending": 8,  # bounded background-compile queue (past it: foreground)
+    # Estimator-driven packing scheduler (serving/scheduler.py,
+    # docs/serving.md "Scheduling and multi-tenancy"): concurrently
+    # admitted queries are packed against the device byte budget using each
+    # family's PROVABLE peak-bytes floor, ordered deadline-first, with
+    # per-tenant token-bucket quotas.  enabled=false restores the plain
+    # FIFO class deques byte-for-byte (pre-scheduler behavior).
+    "serving.scheduler.enabled": True,
+    # device byte budget the packer reserves against; None falls back to
+    # serving.admission.max_estimated_bytes (no budget anywhere = packing
+    # inactive, ordering/quotas still apply)
+    "serving.scheduler.device_budget_bytes": None,
+    # anti-starvation bound on deadline-first ordering: a deadline-free
+    # query sorts as if its deadline were admission + this many seconds,
+    # so deadline-bearing traffic can delay it at most ~this long
+    "serving.scheduler.fair_horizon_s": 30.0,
+    # per-tenant token-bucket refill rate, queries/second (None = quotas
+    # off).  Tenants come from the X-Dsql-Tenant header; an out-of-tokens
+    # tenant is passed over only while OTHER tenants have runnable work
+    # (work-conserving — quotas reorder, they never fail queries).
+    "serving.tenant.rate_qps": None,
+    "serving.tenant.burst": 4.0,  # token-bucket capacity (burst allowance) per tenant
     "serving.cache.enabled": True,  # result cache for repeated identical queries
     "serving.cache.max_bytes": 256 << 20,  # total resident bytes before LRU eviction
     "serving.cache.max_entry_bytes": 64 << 20,  # per-entry cap (huge results bypass the cache)
@@ -169,6 +199,14 @@ DEFAULTS: Dict[str, Any] = {
     # retry/backoff, circuit breaker, fault injection.  docs/resilience.md.
     "resilience.ladder.enabled": True,  # degradable failures step down a rung instead of failing
     "resilience.ladder.cpu_fallback": True,  # last rung: re-execute the plan on the CPU backend
+    # Cost-based rung selection (resilience/ladder.py cost_skip): skip a
+    # compile-bearing rung whose predicted compile cost (observed per-rung
+    # compile_ms p50) exceeds amortize_factor x the family's observed hits
+    # x its observed exec_ms p50 — a choice, not a degradation (no breaker
+    # charge, resilience.degraded untouched).  Evidence-gated: first-seen
+    # families and already-compiled rungs are never skipped.
+    "resilience.ladder.cost_based": True,
+    "resilience.ladder.cost.amortize_factor": 4.0,
     "resilience.retry.max_attempts": 3,  # total tries per query at the serving worker (1 = no retry)
     "resilience.retry.base_s": 0.05,  # first backoff delay, seconds
     "resilience.retry.multiplier": 2.0,  # exponential backoff factor

@@ -191,7 +191,15 @@ class TpuFrame:
                 ctx.profiles.record_exec(
                     fp, sql=sql_text, exec_ms=exec_ms,
                     result_bytes=table_nbytes(self._result),
-                    family=family_fp)
+                    family=family_fp,
+                    rows=self._result.num_rows)
+                est = getattr(self._plan, "_dsql_estimate", None)
+                if est is not None:
+                    # the "estimated" side of SHOW PROFILES' observed-vs-
+                    # estimated pairing, recorded HERE because the entry
+                    # now exists (record_estimate never creates entries)
+                    ctx.profiles.record_estimate(fp, est.rows.hi,
+                                                 family=family_fp)
                 if key is not None:
                     ctx._result_cache.put(key, self._result)
         return self._result
@@ -1052,19 +1060,90 @@ class Context:
                 if cached is not None:
                     plan._dsql_estimate = cached
                     self.metrics.inc("families.estimate.hit")
-                    return cached
+                    return self._feedback_estimate(plan, cached, fam)
             est = estimator.estimate_and_apply(plan, self)
             if key is not None and est is not None:
                 with self._plan_lock:
                     if len(self._family_estimates) >= 512:
                         self._family_estimates.clear()
                     self._family_estimates[key] = est
-            return est
+            return self._feedback_estimate(plan, est, fam)
         except Exception:  # dsql: allow-broad-except — advisory analysis
             self.metrics.inc("analysis.estimate.internal_error")
             logger.debug("plan estimation failed; query runs ungated",
                          exc_info=True)
             return None
+
+    def cost_hint(self, sql: str, config_options=None):
+        """Submit-time `QueryCost` for the packing scheduler
+        (serving/scheduler.py): peek the plan cache for this SQL text — a
+        hit carries the family's memoized estimate (the provable
+        ``peak_bytes`` floor the packer reserves) and the family's observed
+        exec profile (the predicted exec_ms behind drain hints and
+        deadline ordering).  Never parses or plans: submit must stay cheap,
+        so a cold SQL text returns None and the scheduler treats the query
+        as zero-cost (FIFO-equivalent) until its first execution populates
+        the plan cache and profile."""
+        from .serving.scheduler import QueryCost
+
+        try:
+            key = self._plan_cache_key(sql, dict(config_options or {}))
+            if key is None:
+                return None
+            with self._plan_lock:
+                plans = self._plan_cache.get(key)
+            if not plans or len(plans) != 1:
+                return None
+            plan = plans[0]
+            est = getattr(plan, "_dsql_estimate", None)
+            fam = getattr(plan, "_dsql_family", None)
+            fam_fp = fam.fingerprint if fam is not None else None
+            fp = fam_fp
+            if fp is None:
+                from .resilience.ladder import plan_fingerprint
+
+                fp = plan_fingerprint(plan)
+            return QueryCost(
+                bytes_lo=int(est.peak_bytes.lo) if est is not None else 0,
+                pred_exec_ms=self.profiles.predicted_exec_ms(fp),
+                family=fam_fp)
+        except Exception:  # dsql: allow-broad-except — advisory hint: a
+            # lookup bug must degrade to FIFO treatment, never block submit
+            logger.debug("cost hint failed for %r", sql, exc_info=True)
+            return None
+
+    def _feedback_estimate(self, plan, est, fam):
+        """Close the profile-feedback loop on one freshly produced (or
+        family-memoized) estimate: record the static rows upper bound into
+        the family's profile (the "estimated" side SHOW PROFILES pairs with
+        the observed rows), then tighten the estimate's upper bounds from
+        the observed history (`estimator.apply_feedback` — bounded, never
+        below the provable floors).  The memoized static verdict is never
+        mutated, so every later family member re-applies feedback against
+        its own, fresher history."""
+        if est is None:
+            return None
+        try:
+            from .analysis import estimator
+
+            fam_fp = fam.fingerprint if fam is not None else None
+            fp = fam_fp
+            if fp is None:
+                from .resilience.ladder import plan_fingerprint
+
+                fp = plan_fingerprint(plan)
+            self.profiles.record_estimate(fp, est.rows.hi, family=fam_fp)
+            out = estimator.apply_feedback(est, self.profiles.get(fp),
+                                           self.config, self.metrics)
+            plan._dsql_estimate = out
+            return out
+        except Exception:  # dsql: allow-broad-except — feedback is an
+            # advisory sharpening: a bug here must leave the static
+            # verdict in force, never fail the query or EXPLAIN
+            self.metrics.inc("analysis.estimate.internal_error")
+            logger.debug("estimate feedback failed; static verdict kept",
+                         exc_info=True)
+            return est
 
     def _plan_estimate(self, plan):
         """The bind-time `PlanEstimate` riding a plan, or a fresh one when
@@ -1072,6 +1151,13 @@ class Context:
         plans carry theirs; `analysis.estimate = off` disables both)."""
         est = getattr(plan, "_dsql_estimate", None)
         if est is not None:
+            if not est.feedback:
+                # a plan-cached query keeps its bind-time estimate; apply
+                # feedback once history exists so repeated cached traffic
+                # still benefits (one-time tightening — an already-fed-back
+                # estimate is not re-ratcheted against a rolling window)
+                est = self._feedback_estimate(
+                    plan, est, getattr(plan, "_dsql_family", None))
             return est
         if config_module.parse_byte_budget(
                 self.config.get("serving.admission.max_estimated_bytes")) \

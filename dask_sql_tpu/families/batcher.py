@@ -55,13 +55,20 @@ class FamilyBatcher:
     per member, in order."""
 
     def __init__(self, max_queries: int = 8, window_ms: float = 2.0,
-                 metrics=None, busy: Optional[Callable[[], bool]] = None):
+                 metrics=None, busy: Optional[Callable[[], bool]] = None,
+                 mates: Optional[Callable[[], int]] = None):
         self.max_queries = max(1, int(max_queries))
         self.window_s = max(0.0, float(window_ms)) / 1000.0
         self.metrics = metrics
         #: "is any OTHER query in flight right now?" — gates the leader's
         #: window wait so idle traffic pays no batching latency
         self._busy = busy
+        #: packer knowledge (serving/scheduler.py): how many OTHER admitted
+        #: queries share the calling thread's plan family.  A positive
+        #: count means the scheduler co-packed batch-mates — the leader
+        #: waits the window with certainty instead of guessing from the
+        #: in-flight heuristic (0 / None when no scheduler or no family)
+        self._mates = mates
         self._lock = threading.Lock()
         self._groups: Dict[Any, _Group] = {}
 
@@ -113,7 +120,8 @@ class FamilyBatcher:
                 if not group.full.is_set() and self.window_s > grace:
                     with self._lock:
                         joined = len(group.members) > 1
-                    if joined or self._busy is None or self._busy():
+                    if joined or self._copacked() \
+                            or self._busy is None or self._busy():
                         group.full.wait(self.window_s - grace)
             with self._lock:
                 group.closed = True
@@ -145,6 +153,18 @@ class FamilyBatcher:
             group.done.set()
         self._mark_member(len(group.members))
         return group.outputs[0]
+
+    def _copacked(self) -> bool:
+        """True when the packer reports same-family batch-mates admitted
+        alongside the calling thread's query (probe failures read as no)."""
+        if self._mates is None:
+            return False
+        try:
+            return self._mates() > 0
+        except Exception:  # dsql: allow-broad-except — advisory probe: a
+            # scheduler teardown race must not fail the leader's query
+            logger.debug("family-mates probe failed", exc_info=True)
+            return False
 
     def _mark_member(self, size: int) -> None:
         if size > 1:

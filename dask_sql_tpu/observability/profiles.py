@@ -71,7 +71,23 @@ class ProfileStore:
                 "cache_hits": 0,
                 "exec_ms": [],
                 "result_bytes": [],
+                #: observed output cardinalities (rolling) — the feedback
+                #: prior the estimator tightens its upper bounds with
+                #: (analysis/estimator.py apply_feedback)
+                "rows": [],
+                #: the estimator's most recent rows upper bound for this
+                #: family (None = unbounded/never estimated): SHOW PROFILES
+                #: renders it beside the observed rows so operators can see
+                #: where feedback tightened the estimate
+                "est_rows_hi": None,
                 "compile": {},  # rung -> {"count": n, "ms": [rolling]}
+                #: per-ladder-rung exec wall times, surfaced as SHOW
+                #: PROFILES ``rung.<rung>.*`` rows so operators can compare
+                #: what each rung actually costs a family.  (The cost-based
+                #: selector itself decides on the family-level exec history
+                #: plus the global per-rung compile priors — see
+                #: resilience/ladder.py cost_skip.)
+                "rungs": {},  # rung -> {"count": n, "ms": [rolling]}
                 "last_seen": 0.0,
             }
         else:
@@ -90,7 +106,8 @@ class ProfileStore:
                     exec_ms: Optional[float] = None,
                     result_bytes: Optional[int] = None,
                     cache_hit: bool = False,
-                    family: Optional[str] = None) -> None:
+                    family: Optional[str] = None,
+                    rows: Optional[int] = None) -> None:
         with self._lock:
             e = self._entry_locked(fingerprint, sql, family)
             e["hits"] += 1
@@ -102,6 +119,38 @@ class ProfileStore:
             if result_bytes is not None:
                 e["result_bytes"].append(int(result_bytes))
                 del e["result_bytes"][:-self.window]
+            if rows is not None:
+                e["rows"].append(int(rows))
+                del e["rows"][:-self.window]
+
+    def record_rung_exec(self, fingerprint: str, rung: str, ms: float,
+                         family: Optional[str] = None) -> None:
+        """One successful ladder-rung execution for this fingerprint — the
+        per-(family, rung) cost evidence behind cost-based rung selection
+        (resilience/ladder.py `attempt`)."""
+        with self._lock:
+            e = self._entry_locked(fingerprint, None, family)
+            r = e["rungs"].setdefault(rung, {"count": 0, "ms": []})
+            r["count"] += 1
+            r["ms"].append(round(float(ms), 3))
+            del r["ms"][:-self.window]
+
+    def record_estimate(self, fingerprint: str,
+                        rows_hi: Optional[int],
+                        family: Optional[str] = None) -> None:
+        """The estimator's latest rows upper bound for this fingerprint —
+        paired with the observed ``rows`` history in SHOW PROFILES so the
+        estimated-vs-observed gap (what feedback closes) is visible.
+
+        Updates EXISTING entries only (no create, no LRU bump): estimation
+        also runs for EXPLAIN ESTIMATE and never-executed plans, and a
+        nominally read-only statement must not evict hot execution
+        profiles that feed warm-up ordering and drain hints."""
+        with self._lock:
+            e = self._entries.get(fingerprint)
+            if e is None:
+                return
+            e["est_rows_hi"] = None if rows_hi is None else int(rows_hi)
 
     def record_compile(self, fingerprint: str, rung: str, ms: float,
                        sql: Optional[str] = None,
@@ -135,6 +184,22 @@ class ProfileStore:
             if e["result_bytes"]:
                 out.append((fp, fam, "result_bytes.last",
                             str(e["result_bytes"][-1])))
+            # estimated-vs-observed cardinality: where the estimator's
+            # upper bound sits against what the family actually returned
+            # (the gap profile feedback tightens, docs/analysis.md)
+            if e.get("est_rows_hi") is not None:
+                out.append((fp, fam, "rows.est_hi", str(e["est_rows_hi"])))
+            if e.get("rows"):
+                out.append((fp, fam, "rows.observed.last",
+                            str(e["rows"][-1])))
+                out.append((fp, fam, "rows.observed.max",
+                            str(max(e["rows"]))))
+            for rung in sorted(e.get("rungs", {})):
+                r = e["rungs"][rung]
+                out.append((fp, fam, f"rung.{rung}.count", str(r["count"])))
+                if r["ms"]:
+                    out.append((fp, fam, f"rung.{rung}.ms.p50",
+                                _fmt(_percentile(r["ms"], 0.5))))
             for rung in sorted(e["compile"]):
                 r = e["compile"][rung]
                 out.append((fp, fam, f"compile.{rung}.count",
@@ -145,6 +210,19 @@ class ProfileStore:
                     out.append((fp, fam, f"compile.{rung}.ms.max",
                                 _fmt(max(r["ms"]))))
         return out
+
+    def predicted_exec_ms(self, fingerprint: str) -> Optional[float]:
+        """The rolling p50 of observed exec wall times for one fingerprint
+        — the packing scheduler's predicted exec_ms (drain hints, deadline
+        ordering) and the ladder's interpreted-cost prior.  None when the
+        fingerprint has no exec history (an unknown query earns no made-up
+        prediction)."""
+        with self._lock:
+            e = self._entries.get(fingerprint)
+            samples = list(e["exec_ms"]) if e is not None else []
+        if not samples:
+            return None
+        return _percentile(samples, 0.5)
 
     def top_fingerprints(self, n: int = 10) -> List[str]:
         """Hottest fingerprints by hit count — the pre-warm ordering."""
@@ -236,11 +314,23 @@ class ProfileStore:
                                 e.get("exec_ms", [])][-self.window:],
                     "result_bytes": [int(v) for v in
                                      e.get("result_bytes", [])][-self.window:],
+                    # additive since version 2: pre-scheduler snapshots
+                    # simply restore with no observed-rows / rung history
+                    "rows": [int(v) for v in
+                             e.get("rows", [])][-self.window:],
+                    "est_rows_hi": (None if e.get("est_rows_hi") is None
+                                    else int(e["est_rows_hi"])),
                     "compile": {
                         rung: {"count": int(r.get("count", 0)),
                                "ms": [float(v) for v in
                                       r.get("ms", [])][-self.window:]}
                         for rung, r in (e.get("compile") or {}).items()
+                    },
+                    "rungs": {
+                        rung: {"count": int(r.get("count", 0)),
+                               "ms": [float(v) for v in
+                                      r.get("ms", [])][-self.window:]}
+                        for rung, r in (e.get("rungs") or {}).items()
                     },
                     "last_seen": float(e.get("last_seen", 0.0)),
                 }
@@ -253,6 +343,9 @@ def _copy_entry(e: Dict[str, Any]) -> Dict[str, Any]:
     out = dict(e)
     out["exec_ms"] = list(e["exec_ms"])
     out["result_bytes"] = list(e["result_bytes"])
+    out["rows"] = list(e.get("rows", []))
     out["compile"] = {rung: {"count": r["count"], "ms": list(r["ms"])}
                       for rung, r in e["compile"].items()}
+    out["rungs"] = {rung: {"count": r["count"], "ms": list(r["ms"])}
+                    for rung, r in e.get("rungs", {}).items()}
     return out

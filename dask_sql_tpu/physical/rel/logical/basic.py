@@ -385,6 +385,14 @@ class ExplainPlugin(BaseRelPlugin):
             # compiled rungs execution would pre-skip
             est.rung_proofs = estimator.collect_rung_proofs(
                 est, estimator.device_budget_bytes(executor.context.config))
+            # profile feedback under the same family identity execution
+            # uses, so EXPLAIN ESTIMATE shows the bounds the scheduler
+            # actually packs with once the family has observed history
+            from ....families import family_of
+
+            fam = family_of(rel.input, executor.config,
+                            metrics=executor.context.metrics)
+            est = executor.context._feedback_estimate(rel.input, est, fam)
             executor.context.metrics.inc("analysis.explain_estimate")
             lines = np.array(est.format_rows(), dtype=object)
         elif rel.analyze:

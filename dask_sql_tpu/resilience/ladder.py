@@ -41,6 +41,7 @@ from __future__ import annotations
 
 import hashlib
 import logging
+import time
 from typing import Callable, Optional, TypeVar
 
 from ..observability import trace_event
@@ -50,6 +51,11 @@ from . import faults
 logger = logging.getLogger(__name__)
 
 T = TypeVar("T")
+
+#: rung-name prefixes whose FIRST run for a family pays an XLA compile —
+#: the candidates for cost-based selection (interpreted / cpu / dist rungs
+#: never pre-pay a compile worth skipping)
+_COMPILE_RUNG_PREFIXES = ("compiled_", "spmd_")
 
 
 def plan_fingerprint(rel) -> str:
@@ -80,6 +86,63 @@ def _breaker_of(executor):
     if not executor.config.get("resilience.breaker.enabled", True):
         return None
     return getattr(executor.context, "breaker", None)
+
+
+def cost_skip(executor, rung: str, rel) -> bool:
+    """Cost-based rung selection (``resilience.ladder.cost_based``): skip a
+    compile-bearing rung whose predicted compile cost can never amortize
+    for this family — choosing the predicted-cheapest viable rung instead
+    of only skipping provably doomed ones (TQP's cost-model-as-scheduler
+    argument, arXiv:2203.01877).
+
+    The decision is evidence-gated so it can never regress a cold engine:
+
+    - the family must have OBSERVED exec history (it already ran on a lower
+      rung) — a first-seen family always gets its compile attempt;
+    - the rung must not have compiled for this family yet (an existing
+      executable is nearly free to run: never skip it);
+    - a per-rung compile-cost prior must exist — the p50 of the context's
+      ``resilience.compile_ms.<rung>`` history (PR 5's compile histograms);
+      no prior, no claim.
+
+    Skip when ``predicted_compile_ms > amortize_factor * observed_hits *
+    observed_exec_ms_p50``: compiling costs more than running the family
+    the way it already runs `amortize_factor x` its observed popularity.  A
+    family that keeps getting hit grows ``observed_hits`` until the compile
+    amortizes and is then taken — one-shot families never pay it.  A skip
+    is a *choice*, not a failure: no degradation count, no breaker charge
+    (``resilience.degraded`` stays 0)."""
+    try:
+        config = executor.config
+        if not config.get("resilience.ladder.cost_based", True):
+            return False
+        if not rung.startswith(_COMPILE_RUNG_PREFIXES):
+            return False
+        profiles = getattr(executor.context, "profiles", None)
+        if profiles is None:
+            return False
+        entry = profiles.get(_fingerprint_of(executor, rel))
+        if entry is None:
+            return False
+        if entry["compile"].get(rung):
+            return False
+        exec_hist = entry.get("exec_ms") or []
+        if not exec_hist:
+            return False
+        compile_pred = executor.context.metrics.hist_percentile(
+            f"resilience.compile_ms.{rung}", 0.5)
+        if compile_pred is None:
+            return False
+        observed = sorted(exec_hist)[len(exec_hist) // 2]
+        hits = max(1, int(entry.get("hits", 0)))
+        factor = float(
+            config.get("resilience.ladder.cost.amortize_factor", 4.0))
+        return compile_pred > factor * hits * max(observed, 1e-3)
+    except Exception:  # dsql: allow-broad-except — the selector is an
+        # advisory optimization: a bug here must mean "no skip", never a
+        # failed query
+        logger.debug("cost-based rung selection failed open", exc_info=True)
+        return False
 
 
 def attempt(executor, rung: str, fn: Callable[[], Optional[T]],
@@ -117,6 +180,17 @@ def attempt(executor, rung: str, fn: Callable[[], Optional[T]],
             trace_event(f"breaker_skip:{rung}", fingerprint=key[0])
             logger.debug("breaker open for rung %s: skipping", rung)
             return None
+    if rel is not None and cost_skip(executor, rung, rel):
+        # predicted-cost choice, not a failure: the rung is viable, just
+        # predicted more expensive than staying on the rung the family
+        # already runs on — no degradation count, no breaker charge
+        metrics.inc("serving.scheduler.cost_rung_skip")
+        metrics.inc(f"serving.scheduler.cost_rung_skip.{rung}")
+        trace_event(f"cost_rung_skip:{rung}")
+        logger.debug("cost model predicts rung %s cannot amortize: "
+                     "skipping", rung)
+        return None
+    t0 = time.perf_counter()
     try:
         if inject_site is not None:
             faults.maybe_inject(inject_site, executor.config)
@@ -153,6 +227,16 @@ def attempt(executor, rung: str, fn: Callable[[], Optional[T]],
             trace_event(f"rung:{rung}", rung=rung, spmd=True)
         if key is not None:
             breaker.record_success(key)
+        if rel is not None:
+            # per-(family, rung) exec evidence for the cost-based selector
+            # and SHOW PROFILES (wall time includes any compile this rung
+            # paid — that IS the cost a scheduler-visible run charges)
+            profiles = getattr(executor.context, "profiles", None)
+            if profiles is not None:
+                profiles.record_rung_exec(
+                    key[0] if key is not None
+                    else _fingerprint_of(executor, rel),
+                    rung, (time.perf_counter() - t0) * 1000.0)
     return out
 
 

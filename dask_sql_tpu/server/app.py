@@ -12,8 +12,10 @@ shedding (a submit past the bound returns a structured 429 + Retry-After
 through the wire protocol instead of queueing unbounded work), per-query
 deadlines that cancel cooperatively at executor checkpoints, and a metrics
 registry surfaced at /v1/metrics and via ``SHOW METRICS``.  Clients pick a
-concurrency class with the ``X-Dsql-Class: interactive|batch`` header and a
-deadline with ``X-Dsql-Deadline-Ms``.
+concurrency class with the ``X-Dsql-Class: interactive|batch`` header, a
+deadline with ``X-Dsql-Deadline-Ms``, and a tenant (for the packing
+scheduler's token-bucket quotas, serving/scheduler.py) with
+``X-Dsql-Tenant``.
 """
 from __future__ import annotations
 
@@ -120,10 +122,22 @@ class _QueryRegistry:
 
     def submit(self, fn, priority_class: str = "interactive",
                deadline_s: Optional[float] = None,
-               sql: Optional[str] = None) -> str:
+               sql: Optional[str] = None,
+               tenant: str = "") -> str:
         """Admit + enqueue; raises `QueueFullError` (load shed) without
-        registering an entry."""
+        registering an entry.  ``tenant`` (the ``X-Dsql-Tenant`` header)
+        feeds the packing scheduler's per-tenant token buckets; the cost
+        hint (provable byte floor + predicted exec of a plan-cached SQL)
+        feeds its byte packing and drain predictions."""
         qid = str(uuid.uuid4())
+        cost = None
+        if self.context is not None and sql is not None:
+            cost = self.context.cost_hint(sql)
+        if tenant:
+            from ..serving.scheduler import QueryCost
+
+            cost = cost or QueryCost()
+            cost.tenant = tenant
         trace = None
         if self.context is not None and self.context._trace_enabled():
             # the lifecycle trace opens at SUBMIT time, so queue wait is a
@@ -165,7 +179,7 @@ class _QueryRegistry:
             try:
                 _, fut, ticket = self.runtime.submit(
                     run, qid=qid, priority_class=priority_class,
-                    deadline_s=deadline_s)
+                    deadline_s=deadline_s, cost=cost)
             except QueueFullError:
                 self.rejected += 1
                 raise
@@ -329,9 +343,11 @@ def _make_handler(context, registry: _QueryRegistry, jdbc_meta: bool):
                     deadline_s = max(0.0, float(deadline_ms) / 1000.0)
                 except ValueError:
                     deadline_s = None
+            tenant = (self.headers.get("X-Dsql-Tenant") or "").strip()
             try:
                 qid = registry.submit(run, priority_class=priority_class,
-                                      deadline_s=deadline_s, sql=sql)
+                                      deadline_s=deadline_s, sql=sql,
+                                      tenant=tenant)
             except QueueFullError as e:
                 # load shed: structured retry-after error instead of
                 # accepting unbounded work (parity: Trino's 429 + Retry-After)

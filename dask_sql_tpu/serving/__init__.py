@@ -22,6 +22,15 @@ Zero-cold-start additions (docs/serving.md "Cold starts"):
 - :mod:`.background` — the bounded background recompile thread that takes
   bucket-growth recompiles off the serving path.
 
+Estimator-driven scheduling (docs/serving.md "Scheduling and
+multi-tenancy"):
+
+- :mod:`.scheduler` — the packing scheduler: concurrently admitted queries
+  are packed against the device byte budget using each family's provable
+  ``peak_bytes`` floor, ordered deadline-first, with per-tenant
+  token-bucket quotas (``X-Dsql-Tenant``) so one tenant's batch scan
+  cannot starve interactive traffic.
+
 :mod:`.runtime` ties them together into the worker pool the Presto server
 runs queries on.
 """
@@ -37,6 +46,7 @@ from .background import BackgroundCompiler
 from .cache import ResultCache, table_nbytes
 from .metrics import Histogram, MetricsRegistry
 from .runtime import ServingRuntime, current_ticket
+from .scheduler import PackingScheduler, QueryCost, TokenBucket
 from .warmup import WarmupManager
 
 __all__ = [
@@ -45,12 +55,15 @@ __all__ = [
     "DeadlineExceededError",
     "Histogram",
     "MetricsRegistry",
+    "PackingScheduler",
     "QueryCancelledError",
+    "QueryCost",
     "QueryTicket",
     "QueueFullError",
     "ResultCache",
     "ServingRuntime",
     "ShutdownError",
+    "TokenBucket",
     "WarmupManager",
     "current_ticket",
     "table_nbytes",

@@ -120,7 +120,7 @@ class QueryTicket:
     """
 
     __slots__ = ("qid", "priority_class", "deadline", "admitted_at",
-                 "started_at", "_cancelled")
+                 "started_at", "_cancelled", "cost")
 
     def __init__(self, qid: str, priority_class: str = "interactive",
                  deadline: Optional[float] = None):
@@ -131,6 +131,10 @@ class QueryTicket:
         self.admitted_at = time.monotonic()
         self.started_at: Optional[float] = None
         self._cancelled = False
+        #: the packing scheduler's `QueryCost` (serving/scheduler.py) when
+        #: the submit carried one — rides the ticket so the executing
+        #: thread (family batcher, metrics) can see its own cost view
+        self.cost = None
 
     def cancel(self) -> None:
         self._cancelled = True

@@ -37,6 +37,7 @@ DOCUMENTED_METRICS = frozenset({
     "analysis.estimate.rows_hi",
     "analysis.estimate.rung_proof",
     "analysis.estimate.internal_error",
+    "analysis.estimate.feedback",
     # columnar/ — compressed column encodings (encodings.py, docs/columnar.md)
     "columnar.encoding.encoded_columns",
     "columnar.encoding.encoded_bytes",
@@ -112,6 +113,14 @@ DOCUMENTED_METRICS = frozenset({
     "serving.shed_estimated_bytes",
     "serving.latency_ms",
     "serving.queue_wait_ms",
+    # serving/ — packing scheduler (scheduler.py, docs/serving.md
+    # "Scheduling and multi-tenancy")
+    "serving.scheduler.packed",
+    "serving.scheduler.waited",
+    "serving.scheduler.quota_throttled",
+    "serving.scheduler.cost_rung_skip",
+    "serving.scheduler.inflight_bytes",
+    "serving.scheduler.running",
     # serving/ — zero-cold-start: pre-warm + background recompile
     "serving.warmup.started",
     "serving.warmup.warmed",
@@ -139,6 +148,8 @@ DOCUMENTED_METRIC_PREFIXES = (
     "resilience.compile_ms.",   # per-rung XLA compile wall time (observability/spans.py)
     "serving.admitted.",        # per admission class
     "serving.rejected.",        # per admission class
+    "serving.scheduler.queue_depth.",    # per admission class (gauge)
+    "serving.scheduler.cost_rung_skip.",  # per cost-skipped ladder rung
     "executor.node.",           # per plan-node type (Tracer aggregation)
 )
 
@@ -258,6 +269,17 @@ class MetricsRegistry:
     def counter(self, name: str) -> int:
         with self._lock:
             return self._counters.get(name, 0)
+
+    def hist_percentile(self, name: str, q: float = 0.5) -> Optional[float]:
+        """One percentile of a histogram's rolling reservoir, or None when
+        the histogram has no samples — the cost-based rung selector reads
+        the per-rung compile-cost prior (``resilience.compile_ms.<rung>``)
+        through this."""
+        with self._lock:
+            hist = self._hists.get(name)
+            if hist is None or not hist._ring:
+                return None
+            return hist.percentiles([q])[0]
 
     def hit_rate(self, hit: str, miss: str) -> float:
         with self._lock:

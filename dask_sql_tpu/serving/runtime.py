@@ -37,6 +37,7 @@ from .admission import (
     QueryTicket,
 )
 from .metrics import MetricsRegistry
+from .scheduler import PackingScheduler, QueryCost
 
 logger = logging.getLogger(__name__)
 
@@ -58,7 +59,12 @@ class ServingRuntime:
                  metrics: Optional[MetricsRegistry] = None,
                  retry_policy: Optional[BackoffPolicy] = None,
                  batch_queries: int = 8,
-                 batch_window_ms: float = 2.0):
+                 batch_window_ms: float = 2.0,
+                 scheduler_enabled: bool = True,
+                 scheduler_budget_bytes: Optional[int] = None,
+                 tenant_rate: Optional[float] = None,
+                 tenant_burst: float = 4.0,
+                 fair_horizon_s: float = 30.0):
         self.workers = max(1, int(workers))
         self.metrics = metrics if metrics is not None else MetricsRegistry()
         #: backoff policy for taxonomy-retryable failures (resilience/retry.py)
@@ -75,13 +81,23 @@ class ServingRuntime:
         #: queries actually being in flight, so idle traffic pays nothing.
         self.batcher = FamilyBatcher(
             max_queries=batch_queries, window_ms=batch_window_ms,
-            metrics=self.metrics, busy=self._others_in_flight)
+            metrics=self.metrics, busy=self._others_in_flight,
+            mates=self._family_mates)
         # 0 is a legitimate setting (pause batch entirely), so only None
         # falls back to the workers-1 default
         self.batch_max_running = int(batch_max_running) \
             if batch_max_running is not None else max(1, self.workers - 1)
         self.default_deadline_s = default_deadline_s
         self._queues: Dict[str, deque] = {c: deque() for c in CLASSES}
+        #: the packing scheduler (serving/scheduler.py) replaces the FIFO
+        #: deques when enabled; its state is guarded by `_cv`, never a lock
+        #: of its own.  Disabled (`serving.scheduler.enabled=false`) the
+        #: deques above keep today's FIFO behavior byte-for-byte.
+        self.scheduler: Optional[PackingScheduler] = PackingScheduler(
+            budget_bytes=scheduler_budget_bytes,
+            tenant_rate=tenant_rate, tenant_burst=tenant_burst,
+            fair_horizon_s=fair_horizon_s,
+            metrics=self.metrics) if scheduler_enabled else None
         self._cv = threading.Condition()
         #: batch queries popped-but-not-finished, owned by _cv (admission's
         #: running counter is updated later under its own lock, so checking
@@ -103,6 +119,18 @@ class ServingRuntime:
     @classmethod
     def from_config(cls, config, metrics=None) -> "ServingRuntime":
         """Build from the ``serving.*`` keys (see config.py docstrings)."""
+        from ..config import parse_byte_budget
+
+        # the packer's budget: its own key when set, else the admission
+        # gate's byte budget (one budget is the common deployment; a
+        # separate scheduler budget exists for packing tighter or looser
+        # than the shed threshold)
+        budget = parse_byte_budget(
+            config.get("serving.scheduler.device_budget_bytes"))
+        if budget is None:
+            budget = parse_byte_budget(
+                config.get("serving.admission.max_estimated_bytes"))
+        rate = config.get("serving.tenant.rate_qps")
         return cls(
             workers=int(config.get("serving.workers", 8)),
             bounds={
@@ -117,6 +145,13 @@ class ServingRuntime:
             batch_queries=int(config.get("serving.batch.max_queries", 8) or 1),
             batch_window_ms=float(
                 config.get("serving.batch.window_ms", 2.0) or 0.0),
+            scheduler_enabled=bool(
+                config.get("serving.scheduler.enabled", True)),
+            scheduler_budget_bytes=budget,
+            tenant_rate=None if rate is None else float(rate),
+            tenant_burst=float(config.get("serving.tenant.burst", 4.0)),
+            fair_horizon_s=float(
+                config.get("serving.scheduler.fair_horizon_s", 30.0)),
         )
 
     def _others_in_flight(self) -> bool:
@@ -130,28 +165,61 @@ class ServingRuntime:
             return (sum(self.admission.running.values())
                     + sum(self.admission.waiting.values())) > 1
 
+    def _family_mates(self) -> int:
+        """How many OTHER admitted queries share the calling thread's plan
+        family — the packer's co-scheduling knowledge, handed to the family
+        batcher so a leader whose batch-mates were packed alongside it
+        waits the rendezvous window with certainty instead of relying on
+        the in-flight heuristic.  0 when the scheduler is off or the
+        current query submitted without a family cost hint."""
+        if self.scheduler is None:
+            return 0
+        ticket = current_ticket()
+        cost = getattr(ticket, "cost", None) if ticket is not None else None
+        if cost is None or not cost.family:
+            return 0
+        with self._cv:
+            return self.scheduler.family_mates_locked(
+                cost.family, exclude_qid=ticket.qid)
+
     # -------------------------------------------------------------- submit
     def submit(self, fn: Callable[[QueryTicket], object],
                qid: Optional[str] = None,
                priority_class: str = "interactive",
                deadline_s: Optional[float] = None,
+               cost: Optional[QueryCost] = None,
                ) -> Tuple[str, Future, QueryTicket]:
         """Admit and enqueue `fn(ticket)`; raises `QueueFullError` when the
-        class queue is at its bound (load shedding, never blocks)."""
+        class queue is at its bound (load shedding, never blocks).
+
+        ``cost`` is the packing scheduler's view of the query (provable
+        peak-byte floor, predicted exec, tenant, family); None degrades to
+        the zero cost — FIFO-equivalent treatment, no reservation."""
         if self._shutdown:
             raise ShutdownError("serving runtime is shut down")
+        from .admission import QueueFullError
+
         if priority_class == "batch" and self.batch_max_running == 0:
             # batch is paused: shed immediately instead of admitting work
             # that no worker would ever pop (client would hang in QUEUED)
-            from .admission import QueueFullError
-
             self.metrics.inc("serving.rejected")
             self.metrics.inc("serving.rejected.batch")
             raise QueueFullError("batch", 0, self.admission.retry_after_s)
         qid = qid or str(uuid.uuid4())
         if deadline_s is None:
             deadline_s = self.default_deadline_s
-        ticket = self.admission.admit(qid, priority_class, deadline_s)
+        try:
+            ticket = self.admission.admit(qid, priority_class, deadline_s)
+        except QueueFullError as e:
+            drain = self._predicted_drain_s()
+            if drain is not None and drain > e.retry_after_s:
+                # the scheduler predicts the drain from running queries'
+                # remaining predicted exec + the queued backlog — a better
+                # hint than the admission controller's latency average
+                raise QueueFullError(e.priority_class, e.bound,
+                                     min(60.0, drain)) from None
+            raise
+        ticket.cost = cost
         fut: Future = Future()
         with self._cv:
             if self._shutdown:
@@ -159,12 +227,27 @@ class ServingRuntime:
                 # would strand the future (the drain already ran)
                 self.admission.on_finish(ticket, started=False)
                 raise ShutdownError("serving runtime is shut down")
-            self._queues[ticket.priority_class].append((ticket, fn, fut))
+            if self.scheduler is not None:
+                self.scheduler.push_locked(ticket, fn, fut, cost)
+            else:
+                self._queues[ticket.priority_class].append((ticket, fn, fut))
             self._cv.notify()
         return qid, fut, ticket
 
+    def _predicted_drain_s(self) -> Optional[float]:
+        if self.scheduler is None:
+            return None
+        with self._cv:
+            return self.scheduler.predicted_drain_s(self.workers)
+
     # -------------------------------------------------------------- workers
     def _pop_locked(self):
+        if self.scheduler is not None:
+            item = self.scheduler.pop_locked(
+                batch_ok=self._batch_in_flight < self.batch_max_running)
+            if item is not None and item[0].priority_class == "batch":
+                self._batch_in_flight += 1
+            return item
         q = self._queues["interactive"]
         if q:
             return q.popleft()
@@ -233,10 +316,13 @@ class ServingRuntime:
 
     def _release(self, ticket: QueryTicket):
         """Return a popped item's scheduling slot: frees the batch
-        running-cap and wakes workers blocked on it."""
+        running-cap (and the packer's byte reservation — on EVERY outcome,
+        including a mid-pack failure) and wakes workers blocked on it."""
         with self._cv:
             if ticket.priority_class == "batch":
                 self._batch_in_flight -= 1
+            if self.scheduler is not None:
+                self.scheduler.release_locked(ticket)
             self._cv.notify_all()
 
     # ------------------------------------------------------------ lifecycle
@@ -274,6 +360,8 @@ class ServingRuntime:
                 q = self._queues[cls]
                 while q:
                     drained.append(q.popleft())
+            if self.scheduler is not None:
+                drained.extend(self.scheduler.drain_all_locked())
             self._cv.notify_all()
         for ticket, _fn, fut in drained:
             self.admission.on_finish(ticket, started=False)
@@ -296,10 +384,20 @@ class ServingRuntime:
 
     def snapshot(self) -> Dict[str, object]:
         adm = self.admission.snapshot()
-        return {
+        with self._cv:
+            if self.scheduler is not None:
+                queues = {c: self.scheduler.depth_locked(c) for c in CLASSES}
+                sched = self.scheduler.snapshot_locked()
+            else:
+                queues = {c: len(self._queues[c]) for c in CLASSES}
+                sched = None
+        out = {
             "workers": self.workers,
             "batchMaxRunning": self.batch_max_running,
-            "queues": {c: len(self._queues[c]) for c in CLASSES},
+            "queues": queues,
             "admission": adm,
             "familyBatcher": self.batcher.snapshot(),
         }
+        if sched is not None:
+            out["scheduler"] = sched
+        return out

@@ -1,0 +1,552 @@
+"""Estimator-driven packing scheduler (serving/scheduler.py): byte-budget
+packing, deadline ordering, tenant quotas, drain-based retry hints,
+cost-based rung selection, and estimator profile feedback."""
+import threading
+import time
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from dask_sql_tpu import Context
+from dask_sql_tpu import config as config_module
+from dask_sql_tpu.serving import (
+    MetricsRegistry,
+    PackingScheduler,
+    QueryCost,
+    QueueFullError,
+    ServingRuntime,
+    TokenBucket,
+)
+
+pytestmark = pytest.mark.scheduler
+
+
+@pytest.fixture(autouse=True)
+def _restore_global_config():
+    """Context.config IS the process-global config singleton: every key a
+    test flips (serving.cache.enabled in _ctx, feedback margins, ...) must
+    be restored or later test FILES in the same session inherit it."""
+    saved = config_module.config.effective_items()
+    yield
+    config_module.config.update(dict(saved))
+
+
+def _wait_for(pred, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.005)
+    return pred()
+
+
+# ------------------------------------------------------------- token bucket
+def test_token_bucket_refill_and_burst():
+    now = [0.0]
+    b = TokenBucket(rate=2.0, burst=3.0, clock=lambda: now[0])
+    assert b.take() and b.take() and b.take()
+    assert not b.take()  # burst exhausted
+    now[0] = 0.5  # +1 token at 2/s
+    assert b.take()
+    assert not b.take()
+    now[0] = 100.0
+    for _ in range(3):  # refill caps at burst
+        assert b.take()
+    assert not b.take()
+
+
+# ----------------------------------------------------------------- packing
+def test_packing_admits_small_beside_big_fifo_would_block():
+    """The acceptance shape: a budget that fits one big + one small query
+    runs them CONCURRENTLY (packed in-flight > 1), while the second big
+    query waits because its provable floor cannot fit the remainder."""
+    rt = ServingRuntime(workers=4, scheduler_budget_bytes=100)
+    try:
+        gate = threading.Event()
+        started = []
+
+        def blocker(name):
+            def fn(_t):
+                started.append(name)
+                gate.wait(10)
+                return name
+            return fn
+
+        _, f1, _ = rt.submit(blocker("big1"), cost=QueryCost(bytes_lo=60))
+        assert _wait_for(lambda: "big1" in started)
+        _, f2, _ = rt.submit(blocker("big2"), cost=QueryCost(bytes_lo=60))
+        _, f3, _ = rt.submit(blocker("small"), cost=QueryCost(bytes_lo=30))
+        # the small query packs beside big1 (60 + 30 <= 100); big2 waits
+        assert _wait_for(lambda: "small" in started)
+        time.sleep(0.05)
+        assert "big2" not in started
+        assert rt.metrics.counter("serving.scheduler.packed") >= 1
+        assert rt.metrics.counter("serving.scheduler.waited") >= 1
+        snap = rt.snapshot()["scheduler"]
+        assert snap["reservedBytes"] == 90
+        gate.set()
+        assert f1.result(5) == "big1"
+        assert f2.result(5) == "big2"  # dispatched once big1 released
+        assert f3.result(5) == "small"
+        assert rt.snapshot()["scheduler"]["reservedBytes"] == 0
+    finally:
+        rt.shutdown(wait=True)
+
+
+def test_lone_oversize_query_still_dispatches():
+    """Liveness: with nothing in flight the head query dispatches even if
+    its floor exceeds the whole budget (shedding oversize queries is the
+    admission gate's job, not a scheduler deadlock)."""
+    rt = ServingRuntime(workers=1, scheduler_budget_bytes=10)
+    try:
+        _, f, _ = rt.submit(lambda t: "ran", cost=QueryCost(bytes_lo=1000))
+        assert f.result(5) == "ran"
+    finally:
+        rt.shutdown(wait=True)
+
+
+def test_midpack_failure_releases_reserved_bytes():
+    """A fault mid-pack must release the reservation on the failure path,
+    or the budget leaks and every later query waits forever."""
+    rt = ServingRuntime(workers=2, scheduler_budget_bytes=100)
+    try:
+        def boom(_t):
+            raise RuntimeError("induced mid-pack failure")
+
+        _, f1, _ = rt.submit(boom, cost=QueryCost(bytes_lo=80))
+        with pytest.raises(RuntimeError):
+            f1.result(5)
+        assert _wait_for(
+            lambda: rt.snapshot()["scheduler"]["reservedBytes"] == 0)
+        # the freed budget admits the next big query
+        _, f2, _ = rt.submit(lambda t: "ok", cost=QueryCost(bytes_lo=80))
+        assert f2.result(5) == "ok"
+    finally:
+        rt.shutdown(wait=True)
+
+
+def test_fifo_mode_preserves_legacy_queues():
+    """serving.scheduler.enabled=false: the runtime keeps the original
+    FIFO deques — no scheduler object, no reservations, submission order
+    within a class."""
+    rt = ServingRuntime(workers=1, scheduler_enabled=False)
+    try:
+        assert rt.scheduler is None
+        gate = threading.Event()
+        started = threading.Event()
+        order = []
+        _, f0, _ = rt.submit(lambda t: (started.set(), gate.wait(10))[1])
+        started.wait(5)
+        # deadline-bearing query does NOT jump ahead in FIFO mode
+        _, fa, _ = rt.submit(lambda t: order.append("A"))
+        _, fb, _ = rt.submit(lambda t: order.append("B"), deadline_s=30.0)
+        gate.set()
+        fa.result(5)
+        fb.result(5)
+        assert order == ["A", "B"]
+        assert "scheduler" not in rt.snapshot()
+    finally:
+        rt.shutdown(wait=True)
+
+
+# ---------------------------------------------------------------- ordering
+def test_deadline_aware_ordering():
+    rt = ServingRuntime(workers=1)
+    try:
+        gate = threading.Event()
+        started = threading.Event()
+        order = []
+        _, f0, _ = rt.submit(lambda t: (started.set(), gate.wait(10))[1])
+        started.wait(5)
+        # deadlines tighter than the 30s fairness horizon: both outrank
+        # the earlier-submitted deadline-free query
+        _, fa, _ = rt.submit(lambda t: order.append("no_deadline"))
+        _, fb, _ = rt.submit(lambda t: order.append("tight"), deadline_s=5.0)
+        _, fc, _ = rt.submit(lambda t: order.append("loose"), deadline_s=10.0)
+        gate.set()
+        for f in (fa, fb, fc):
+            f.result(5)
+        assert order == ["tight", "loose", "no_deadline"]
+    finally:
+        rt.shutdown(wait=True)
+
+
+def test_no_deadline_query_not_starved_past_fair_horizon():
+    """Anti-starvation: a deadline-free query sorts with a synthetic
+    deadline of admission + fair_horizon_s, so a stream of deadline-bearing
+    arrivals cannot pass it over forever."""
+    from dask_sql_tpu.serving.admission import QueryTicket
+
+    now = [1000.0]
+    sched = PackingScheduler(fair_horizon_s=30.0, clock=lambda: now[0])
+    starved = QueryTicket("starved")  # no deadline
+    sched.push_locked(starved, lambda t: None, None, QueryCost())
+    # a later arrival with a deadline LOOSER than the horizon loses to it
+    later = QueryTicket("later", deadline=starved.admitted_at + 300.0)
+    sched.push_locked(later, lambda t: None, None, QueryCost())
+    ticket, _, _ = sched.pop_locked(batch_ok=True)
+    assert ticket.qid == "starved"
+
+
+def test_byte_blocked_query_becomes_barrier_past_horizon():
+    """A big-floor query byte-blocked past fair_horizon_s becomes a
+    head-of-line barrier: small queries stop packing in behind it, so
+    in-flight work drains until it fits (a rotating small-query stream
+    could otherwise starve it forever)."""
+    from dask_sql_tpu.serving.admission import QueryTicket
+
+    now = [0.0]
+    sched = PackingScheduler(budget_bytes=100, fair_horizon_s=30.0,
+                             clock=lambda: now[0])
+    small_running = QueryTicket("r0")
+    sched.push_locked(small_running, lambda t: None, None,
+                      QueryCost(bytes_lo=30))
+    assert sched.pop_locked(batch_ok=True)[0].qid == "r0"
+    big = QueryTicket("big")
+    sched.push_locked(big, lambda t: None, None, QueryCost(bytes_lo=80))
+    small = QueryTicket("small")
+    sched.push_locked(small, lambda t: None, None, QueryCost(bytes_lo=30))
+    # within the horizon: the small query packs past the blocked big one
+    assert sched.pop_locked(batch_ok=True)[0].qid == "small"
+    sched.release_locked(small)
+    now[0] = 31.0  # big has now been byte-blocked past the horizon
+    small2 = QueryTicket("small2")
+    sched.push_locked(small2, lambda t: None, None, QueryCost(bytes_lo=30))
+    assert sched.pop_locked(batch_ok=True) is None  # barrier: nothing jumps
+    sched.release_locked(small_running)  # in-flight drains...
+    assert sched.pop_locked(batch_ok=True)[0].qid == "big"  # ...big fits
+
+
+def test_dead_items_consume_no_quota_tokens():
+    """Cancelled-while-queued queries are handed out only for finalization:
+    they must not burn the tenant's tokens or count as packed."""
+    from dask_sql_tpu.serving.admission import QueryTicket
+
+    m = MetricsRegistry()
+    sched = PackingScheduler(tenant_rate=0.001, tenant_burst=2.0, metrics=m)
+    for i in range(2):
+        t = QueryTicket(f"dead{i}")
+        t.cancel()
+        sched.push_locked(t, lambda t: None, None, QueryCost(tenant="a"))
+        popped = sched.pop_locked(batch_ok=True)
+        assert popped[0].qid == f"dead{i}"
+        sched.release_locked(popped[0])
+    # both tokens survive for real work
+    live = [QueryTicket(f"live{i}") for i in range(2)]
+    for t in live:
+        sched.push_locked(t, lambda t: None, None, QueryCost(tenant="a"))
+    assert sched.pop_locked(batch_ok=True)[0].qid == "live0"
+    assert sched._buckets["a"].tokens < 2.0  # live dispatch DID take one
+    # dead dispatches never counted as packed (nothing ran beside them)
+    assert m.counter("serving.scheduler.packed") == 0
+
+
+def test_explain_estimate_does_not_create_profile_entries():
+    """Estimating a never-executed family must not create profile entries
+    (EXPLAIN's own execution records its own profile as always — but no
+    phantom zero-hit entry may appear for the estimated inner query)."""
+    c = _ctx()
+    c.sql("EXPLAIN ESTIMATE SELECT k FROM t WHERE v < 10",
+          return_futures=False)
+    snap = c.profiles.snapshot()["profiles"]
+    assert snap, "EXPLAIN's own execution should be profiled"
+    assert all(e["hits"] >= 1 for e in snap.values()), \
+        "phantom zero-hit entry created by estimation"
+
+
+def test_tenant_bucket_map_is_bounded():
+    """The bucket map is keyed by a CLIENT header: unique tenant names per
+    request must not grow it without bound."""
+    from dask_sql_tpu.serving.scheduler import _TENANT_BUCKET_CAP
+    from dask_sql_tpu.serving.admission import QueryTicket
+
+    sched = PackingScheduler(tenant_rate=1.0, tenant_burst=1.0)
+    for i in range(_TENANT_BUCKET_CAP + 200):
+        t = QueryTicket(f"q{i}")
+        sched.push_locked(t, lambda t: None, None,
+                          QueryCost(tenant=f"tenant{i}"))
+        popped = sched.pop_locked(batch_ok=True)
+        assert popped is not None
+        sched.release_locked(popped[0])
+    assert len(sched._buckets) <= _TENANT_BUCKET_CAP
+
+
+def test_interactive_still_outranks_batch():
+    rt = ServingRuntime(workers=1)
+    try:
+        gate = threading.Event()
+        started = threading.Event()
+        order = []
+        _, f0, _ = rt.submit(lambda t: (started.set(), gate.wait(10))[1])
+        started.wait(5)
+        _, fb, _ = rt.submit(lambda t: order.append("batch"),
+                             priority_class="batch")
+        _, fi, _ = rt.submit(lambda t: order.append("interactive"))
+        gate.set()
+        fb.result(5)
+        fi.result(5)
+        assert order == ["interactive", "batch"]
+    finally:
+        rt.shutdown(wait=True)
+
+
+# ------------------------------------------------------------ tenant quotas
+def test_tenant_quota_starvation_regression():
+    """8 worker threads, one greedy tenant flooding the queue: the victim
+    tenant's queries are served ahead of the greedy backlog once the greedy
+    burst is spent, and every greedy query still SUCCEEDS (quotas reorder,
+    never fail)."""
+    rt = ServingRuntime(workers=8, tenant_rate=0.001, tenant_burst=2)
+    try:
+        gate = threading.Event()
+        order = []
+        blockers = []
+        startcount = threading.Semaphore(0)
+        for i in range(8):  # occupy all 8 workers
+            def hold(_t):
+                startcount.release()
+                gate.wait(10)
+            blockers.append(rt.submit(hold)[1])
+        for _ in range(8):
+            startcount.acquire()
+        greedy = [rt.submit(lambda t, i=i: order.append(f"greedy{i}"),
+                            cost=QueryCost(tenant="greedy"))[1]
+                  for i in range(6)]
+        victims = [rt.submit(lambda t, i=i: order.append(f"victim{i}"),
+                             cost=QueryCost(tenant="victim"))[1]
+                   for i in range(2)]
+        gate.set()
+        for f in victims + greedy + blockers:
+            f.result(10)
+        # greedy burst=2: at most two greedy queries may lead on tokens,
+        # then both victims outrank the remaining greedy backlog
+        first4 = order[:4]
+        assert sum(1 for name in first4 if name.startswith("victim")) == 2, \
+            order
+        assert sorted(n for n in order if n.startswith("greedy")) == \
+            [f"greedy{i}" for i in range(6)]  # none failed, none lost
+        assert rt.metrics.counter("serving.scheduler.quota_throttled") >= 1
+    finally:
+        rt.shutdown(wait=True)
+
+
+def test_quota_work_conserving_when_alone():
+    """A greedy tenant ALONE gets full throughput: out-of-tokens queries
+    dispatch when no other tenant has runnable work."""
+    rt = ServingRuntime(workers=1, tenant_rate=0.001, tenant_burst=1)
+    try:
+        futs = [rt.submit(lambda t, i=i: i,
+                          cost=QueryCost(tenant="greedy"))[1]
+                for i in range(4)]
+        assert [f.result(5) for f in futs] == [0, 1, 2, 3]
+    finally:
+        rt.shutdown(wait=True)
+
+
+# -------------------------------------------------------- drain retry hint
+def test_retry_after_from_predicted_drain():
+    """A shed submit's Retry-After reflects the scheduler's predicted
+    drain (running queries' remaining predicted exec), not the static
+    floor."""
+    rt = ServingRuntime(workers=1, bounds={"interactive": 1, "batch": 1},
+                        retry_after_s=1.0)
+    try:
+        gate = threading.Event()
+        started = threading.Event()
+        _, f1, _ = rt.submit(
+            lambda t: (started.set(), gate.wait(10))[1],
+            cost=QueryCost(pred_exec_ms=40_000.0))
+        started.wait(5)
+        _, f2, _ = rt.submit(lambda t: "queued",
+                             cost=QueryCost(pred_exec_ms=40_000.0))
+        with pytest.raises(QueueFullError) as ei:
+            rt.submit(lambda t: "shed")
+        # ~40s running remainder + ~40s queued over 1 worker, capped at 60
+        assert ei.value.retry_after_s > 10.0
+        assert ei.value.retry_after_s <= 60.0
+        gate.set()
+        f1.result(5)
+        f2.result(5)
+    finally:
+        rt.shutdown(wait=True)
+
+
+def test_family_mates_visible_to_batcher_probe():
+    sched = PackingScheduler(budget_bytes=None)
+    from dask_sql_tpu.serving.admission import QueryTicket
+
+    t1 = QueryTicket("q1")
+    t2 = QueryTicket("q2")
+    sched.push_locked(t1, lambda t: None, None, QueryCost(family="fam_a"))
+    sched.push_locked(t2, lambda t: None, None, QueryCost(family="fam_a"))
+    assert sched.family_mates_locked("fam_a") == 2
+    sched.pop_locked(batch_ok=True)  # q1 starts running
+    assert sched.family_mates_locked("fam_a", exclude_qid="q1") == 1
+    assert sched.family_mates_locked("fam_b") == 0
+
+
+# ------------------------------------------------- cost-based rung selection
+def _ctx():
+    c = Context()
+    c.config.update({"serving.cache.enabled": False})
+    c.create_table("t", pd.DataFrame({
+        "k": np.arange(4000, dtype=np.int64) % 7,
+        "v": np.arange(4000, dtype=np.float64),
+    }))
+    return c
+
+
+def test_cost_based_rung_skip_no_degradation():
+    """A family with cheap observed interpreted history and a compile
+    prior that can never amortize skips its compiled rungs — counted as
+    serving.scheduler.cost_rung_skip, with resilience.degraded == 0 and a
+    correct (interpreted) result."""
+    c = _ctx()
+    q = "SELECT k, SUM(v) AS s, COUNT(*) AS n FROM t GROUP BY k"
+    plan = c.sql(q).plan  # planned, not yet executed
+    fam = getattr(plan, "_dsql_family", None)
+    assert fam is not None
+    # evidence: the family ran cheaply twice without compiling, and this
+    # context's observed compile cost for the rungs is enormous
+    c.profiles.record_exec(fam.fingerprint, sql=q, exec_ms=1.0,
+                           family=fam.fingerprint)
+    c.profiles.record_exec(fam.fingerprint, sql=q, exec_ms=1.0,
+                           family=fam.fingerprint)
+    for rung in ("compiled_aggregate", "compiled_join_aggregate",
+                 "compiled_select"):
+        c.metrics.observe(f"resilience.compile_ms.{rung}", 60_000.0)
+    got = c.sql(q, return_futures=False).sort_values("k").reset_index(
+        drop=True)
+    snap = c.metrics.snapshot()["counters"]
+    assert snap.get("serving.scheduler.cost_rung_skip", 0) >= 1
+    assert snap.get("serving.scheduler.cost_rung_skip.compiled_aggregate",
+                    0) == 1
+    assert snap.get("resilience.degraded", 0) == 0
+    v = np.arange(4000, dtype=np.float64)
+    k = np.arange(4000) % 7
+    assert np.allclose(got["s"], [v[k == i].sum() for i in range(7)])
+    assert list(got["n"]) == [int((k == i).sum()) for i in range(7)]
+
+
+def test_cost_skip_never_fires_cold_or_after_compile():
+    """Evidence gates: a first-seen family always gets its compile, and a
+    family that already compiled the rung is never skipped."""
+    c = _ctx()
+    for rung in ("compiled_aggregate", "compiled_select"):
+        c.metrics.observe(f"resilience.compile_ms.{rung}", 60_000.0)
+    q = "SELECT k, SUM(v) AS s FROM t GROUP BY k"
+    # cold family: no exec history -> compiles despite the huge prior
+    c.sql(q, return_futures=False)
+    snap = c.metrics.snapshot()["counters"]
+    assert snap.get("serving.scheduler.cost_rung_skip", 0) == 0
+    assert snap.get("resilience.rung.compiled_aggregate", 0) == 1
+    # warm family: the aggregate rung compiled on run 1, so it is never
+    # cost-skipped and serves run 2 too.  (compiled_select MAY cost-skip —
+    # it declined run 1 for this aggregate shape, so it has no compile
+    # entry; skipping a rung that would decline changes nothing.)
+    c.sql(q, return_futures=False)
+    snap = c.metrics.snapshot()["counters"]
+    assert snap.get(
+        "serving.scheduler.cost_rung_skip.compiled_aggregate", 0) == 0
+    assert snap.get("resilience.rung.compiled_aggregate", 0) == 2
+
+
+def test_cost_skip_off_switch():
+    c = _ctx()
+    c.config.update({"resilience.ladder.cost_based": False})
+    try:
+        q = "SELECT k, SUM(v) AS s FROM t GROUP BY k"
+        plan = c.sql(q).plan
+        fam = plan._dsql_family
+        c.profiles.record_exec(fam.fingerprint, sql=q, exec_ms=0.5,
+                               family=fam.fingerprint)
+        c.metrics.observe("resilience.compile_ms.compiled_aggregate",
+                          60_000.0)
+        c.sql(q, return_futures=False)
+        snap = c.metrics.snapshot()["counters"]
+        assert snap.get("serving.scheduler.cost_rung_skip", 0) == 0
+        assert snap.get("resilience.rung.compiled_aggregate", 0) == 1
+    finally:
+        config_module.config.update({"resilience.ladder.cost_based": True})
+
+
+# -------------------------------------------------------- estimator feedback
+def test_feedback_priors_never_cross_provable_floors():
+    """Profile feedback tightens UPPER bounds only: lo is byte-identical
+    with feedback on/off, hi never drops below lo, across margins."""
+    from dask_sql_tpu.analysis import estimator
+
+    c = _ctx()
+    q = "SELECT v FROM t WHERE v < 50"
+    for _ in range(3):
+        c.sql(q, return_futures=False)
+    plan = c.sql(q).plan
+    with c.config.set({"analysis.estimate.feedback": False}):
+        base = estimator.estimate_plan(plan, context=c)
+    fam = plan._dsql_family
+    prof = c.profiles.get(fam.fingerprint if fam is not None else None)
+    assert prof is not None and len(prof["rows"]) >= 2
+    for margin in (1.0, 1.5, 2.0, 10.0):
+        with c.config.set({"analysis.estimate.feedback.margin": margin}):
+            fb = estimator.apply_feedback(base, prof, c.config)
+        assert fb.peak_bytes.lo == base.peak_bytes.lo  # provable, untouched
+        assert fb.rows.lo == base.rows.lo
+        assert fb.result_bytes.lo == base.result_bytes.lo
+        assert fb.peak_bytes.hi >= fb.peak_bytes.lo
+        assert fb.rows.hi >= fb.rows.lo
+        assert fb.result_bytes.hi >= fb.result_bytes.lo
+        # and it actually tightens (50 observed rows << static 4000 hi)
+        assert fb.rows.hi <= base.rows.hi
+
+
+def test_feedback_tightens_only_with_enough_observations():
+    from dask_sql_tpu.analysis import estimator
+
+    c = _ctx()
+    q = "SELECT v FROM t WHERE v < 50"
+    c.sql(q, return_futures=False)  # one observation < min_obs (2)
+    plan = c.sql(q).plan
+    fam = plan._dsql_family
+    prof = c.profiles.get(fam.fingerprint)
+    with c.config.set({"analysis.estimate.feedback": False}):
+        base = estimator.estimate_plan(plan, context=c)
+    fb = estimator.apply_feedback(base, prof, c.config)
+    assert fb.feedback is False and fb.rows.hi == base.rows.hi
+
+
+def test_show_profiles_estimated_vs_observed_rows():
+    c = _ctx()
+    q = "SELECT v FROM t WHERE v < 50"
+    for _ in range(2):
+        c.sql(q, return_futures=False)
+    df = c.sql("SHOW PROFILES LIKE 'rows.%'", return_futures=False)
+    metrics = set(df["Metric"])
+    assert {"rows.est_hi", "rows.observed.last", "rows.observed.max"} \
+        <= metrics
+    by_metric = {m: v for _, (_, _, m, v) in df.iterrows()}
+    assert int(by_metric["rows.observed.last"]) == 50
+    assert int(by_metric["rows.est_hi"]) >= 50
+
+
+# ------------------------------------------------------------- persistence
+def test_profile_rung_and_rows_history_round_trips():
+    from dask_sql_tpu.observability import ProfileStore
+
+    store = ProfileStore(window=8)
+    store.record_exec("fp", sql="q", exec_ms=2.0, rows=10)
+    store.record_rung_exec("fp", "compiled_select", 1.5)
+    store.record_estimate("fp", 128)
+    snap = store.snapshot()
+    other = ProfileStore()
+    assert other.load(snap) == 1
+    e = other.get("fp")
+    assert e["rows"] == [10]
+    assert e["est_rows_hi"] == 128
+    assert e["rungs"]["compiled_select"]["count"] == 1
+    # pre-scheduler snapshots (no rows/rungs keys) restore additively
+    legacy = {"version": 2, "profiles": {
+        "old": {"sql": "SELECT 1", "hits": 3, "exec_ms": [1.0]}}}
+    assert other.load(legacy) == 1
+    e = other.get("old")
+    assert e["rows"] == [] and e["rungs"] == {} and e["est_rows_hi"] is None

@@ -382,7 +382,12 @@ def test_expired_while_queued():
     rt = ServingRuntime(workers=1)
     try:
         gate = threading.Event()
-        _, f1, _ = rt.submit(lambda t: gate.wait(10))
+        started = threading.Event()
+        # the blocker must be RUNNING before f2 is submitted: the packing
+        # scheduler orders deadline-bearing queries first, so a still-queued
+        # blocker would let f2 jump ahead and complete instead of expiring
+        _, f1, _ = rt.submit(lambda t: (started.set(), gate.wait(10))[1])
+        started.wait(5)
         _, f2, _ = rt.submit(lambda t: "x", deadline_s=0.05)
         time.sleep(0.2)
         gate.set()
