@@ -911,11 +911,121 @@ def run_schedule_smoke():
         raise SystemExit(1)
 
 
+def run_stream_smoke():
+    """`bench.py --stream`: streamed partitioned execution smoke, exit 1
+    on violation (ISSUE 13 acceptance).
+
+    1. *Streamed completion* — a working set whose provable resident floor
+       is >2x the configured admission budget completes via N>1 pipelined
+       partition launches of one morsel executable (instead of the 429 the
+       gate used to return), with results matching pandas.
+    2. *Mid-stream OOM recovery* — an injected ``partition:atK`` fault
+       mid-sequence repartitions (halved chunks) and RESUMES from the last
+       completed partition: the per-run processed-row counter equals the
+       table rows exactly (a restart would re-count completed partitions),
+       and results still match pandas.
+    """
+    import json as _json
+
+    _ensure_backend()
+    import jax
+    import pandas as pd
+
+    from dask_sql_tpu import Context
+    from dask_sql_tpu.resilience import faults
+    from dask_sql_tpu.serving.cache import table_nbytes
+
+    n = 600_000
+    df = gen_lineitem(n, seed=0)
+    c = Context()
+    c.config.update({"serving.cache.enabled": False})
+    c.create_table("lineitem", df)
+    resident = table_nbytes(c.schema["root"].tables["lineitem"].table)
+    q = ("SELECT l_returnflag, SUM(l_quantity) AS sum_qty, "
+         "COUNT(*) AS count_order, AVG(l_quantity) AS avg_qty "
+         "FROM lineitem GROUP BY l_returnflag ORDER BY l_returnflag")
+    # warm the plan cache, then size the budget from the query's PROVABLE
+    # working-set floor (the estimator's peak_bytes.lo — what the gate
+    # actually sheds on): the floor is > 2x the budget, so the single
+    # launch is provably infeasible and only streaming can serve it
+    c.sql(q, return_futures=False)
+    cost = c.cost_hint(q)
+    floor = int(cost.bytes_lo) if cost is not None else 0
+    budget = floor // 2 - (1 << 10)
+    expected = (df.groupby("l_returnflag").agg(
+        sum_qty=("l_quantity", "sum"), count_order=("l_quantity", "size"),
+        avg_qty=("l_quantity", "mean")).reset_index().sort_values(
+            "l_returnflag").reset_index(drop=True))
+
+    def matches(res) -> bool:
+        got = res.sort_values("l_returnflag").reset_index(drop=True)
+        try:
+            assert list(got["l_returnflag"]) == list(
+                expected["l_returnflag"])
+            np.testing.assert_allclose(got["sum_qty"], expected["sum_qty"],
+                                       rtol=1e-5)
+            np.testing.assert_array_equal(got["count_order"],
+                                          expected["count_order"])
+            np.testing.assert_allclose(got["avg_qty"], expected["avg_qty"],
+                                       rtol=1e-5)
+            return True
+        except AssertionError:
+            return False
+
+    opts = {"serving.admission.max_estimated_bytes": budget}
+    # phase 1: streamed completion, N>1 launches, pandas-identical
+    res1 = c.sql(q, return_futures=False, config_options=opts)
+    parts1 = c.metrics.counter("serving.stream.partitions")
+    rows1 = c.metrics.counter("serving.stream.rows")
+    ok_stream = (budget > 0 and floor > 2 * budget
+                 and c.metrics.counter("serving.stream.admitted") >= 1
+                 and parts1 > 1 and rows1 == n
+                 and c.metrics.counter("serving.shed_estimated_bytes") == 0
+                 and matches(res1))
+
+    # phase 2: induced mid-stream OOM -> repartition + resume (no restart)
+    faults.reset()
+    res2 = c.sql(q, return_futures=False, config_options={
+        **opts, "resilience.inject": "partition:at2",
+        "serving.stream.min_chunk_rows": 1024})
+    rows2 = c.metrics.counter("serving.stream.rows") - rows1
+    reparts = c.metrics.counter("serving.stream.repartitions")
+    ooms = c.metrics.counter("resilience.partition.oom")
+    # rows2 == n proves completed partitions were NOT re-executed: a
+    # restart would re-process partition 0 and overshoot
+    ok_recover = (ooms >= 1 and reparts >= 1 and rows2 == n
+                  and c.metrics.counter("resilience.degraded") == 0
+                  and matches(res2))
+
+    ok = ok_stream and ok_recover
+    print(_json.dumps({
+        "metric": "streaming_partitioned_smoke",
+        "backend": jax.default_backend(),
+        "ok": bool(ok),
+        "resident_bytes": resident,
+        "working_set_floor_bytes": floor,
+        "budget_bytes": budget,
+        "partitions_first_run": parts1,
+        "rows_processed_first_run": rows1,
+        "streamed_completion_ok": bool(ok_stream),
+        "midstream_oom_injected": ooms,
+        "repartitions": reparts,
+        "rows_processed_recovery_run": rows2,
+        "resumed_without_restart": bool(rows2 == n),
+        "recovery_ok": bool(ok_recover),
+    }), flush=True)
+    if not ok:
+        raise SystemExit(1)
+
+
 def main():
     import sys
 
     if "--lint" in sys.argv:
         run_lint_smoke()
+        return
+    if "--stream" in sys.argv:
+        run_stream_smoke()
         return
     if "--inject" in sys.argv:
         run_inject_smoke()

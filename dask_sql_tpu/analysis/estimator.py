@@ -199,6 +199,12 @@ class PlanEstimate:
     #: Lower bounds are untouched — they stay provable, so the admission
     #: shed and every rung proof keep their soundness regardless
     feedback: bool = False
+    #: provable floor of the RESIDENT base-table scans alone (the scan part
+    #: of ``peak_bytes.lo``): the streaming partitioner (streaming/plan.py)
+    #: divides this by the partition count to derive the per-chunk floor —
+    #: the non-scan remainder (materialized root, per-device exchange) does
+    #: not shrink with partitioning and must stay whole
+    scan_bytes_lo: int = 0
 
     def format_rows(self) -> List[str]:
         rows = [
@@ -532,6 +538,7 @@ class _Estimator:
             nodes=list(reversed(self.nodes)),  # root first for display
             rung_proofs=[],
             devices=self.devices,
+            scan_bytes_lo=sum(self._scan_lo.values()),
         )
 
 

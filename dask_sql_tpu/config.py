@@ -150,8 +150,28 @@ DEFAULTS: Dict[str, Any] = {
     # pre-compile OOM gate: shed queries whose statically PROVABLE peak
     # device bytes (estimator lower bound) exceed this budget, with a
     # non-retryable ESTIMATED_BYTES_EXCEEDED before any compilation.
-    # None disables the gate.
+    # None disables the gate.  Oversize-but-PARTITIONABLE plans are routed
+    # to streamed execution first (serving.stream.* below); the shed is
+    # the last resort.
     "serving.admission.max_estimated_bytes": None,
+    # Streamed partitioned execution (streaming/, docs/serving.md
+    # "Streaming execution"): a provably-over-budget scan splits into
+    # fixed-size encoded row partitions and executes as N pipelined
+    # launches of one morsel-shaped family executable, with partial
+    # aggregate states combined across the time axis and mid-stream OOM
+    # recovery (halve the partition, resume from the last completed one).
+    "serving.stream.enabled": True,
+    # explicit partition size in rows (0/None = derive from the estimate:
+    # the smallest partition count whose provable per-chunk floor fits
+    # serving.admission.max_estimated_bytes)
+    "serving.stream.chunk_rows": None,
+    # the repartition floor: an absorbed mid-stream OOM halves the chunk
+    # until it would cross this, at which point the failure degrades down
+    # the ladder (streamed -> interpreted) like any rung failure
+    "serving.stream.min_chunk_rows": 4096,
+    # admission cap on the partition count: a plan needing more launches
+    # than this to fit is shed (bounded latency beats unbounded streaming)
+    "serving.stream.max_partitions": 256,
     # Zero-cold-start serving (docs/serving.md "Cold starts"): persistent
     # executable cache + profile-driven pre-warm + background recompile.
     "serving.compile_cache.path": None,  # dir for the persistent XLA executable cache (None = off)

@@ -155,13 +155,19 @@ class TpuFrame:
                     self._result = hit
                     return self._result
                 estimate = ctx._plan_estimate(self._plan)
+                routed = None
                 if estimate is not None:
                     # pre-compile OOM gate: a provable over-budget query is
                     # shed HERE — before the executor compiles anything —
-                    # with a structured, non-retryable taxonomy error
+                    # with a structured, non-retryable taxonomy error.
+                    # Oversize-but-partitionable plans are routed to the
+                    # streaming rungs instead (streaming/): shedding is the
+                    # last resort, not the first.
                     from .serving.admission import check_estimated_bytes
 
-                    check_estimated_bytes(estimate, ctx.config, ctx.metrics)
+                    routed = check_estimated_bytes(
+                        estimate, ctx.config, ctx.metrics,
+                        plan=self._plan, context=ctx)
                     # result-cache admission: a result whose PROVABLE bytes
                     # already exceed the per-entry cap is never cacheable;
                     # skip the insert instead of materializing-then-evicting
@@ -172,6 +178,13 @@ class TpuFrame:
                 trace = bool(ctx.config.get("serving.metrics.node_traces",
                                             False))
                 executor = Executor(ctx, trace=trace)
+                if routed is not None:
+                    # per-EXECUTION streaming verdict: keyed by the
+                    # streamable node's identity on THIS executor, so a
+                    # concurrent execution of the same cached plan under a
+                    # different budget cannot null it mid-flight
+                    node, decision = routed
+                    executor.stream_decisions[id(node)] = decision
                 t0 = time.perf_counter()
                 # executor boundary: every failure leaves here as a taxonomy
                 # QueryError (code/retryable/degradable), never a raw
@@ -188,11 +201,26 @@ class TpuFrame:
                         tr.attach_node_tree(executor.tracer.root)
                 from .serving.cache import table_nbytes
 
+                result_bytes = table_nbytes(self._result)
                 ctx.profiles.record_exec(
                     fp, sql=sql_text, exec_ms=exec_ms,
-                    result_bytes=table_nbytes(self._result),
+                    result_bytes=result_bytes,
                     family=family_fp,
                     rows=self._result.num_rows)
+                from .serving.runtime import current_ticket
+
+                ticket = current_ticket()
+                if ticket is not None:
+                    # measured footprint for the packing scheduler's
+                    # reservation reconciliation (release surfaces the
+                    # drift as serving.scheduler.reserve_drift): result
+                    # bytes + the MEASURED resident bytes of the scanned
+                    # tables — table_nbytes accounting on both sides, so
+                    # reserve-vs-measured comparisons cannot drift
+                    ticket.measured_bytes = result_bytes \
+                        + ctx._measured_scan_bytes(
+                            self._plan,
+                            routed[1] if routed is not None else None)
                 est = getattr(self._plan, "_dsql_estimate", None)
                 if est is not None:
                     # the "estimated" side of SHOW PROFILES' observed-vs-
@@ -1087,7 +1115,12 @@ class Context:
         from .serving.scheduler import QueryCost
 
         try:
-            key = self._plan_cache_key(sql, dict(config_options or {}))
+            # Context.sql computes the plan-cache key INSIDE its config
+            # overlay scope (effective_items sees the per-query options);
+            # the peek must mirror that or option-carrying submits never
+            # hit the cache they populated
+            with self.config.set(dict(config_options or {})):
+                key = self._plan_cache_key(sql, config_options)
             if key is None:
                 return None
             with self._plan_lock:
@@ -1103,14 +1136,80 @@ class Context:
                 from .resilience.ladder import plan_fingerprint
 
                 fp = plan_fingerprint(plan)
+            # streamed plans reserve only their per-chunk footprint: re-run
+            # the (pure, read-only) routing decision under this submit's
+            # effective config — never read from the shared plan object, so
+            # the hint is always current with THIS submit's budget
+            chunk = None
+            if est is not None:
+                chunk = self._stream_chunk_hint(plan, est, config_options)
             return QueryCost(
                 bytes_lo=int(est.peak_bytes.lo) if est is not None else 0,
                 pred_exec_ms=self.profiles.predicted_exec_ms(fp),
-                family=fam_fp)
+                family=fam_fp,
+                chunk_bytes_lo=chunk)
         except Exception:  # dsql: allow-broad-except — advisory hint: a
             # lookup bug must degrade to FIFO treatment, never block submit
             logger.debug("cost hint failed for %r", sql, exc_info=True)
             return None
+
+    def _stream_chunk_hint(self, plan, est, config_options):
+        """The provable per-chunk floor a streamed execution of `plan`
+        would reserve under this submit's effective config, or None (the
+        query runs single-launch).  Mirrors the admission gate's routing
+        exactly — same budget parse, same `stream_decision` — but purely
+        read-only, so the submit path never mutates shared plan state."""
+        with self.config.set(dict(config_options or {})):
+            budget = config_module.parse_byte_budget(
+                self.config.get("serving.admission.max_estimated_bytes"))
+            if budget is None or int(est.peak_bytes.lo) <= budget:
+                return None
+            from .streaming import stream_decision
+
+            routed = stream_decision(plan, est, self, self.config, budget)
+        return int(routed[1].chunk_bytes_lo) if routed is not None else None
+
+    def _measured_scan_bytes(self, plan, stream_decision=None) -> int:
+        """MEASURED resident bytes of the registered tables `plan` scans
+        (`serving/cache.table_nbytes` accounting — encoded widths, masks,
+        dictionaries), the scan side of the scheduler's reserve-vs-measured
+        reconciliation.  ``stream_decision`` is this execution's routing
+        verdict (streaming/) when it streamed: the streamed table charges
+        its PER-CHUNK share, because the reservation it reconciles against
+        was the per-chunk floor.  Purely advisory — any failure means 0,
+        never a failed query."""
+        try:
+            from .serving.cache import table_nbytes
+
+            total = 0
+            seen = set()
+            for node in plan_nodes.walk_plan(plan):
+                if not isinstance(node, plan_nodes.TableScan):
+                    continue
+                key = (node.schema_name, node.table_name)
+                if key in seen:
+                    continue
+                seen.add(key)
+                container = self.schema.get(node.schema_name)
+                dc = container.tables.get(node.table_name) \
+                    if container is not None else None
+                if dc is None:
+                    continue
+                from .datacontainer import LazyParquetContainer
+
+                if isinstance(dc, LazyParquetContainer):
+                    continue
+                nbytes = table_nbytes(dc.table)
+                if stream_decision is not None \
+                        and stream_decision.partitions > 1 \
+                        and (stream_decision.schema_name,
+                             stream_decision.table_name) == key:
+                    nbytes = -(-nbytes // stream_decision.partitions)
+                total += nbytes
+            return total
+        except Exception:  # dsql: allow-broad-except — advisory accounting
+            logger.debug("measured scan bytes failed", exc_info=True)
+            return 0
 
     def _feedback_estimate(self, plan, est, fam):
         """Close the profile-feedback loop on one freshly produced (or

@@ -338,6 +338,35 @@ class SegmentReducer:
         return v
 
 
+def agg_argument(ev, slots, a: AggExpr, sel, cache: Dict[Tuple, Tuple]):
+    """One aggregate's ``(argument_or_None, validity)`` pair under trace:
+    the row-selection mask ANDed with the FILTER clause and the argument's
+    own validity (floats additionally drop NaNs — pandas dropna parity).
+    Deduped by (arg, filter) repr in ``cache`` so identical masks register
+    once.  Shared by the finalized-output kernels (below) and the streamed
+    partial-state kernel (streaming/aggregate.py) so their NULL semantics
+    can never drift."""
+    key = (str(a.args[0]) if a.args else "*",
+           str(a.filter) if a.filter is not None else None)
+    got = cache.get(key)
+    if got is not None:
+        return got
+    valid = sel
+    if a.filter is not None:
+        fd, fv = ev.eval(a.filter, slots)
+        valid = valid & (fd if fv is None else (fd & fv))
+    if not a.args:
+        got = (None, valid)
+    else:
+        ad, av = ev.eval(a.args[0], slots)
+        v = valid if av is None else (valid & av)
+        if jnp.issubdtype(ad.dtype, jnp.floating):
+            v = v & ~jnp.isnan(ad)
+        got = (ad, v)
+    cache[key] = got
+    return got
+
+
 def segment_agg_outputs(ev, slots, agg_exprs, sel, gid, domain, reducer):
     """Per-aggregate segment reductions under jit tracing.
 
@@ -354,25 +383,7 @@ def segment_agg_outputs(ev, slots, agg_exprs, sel, gid, domain, reducer):
     arg_cache: Dict[Tuple, Tuple] = {}
 
     def arg_of(a):
-        key = (str(a.args[0]) if a.args else "*",
-               str(a.filter) if a.filter is not None else None)
-        got = arg_cache.get(key)
-        if got is not None:
-            return got
-        valid = sel
-        if a.filter is not None:
-            fd, fv = ev.eval(a.filter, slots)
-            valid = valid & (fd if fv is None else (fd & fv))
-        if not a.args:
-            got = (None, valid)
-        else:
-            ad, av = ev.eval(a.args[0], slots)
-            v = valid if av is None else (valid & av)
-            if jnp.issubdtype(ad.dtype, jnp.floating):
-                v = v & ~jnp.isnan(ad)
-            got = (ad, v)
-        arg_cache[key] = got
-        return got
+        return agg_argument(ev, slots, a, sel, arg_cache)
 
     # phase A: register reductions
     plans = []
@@ -1069,51 +1080,12 @@ class CompiledAggregate:
     def _build(self) -> Callable:
         # metadata-only eval inside the closure: no device buffers pinned
         ev = _TraceEval(_TableMeta(self.table))
-        group_refs = [e.index for e in self.group_exprs]
-        filters = self.filters
         agg_exprs = self.agg_exprs
-        radices = self.radices
-        offsets_ = self.offsets
         domain = self.domain
-        n_cols = len(self.table.column_names)
-        n_rows = self.table.num_rows
 
         def fn(datas, valids, row_valid, params=()):
-            slots = {i: (datas[i], valids[i]) for i in range(n_cols)}
-            slots[PARAMS_SLOT] = params
-            nr = (datas[0].shape[0] if datas
-                  else row_valid.shape[0] if row_valid is not None
-                  else n_rows)
-            # selection mask (never compacts — static shapes end to end);
-            # a padded sharded table contributes its row mask here, so pad
-            # rows never count, never aggregate, never mark a group present
-            mask = row_valid
-            for f in filters:
-                d, v = ev.eval(f, slots)
-                m = d if v is None else (d & v)
-                mask = m if mask is None else (mask & m)
-            # 32-bit radix gid: domain is capped at 2^22 so int32 is exact,
-            # and int64 index arithmetic is emulated on TPU (VERDICT r2 #1)
-            gid = jnp.zeros((), dtype=jnp.int32)
-            first = True
-            for idx, r, off in zip(group_refs, radices, offsets_):
-                codes, valid = slots[idx]
-                # widen sub-int32 keys FIRST (int8/int16 spans can overflow
-                # their own dtype under `x - off`), then subtract in that
-                # dtype (int64 offsets can exceed int32), then narrow: the
-                # result is in [0, span] which always fits int32
-                if codes.dtype == jnp.bool_ or np.dtype(codes.dtype).itemsize < 4:
-                    codes = codes.astype(jnp.int32)
-                if off:
-                    codes = codes - jnp.asarray(off, dtype=codes.dtype)
-                codes = jnp.clip(codes.astype(jnp.int32), 0, r - 2)
-                if valid is not None:
-                    codes = jnp.where(valid, codes, r - 1)
-                gid = codes if first else gid * r + codes
-                first = False
-            if first:
-                gid = jnp.zeros(nr, dtype=jnp.int32)
-            sel = mask if mask is not None else jnp.ones(nr, dtype=bool)
+            slots, sel, gid, nr = self._trace_prelude(ev, datas, valids,
+                                                      row_valid, params)
             reducer = self._make_reducer(gid, domain, nr)
             hit_h = reducer.count(sel)
             outs = segment_agg_outputs(ev, slots, agg_exprs, sel, gid, domain,
@@ -1129,6 +1101,56 @@ class CompiledAggregate:
             return out
 
         return fn
+
+    def _trace_prelude(self, ev: "_TraceEval", datas, valids, row_valid,
+                       params) -> Tuple[Dict, object, object, int]:
+        """The shared traced front half of every aggregate kernel: slot
+        table, deferred filter-mask fold, and the radix group id.  Returns
+        ``(slots, sel, gid, nr)``.  Split from `_build` so the streamed
+        morsel rung (streaming/aggregate.py) can reuse the identical mask
+        and gid semantics under a state-emitting tail — the single-chip,
+        SPMD and streamed kernels share ONE traced body, so their
+        per-chunk/per-shard selections can never drift.  `ev` must be the
+        metadata-only evaluator captured at build time (self.table is
+        nulled once the pipeline enters the plugin cache)."""
+        group_refs = [e.index for e in self.group_exprs]
+        n_cols = len(ev.names)
+        slots = {i: (datas[i], valids[i]) for i in range(n_cols)}
+        slots[PARAMS_SLOT] = params
+        nr = (datas[0].shape[0] if datas
+              else row_valid.shape[0] if row_valid is not None
+              else ev.table.num_rows)
+        # selection mask (never compacts — static shapes end to end);
+        # a padded sharded table contributes its row mask here, so pad
+        # rows never count, never aggregate, never mark a group present
+        mask = row_valid
+        for f in self.filters:
+            d, v = ev.eval(f, slots)
+            m = d if v is None else (d & v)
+            mask = m if mask is None else (mask & m)
+        # 32-bit radix gid: domain is capped at 2^22 so int32 is exact,
+        # and int64 index arithmetic is emulated on TPU (VERDICT r2 #1)
+        gid = jnp.zeros((), dtype=jnp.int32)
+        first = True
+        for idx, r, off in zip(group_refs, self.radices, self.offsets):
+            codes, valid = slots[idx]
+            # widen sub-int32 keys FIRST (int8/int16 spans can overflow
+            # their own dtype under `x - off`), then subtract in that
+            # dtype (int64 offsets can exceed int32), then narrow: the
+            # result is in [0, span] which always fits int32
+            if codes.dtype == jnp.bool_ or np.dtype(codes.dtype).itemsize < 4:
+                codes = codes.astype(jnp.int32)
+            if off:
+                codes = codes - jnp.asarray(off, dtype=codes.dtype)
+            codes = jnp.clip(codes.astype(jnp.int32), 0, r - 2)
+            if valid is not None:
+                codes = jnp.where(valid, codes, r - 1)
+            gid = codes if first else gid * r + codes
+            first = False
+        if first:
+            gid = jnp.zeros(nr, dtype=jnp.int32)
+        sel = mask if mask is not None else jnp.ones(nr, dtype=bool)
+        return slots, sel, gid, nr
 
     def _make_reducer(self, gid, domain: int, n_rows: int) -> SegmentReducer:
         """Reducer factory the traced kernel calls — the seam the SPMD

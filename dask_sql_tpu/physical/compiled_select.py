@@ -96,6 +96,13 @@ def _extract(root):
 
 
 class CompiledSelect:
+    #: the ladder-rung label this pipeline's compiles are recorded under
+    #: (``resilience.compile_ms.<rung>`` histograms, ``compile:<rung>``
+    #: trace spans) — subclasses that serve a DIFFERENT rung (the streamed
+    #: select, streaming/select.py) override it so their compiles never
+    #: pollute this rung's compile-cost prior (ladder.cost_skip reads it)
+    _RUNG = "compiled_select"
+
     def __init__(self, table: Table, scan, upper_filters, scan_filters,
                  proj, proj_exprs, sort_keys, sort_fetch, limit, inner_limit,
                  params=()):
@@ -255,7 +262,7 @@ class CompiledSelect:
         datas = tuple(t.columns[n].data for n in t.column_names)
         valids = tuple(t.columns[n].validity for n in t.column_names)
         mask, count_dev = timed_jit_call(
-            "compiled_select", self._mask_fn, datas, valids, t.row_valid,
+            self._RUNG, self._mask_fn, datas, valids, t.row_valid,
             tuple(params), may_compile=not self._mask_warm)
         self._mask_warm = True
         count_d2h()
@@ -282,7 +289,7 @@ class CompiledSelect:
         valids = tuple(table.columns[c].validity
                        for c in table.column_names)
         masks, counts_dev = timed_jit_call(
-            "compiled_select", self._mask_batched, datas, valids,
+            self._RUNG, self._mask_batched, datas, valids,
             table.row_valid, stacked,
             may_compile=bucket not in self._warm_mask_batch)
         self._warm_mask_batch.add(bucket)
@@ -306,7 +313,7 @@ class CompiledSelect:
             bucket = 1 << (count - 1).bit_length()
             # jit re-specializes per bucket: each new bucket is a fresh
             # XLA compile the observability layer records per rung
-            packed = timed_jit_call("compiled_select", self._gather_fn,
+            packed = timed_jit_call(self._RUNG, self._gather_fn,
                                     datas, valids, mask, params,
                                     bucket=bucket,
                                     may_compile=bucket not in

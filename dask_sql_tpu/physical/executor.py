@@ -32,6 +32,11 @@ class Executor:
             self.tracer.start()
         #: (schema, table) -> Table substitutions (streaming batch execution)
         self.table_overrides: Dict[tuple, Table] = {}
+        #: id(streamable node) -> StreamDecision for THIS execution
+        #: (streaming/): the admission gate's routing verdict travels here
+        #: — per-execution state, never on the shared cached plan object,
+        #: so concurrent executions under different budgets cannot race
+        self.stream_decisions: Dict[int, object] = {}
 
     @classmethod
     def add_plugin_class(cls, plugin_class):
@@ -69,7 +74,21 @@ class Executor:
         from ..parallel.dist_plan import plan_has_sharded_scan
 
         sharded = plan_has_sharded_scan(rel, self.context)
+        # admission-routed streamed select (streaming/, this execution's
+        # stream_decisions entry): a provably-oversize root chain serves as
+        # N pipelined chunk launches instead of being shed — its own
+        # (family, rung) breaker entity, stepping down to the single-launch
+        # rungs below
+        streamed_mark = id(rel) in self.stream_decisions
         if self.config.get("resilience.ladder.enabled", True):
+            if streamed_mark:
+                from ..streaming import try_streamed_select
+
+                out = ladder.attempt(
+                    self, "streamed_select",
+                    lambda: try_streamed_select(rel, self), rel=rel)
+                if out is not None:
+                    return out
             if sharded:
                 # the SPMD rung sits above the single-chip one (which
                 # declines sharded tables); its failures degrade and
@@ -90,6 +109,12 @@ class Executor:
             return ladder.execute_interpreted(self, rel)
         # ladder disabled: injection sites still fire (a forced compile
         # fault must propagate here — that is what disabling proves)
+        if streamed_mark:
+            from ..streaming import try_streamed_select
+
+            out = try_streamed_select(rel, self)
+            if out is not None:
+                return out
         if sharded:
             faults.maybe_inject("spmd", self.config)
             out = try_spmd_select(rel, self)

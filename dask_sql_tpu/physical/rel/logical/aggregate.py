@@ -60,6 +60,19 @@ class AggregatePlugin(BaseRelPlugin):
         # the explicit all_to_all shuffle engine remains the general path
         tried_join_pipeline = False
         tried_compiled = False
+        if id(rel) in executor.stream_decisions:
+            # admission-routed streamed aggregation (streaming/): the
+            # provably-oversize scan executes as N pipelined morsel
+            # launches with time-axis partial-state combines instead of
+            # being shed.  Its OWN (family, streamed_aggregate) breaker
+            # entity: an exhausted mid-stream recovery degrades to the
+            # single-launch rungs below without poisoning them.
+            from ....streaming import try_streamed_aggregate
+
+            streamed = rung("streamed_aggregate",
+                            lambda: try_streamed_aggregate(rel, executor))
+            if streamed is not None:
+                return streamed
         if dist_plan.plan_has_sharded_scan(rel.input, executor.context):
             from ....spmd import try_spmd_aggregate, try_spmd_join_aggregate
 

@@ -7,6 +7,10 @@
 - ``once``       fail the first arm() at that site, then never again;
 - ``always``     fail every time;
 - an integer N   fail the first N arms;
+- ``atK``        fail exactly the K-th arm (1-based), once — positions a
+                 fault MID-SEQUENCE (e.g. ``partition:at2`` fails the
+                 second partition launch of a streamed scan, proving the
+                 resume path re-executes nothing already completed);
 - a float p<1    fail with probability p from a seeded PRNG
                  (``resilience.inject.seed``), so a given (seed, spec)
                  produces the same failure sequence every run.
@@ -24,6 +28,11 @@ Sites wired through the engine (each raises the matching taxonomy error):
                 the device->CPU rung)
     execute     executor entry (TransientExecutionError — proves the
                 ServingRuntime retry/backoff policy)
+    partition   one streamed partition launch (streaming/runner.py;
+                ResourceExhaustedError — proves the mid-stream OOM
+                recovery: repartition + resume from the last completed
+                partition, then streamed->interpreted step-down when the
+                chunk floor is reached)
     checkpoint  checkpoint.save_state mid-write, before the atomic CURRENT
                 repoint (ExecutionError — proves crash recoverability)
 
@@ -77,6 +86,7 @@ SITE_ERRORS = {
     "oom": InjectedOomError,
     "exec_oom": InjectedOomError,
     "execute": InjectedTransientError,
+    "partition": InjectedOomError,
     "checkpoint": InjectedWriteError,
 }
 
@@ -88,17 +98,26 @@ HANG_SECONDS_KEY = "resilience.inject.hang_s"
 
 
 class _SiteRule:
-    __slots__ = ("mode", "budget", "probability", "fired")
+    __slots__ = ("mode", "budget", "probability", "fired", "at_index",
+                 "arms")
 
     def __init__(self, mode: str):
         self.mode = mode
         self.budget: Optional[int] = None
         self.probability: Optional[float] = None
+        self.at_index: Optional[int] = None
         self.fired = 0
+        self.arms = 0
         if mode == "once":
             self.budget = 1
         elif mode == "always":
             self.budget = None
+        elif mode.startswith("at") and mode[2:].isdigit():
+            # fire exactly the K-th arm (1-based), once: places the fault
+            # mid-sequence so resume paths are testable
+            self.at_index = int(mode[2:])
+            if self.at_index < 1:
+                raise ValueError(f"atK index must be >= 1, got {mode!r}")
         else:
             try:
                 self.budget = int(mode)
@@ -109,8 +128,11 @@ class _SiteRule:
                         f"fault probability must be in [0, 1], got {mode!r}")
 
     def arm(self, rng: random.Random) -> bool:
+        self.arms += 1
         if self.probability is not None:
             hit = rng.random() < self.probability
+        elif self.at_index is not None:
+            hit = self.arms == self.at_index
         else:
             hit = self.budget is None or self.fired < self.budget
         if hit:

@@ -26,6 +26,10 @@ single-chip counterparts and fire only for mesh-sharded scans; each is its
 own breaker entity per (family, rung), so a flaky SPMD path degrades to
 single-chip without poisoning the family):
 
+    streamed_select         streaming/select.py chunked root select chain
+                            (fires only for admission-routed oversize plans)
+    streamed_aggregate      streaming/aggregate.py morsel partial-state
+                            aggregation with time-axis combines (ditto)
     spmd_select             spmd/select.py shard_map root select chain
     spmd_aggregate          spmd/aggregate.py psum tree-reduce aggregation
     spmd_join_aggregate     spmd/join.py broadcast-join SPMD pipeline

@@ -81,27 +81,53 @@ class EstimatedBytesExceededError(ResourceExhaustedError):
         return out
 
 
-def check_estimated_bytes(estimate, config, metrics=None) -> None:
+def check_estimated_bytes(estimate, config, metrics=None, plan=None,
+                          context=None):
     """The ``serving.admission.max_estimated_bytes`` gate: raise
     `EstimatedBytesExceededError` when the estimate's *lower* bound on peak
     device bytes exceeds the budget.  Called by ``TpuFrame.execute`` after
     the result-cache lookup and before any executor/compiler work — only
     the lower bound sheds, because only it is provable (an upper-bound shed
-    would reject feasible queries)."""
+    would reject feasible queries).
+
+    Streaming escape hatch (streaming/, docs/serving.md "Streaming
+    execution"): when ``plan`` and ``context`` are supplied, an over-budget
+    plan that is *partitionable* — its floor dominated by one registered
+    table's scan, its shape one a streamed rung serves, and its provable
+    PER-CHUNK floor within the budget — returns ``(streamable node,
+    StreamDecision)`` instead of shedding; the caller hands the pair to
+    ITS executor (`Executor.stream_decisions`), so the verdict is
+    per-execution state — a concurrent execution of the same cached plan
+    under a different budget can never null it mid-flight.  Returns None
+    when the query is simply admitted.  ``shed:estimated_bytes`` is the
+    last resort: it fires only when even one chunk provably cannot fit."""
     from ..config import parse_byte_budget
 
     budget = None if config is None else parse_byte_budget(
         config.get("serving.admission.max_estimated_bytes"))
     if budget is None or estimate is None:
-        return
+        return None
     lo = int(estimate.peak_bytes.lo)
-    if lo > budget:
-        if metrics is not None:
-            metrics.inc("serving.shed_estimated_bytes")
-        from ..observability import trace_event
+    if lo <= budget:
+        return None
+    from ..observability import trace_event
 
-        trace_event("shed:estimated_bytes", bytes_lo=lo, budget=budget)
-        raise EstimatedBytesExceededError(lo, budget)
+    if plan is not None and context is not None:
+        from ..streaming import stream_decision
+
+        routed = stream_decision(plan, estimate, context, config, budget)
+        if routed is not None:
+            _, decision = routed
+            if metrics is not None:
+                metrics.inc("serving.stream.admitted")
+            trace_event("admit:streamed", bytes_lo=lo, budget=budget,
+                        partitions=decision.partitions,
+                        chunk_bytes_lo=decision.chunk_bytes_lo)
+            return routed
+    if metrics is not None:
+        metrics.inc("serving.shed_estimated_bytes")
+    trace_event("shed:estimated_bytes", bytes_lo=lo, budget=budget)
+    raise EstimatedBytesExceededError(lo, budget)
 
 
 class DeadlineExceededError(DeadlineError):
@@ -120,7 +146,7 @@ class QueryTicket:
     """
 
     __slots__ = ("qid", "priority_class", "deadline", "admitted_at",
-                 "started_at", "_cancelled", "cost")
+                 "started_at", "_cancelled", "cost", "measured_bytes")
 
     def __init__(self, qid: str, priority_class: str = "interactive",
                  deadline: Optional[float] = None):
@@ -135,6 +161,12 @@ class QueryTicket:
         #: the submit carried one — rides the ticket so the executing
         #: thread (family batcher, metrics) can see its own cost view
         self.cost = None
+        #: MEASURED footprint bytes of the finished execution (result +
+        #: scanned-table resident bytes, `serving/cache.table_nbytes`
+        #: accounting), recorded by TpuFrame.execute so the packing
+        #: scheduler can reconcile its reservation on release
+        #: (``serving.scheduler.reserve_drift``)
+        self.measured_bytes = None
 
     def cancel(self) -> None:
         self._cancelled = True

@@ -101,6 +101,10 @@ DOCUMENTED_METRICS = frozenset({
     "resilience.retry.recovered",
     "resilience.retry.deadline_abort",
     "resilience.retry.backoff_ms",
+    # resilience/ + streaming/ — mid-stream partition fault handling
+    # (streaming/runner.py, docs/resilience.md "Partition faults")
+    "resilience.partition.oom",
+    "resilience.partition.exhausted",
     # serving/ — admission, runtime
     "serving.admitted",
     "serving.rejected",
@@ -121,6 +125,15 @@ DOCUMENTED_METRICS = frozenset({
     "serving.scheduler.cost_rung_skip",
     "serving.scheduler.inflight_bytes",
     "serving.scheduler.running",
+    "serving.scheduler.reserve_drift",
+    # serving/ + streaming/ — streamed partitioned execution
+    # (streaming/, docs/serving.md "Streaming execution")
+    "serving.stream.admitted",
+    "serving.stream.queries",
+    "serving.stream.partitions",
+    "serving.stream.repartitions",
+    "serving.stream.rows",
+    "serving.stream.chunk_rows",
     # serving/ — zero-cold-start: pre-warm + background recompile
     "serving.warmup.started",
     "serving.warmup.warmed",

@@ -322,7 +322,10 @@ class ServingRuntime:
             if ticket.priority_class == "batch":
                 self._batch_in_flight -= 1
             if self.scheduler is not None:
-                self.scheduler.release_locked(ticket)
+                # reconcile the reservation with the measured footprint
+                # the executing thread recorded (None when it never ran)
+                self.scheduler.release_locked(
+                    ticket, getattr(ticket, "measured_bytes", None))
             self._cv.notify_all()
 
     # ------------------------------------------------------------ lifecycle

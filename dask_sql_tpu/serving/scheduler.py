@@ -75,6 +75,17 @@ class QueryCost:
     #: family batcher's rendezvous window consults
     family: Optional[str] = None
     tenant: str = ""
+    #: streamed partitioned execution (streaming/): the provable PER-CHUNK
+    #: floor.  When set, the packer reserves THIS instead of ``bytes_lo`` —
+    #: a streaming batch scan only ever holds one chunk's working set, so
+    #: interactive queries keep packing beside it instead of waiting out
+    #: the whole-table floor
+    chunk_bytes_lo: Optional[int] = None
+
+    def reserve_bytes(self) -> int:
+        """What the packer actually reserves for this query."""
+        return int(self.chunk_bytes_lo if self.chunk_bytes_lo is not None
+                   else self.bytes_lo)
 
 
 class TokenBucket:
@@ -284,7 +295,7 @@ class PackingScheduler:
             # gate's problem — it sheds; the scheduler must not also
             # deadlock it.)
             return True
-        return self.reserved_bytes + int(item.cost.bytes_lo) \
+        return self.reserved_bytes + item.cost.reserve_bytes() \
             <= self.budget_bytes
 
     def _dispatch(self, item: _Item) -> None:
@@ -294,7 +305,7 @@ class PackingScheduler:
         # token, reserve budget, or pollute the packed/drain statistics
         dead = item.ticket.cancelled or item.ticket.expired()
         reserve = 0 if dead or self.budget_bytes is None \
-            else int(item.cost.bytes_lo)
+            else item.cost.reserve_bytes()
         if not dead:
             if self._running:
                 self._inc("serving.scheduler.packed")
@@ -310,13 +321,27 @@ class PackingScheduler:
             item.cost, self._clock(), reserve)
         self._gauges()
 
-    def release_locked(self, ticket: QueryTicket) -> None:
+    def release_locked(self, ticket: QueryTicket,
+                       measured_bytes: Optional[int] = None) -> None:
         """Return a dispatched query's reservation — called from the
         runtime's `_release` on EVERY outcome (success, failure, deadline,
-        cancel, mid-pack fault), so reserved bytes can never leak."""
+        cancel, mid-pack fault), so reserved bytes can never leak.
+
+        ``measured_bytes`` is the execution's MEASURED footprint when the
+        executing thread recorded one (TpuFrame.execute writes
+        ``ticket.measured_bytes`` from `serving/cache.table_nbytes`-style
+        accounting): the packer reconciles it against what it reserved and
+        surfaces the signed drift as ``serving.scheduler.reserve_drift``
+        (measured - reserved, bytes) — the estimator-calibration signal
+        behind packing against measured rather than estimated bytes."""
         rec = self._running.pop(ticket.qid, None)
         if rec is not None:
             self.reserved_bytes -= rec.reserved
+            if measured_bytes is not None and rec.reserved > 0 \
+                    and self.metrics is not None:
+                self.metrics.observe("serving.scheduler.reserve_drift",
+                                     float(int(measured_bytes)
+                                           - rec.reserved))
         self._gauges()
 
     # ------------------------------------------------------------- queries

@@ -1,0 +1,31 @@
+"""Streaming partitioned execution: serve larger-than-budget working sets.
+
+The vertical slice that turns the admission gate's ``shed:estimated_bytes``
+into graceful degradation (ROADMAP item 4, docs/serving.md "Streaming
+execution"):
+
+- `plan.stream_decision` — admission-time routing: a provably-over-budget
+  plan whose floor is dominated by ONE registered table's scan partitions
+  along the row axis; shedding becomes the last resort;
+- `partition` — fixed-shape encoded row chunks (one morsel shape = one
+  executable, zero recompile across chunks);
+- `runner.drive_partitions` — pipelined launches with per-partition
+  retry/backoff, cooperative deadline checkpoints between launches, and
+  mid-stream OOM recovery that halves the partition size and RESUMES from
+  the checkpointable partial-combine state;
+- `aggregate` / `select` — the streamed ladder rungs: partial aggregation
+  states tree-reduced across the time axis with the same combine algebra
+  the SPMD rungs use across the mesh axis, and survivor chunks
+  concatenated in global row order.
+"""
+from .aggregate import StreamedAggregate, try_streamed_aggregate
+from .plan import StreamDecision, stream_decision
+from .select import try_streamed_select
+
+__all__ = [
+    "StreamDecision",
+    "StreamedAggregate",
+    "stream_decision",
+    "try_streamed_aggregate",
+    "try_streamed_select",
+]

@@ -1,0 +1,64 @@
+"""Fixed-shape row partitions of a registered columnar table.
+
+The streamed rungs (streaming/aggregate.py, streaming/select.py) execute a
+provably-oversize scan as N pipelined launches of ONE morsel-shaped
+executable, so every chunk must present the *identical* array shapes to the
+kernel — otherwise each partition would pay a fresh XLA trace and the
+zero-recompile family guarantee (families/, PR 7) would not survive the
+partition axis.  Two mechanisms keep the shape static without ever
+allocating pad buffers:
+
+- every chunk is an exact ``chunk_rows``-long positional slice of the
+  stored column buffers (DICT/FOR codes slice like values, so the h2d /
+  working-set bytes of a chunk are its ENCODED bytes — the compressed-wire
+  argument of arXiv:2506.10092 applied to the time axis);
+- the FINAL chunk, which would come up short, slides its window back to
+  ``total - chunk_rows`` and masks the overlap with ``row_valid`` — the
+  same padded-table mask the compiled kernels already fold into their
+  selection (physical/compiled.py `_trace_prelude`), so overlap rows are
+  provably never counted, never aggregated, never gathered.
+
+RLE columns are run-aligned and do not slice positionally; eligibility
+checks (streaming/plan.py) decline them before a partition is ever cut.
+"""
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import jax.numpy as jnp
+
+from ..columnar.table import Table
+
+
+def partition_layout(total_rows: int, chunk_rows: int
+                     ) -> List[Tuple[int, int]]:
+    """``[(lo, hi)]`` logical row ranges covering ``[0, total_rows)`` in
+    order, every range ``chunk_rows`` long except the last."""
+    out: List[Tuple[int, int]] = []
+    lo = 0
+    while lo < total_rows:
+        out.append((lo, min(lo + chunk_rows, total_rows)))
+        lo += chunk_rows
+    return out
+
+
+def slice_chunk(table: Table, lo: int, chunk_rows: int) -> Table:
+    """Rows ``[lo, lo + chunk_rows)`` of an UNPADDED table as an exactly
+    ``chunk_rows``-row Table with a ``row_valid`` mask.
+
+    The mask is always materialized (all-True for interior chunks): the
+    morsel executable's signature must not alternate between mask and
+    no-mask chunks, or the final chunk would re-trace.  The final chunk
+    shifts its window back so the buffers stay full-length; rows before
+    ``lo`` in the shifted window are masked out."""
+    total = table.num_rows
+    if chunk_rows > total:
+        raise ValueError(
+            f"chunk_rows {chunk_rows} exceeds table rows {total}")
+    start = min(lo, total - chunk_rows)
+    cols = {name: col.slice(start, start + chunk_rows)
+            for name, col in table.columns.items()}
+    valid = jnp.arange(chunk_rows) + start >= lo
+    return Table(cols, chunk_rows, row_valid=valid)
+
+

@@ -1,0 +1,232 @@
+"""Stream decision: can a provably-oversize plan serve as N partitions?
+
+The admission gate (serving/admission.py) used to have exactly two answers
+for a query whose provable ``peak_bytes.lo`` floor exceeds the device
+budget: run it anyway (and OOM) or shed it with a 429.  This module adds
+the third: when the oversize part of the floor is ONE registered table's
+scan, the scan partitions along the row axis — the reference engine's
+partition model (PAPER.md layer 1), executed as pipelined morsel launches
+(TQP arXiv:2203.01877) — and the query serves with a per-chunk working set
+that provably fits.
+
+The sizing algebra works entirely on the estimator's provable floors
+(analysis/estimator.py):
+
+    rest      = peak_bytes.lo - scan_bytes_lo     # does not shrink with N
+    headroom  = budget - rest                     # what a chunk may spend
+    N         = ceil(scan_bytes_lo / headroom)    # partitions needed
+    chunk_lo  = ceil(scan_bytes_lo / N) + rest    # the per-chunk floor
+
+``shed:estimated_bytes`` becomes the LAST resort: it fires only when even
+one chunk provably cannot fit (``headroom <= 0``, or the minimum chunk the
+config allows still exceeds the budget, or the partition count explodes
+past ``serving.stream.max_partitions``).
+
+Eligibility is deliberately static and conservative — exactly one scanned
+table, registered in-memory (lazy parquet already streams through
+physical/streaming.py; mesh-sharded tables belong to the SPMD rungs), no
+RLE columns (run-aligned storage does not slice positionally), and a plan
+shape one of the streamed rungs serves (scan->filter*->aggregate chain, or
+a root scan->filter*->project chain).  A runtime decline inside the rung
+still steps down the ladder like any other rung.
+"""
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+from ..planner import plan as p
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class StreamDecision:
+    """One admission-time routing verdict.  Deliberately plan-reference-
+    free (it rides tickets and cost hints); the verdict travels to the
+    matching ladder rung PER EXECUTION via ``Executor.stream_decisions``
+    (keyed by the streamable node's identity) — never as mutable state on
+    the shared cached plan object, where a concurrent execution's re-check
+    could null it mid-flight (the set-run-reset hazard
+    physical/compiled.py's run() documents)."""
+
+    kind: str                # "aggregate" | "select"
+    schema_name: str
+    table_name: str
+    total_rows: int
+    chunk_rows: int
+    partitions: int
+    #: provable per-chunk floor: what the packing scheduler reserves and
+    #: what the gate compared against the budget
+    chunk_bytes_lo: int
+    #: the whole-scan floor the partitioning divided (for observability)
+    scan_bytes_lo: int
+    #: the gate numbers behind this routing — carried so a rung that
+    #: discovers construction-time ineligibility (a shape the static walk
+    #: could not rule out, e.g. a radix span only device data reveals) can
+    #: RE-SHED with the same structured 429 the gate would have raised,
+    #: instead of silently running the over-budget plan on lower rungs
+    peak_bytes_lo: int = 0
+    budget_bytes: int = 0
+
+
+def shed_ineligible(decision: "StreamDecision", metrics=None,
+                    reason: str = "") -> None:
+    """A ROUTED plan the rung discovered it cannot actually serve
+    (construction-time `_Unsupported`: radix spans only device data
+    reveals, trace-ineligible expressions): raise the gate's structured
+    shed.  The admission contract must hold — the alternative (declining
+    down the ladder) executes the full provably-over-budget working set on
+    a single-launch rung, which is exactly the OOM the gate exists to
+    prevent.  Degradable *failures* inside the rung are different: those
+    step down like any rung failure (docs/resilience.md)."""
+    from ..observability import trace_event
+    from ..serving.admission import EstimatedBytesExceededError
+
+    if metrics is not None:
+        metrics.inc("serving.shed_estimated_bytes")
+    trace_event("shed:estimated_bytes", bytes_lo=decision.peak_bytes_lo,
+                budget=decision.budget_bytes, ineligible=reason or True)
+    logger.info("streamed rung cannot serve a routed oversize plan (%s); "
+                "shedding with the gate's 429 instead of running "
+                "over-budget", reason or "ineligible")
+    raise EstimatedBytesExceededError(decision.peak_bytes_lo,
+                                      decision.budget_bytes)
+
+
+def _streamable_node(plan: p.LogicalPlan):
+    """(node, kind) the streamed rungs can serve, or None.
+
+    Aggregate: the first Aggregate whose scan->filter*->aggregate chain
+    extracts (the exact eligibility the compiled/SPMD aggregate rungs
+    share) with partial-izable aggregate functions.  Select: the plan root
+    itself matches the compiled-select chain with no sort/limit windows
+    (windows are global row properties a chunk cannot see).  The caller
+    has already proven the plan holds exactly ONE TableScan, so whichever
+    chain extracts necessarily ends at that scan."""
+    from ..physical.compiled import (
+        _Unsupported,
+        _extract_chain,
+        check_agg_static_support,
+    )
+    from ..planner.expressions import ColumnRef
+
+    for node in p.walk_plan(plan):
+        if not isinstance(node, p.Aggregate):
+            continue
+        chain = _extract_chain(node)
+        if chain is None:
+            continue
+        _, _, group_exprs, agg_exprs = chain
+        try:
+            check_agg_static_support(agg_exprs)
+        except _Unsupported:
+            return None
+        if not all(isinstance(e, ColumnRef) and type(e) is ColumnRef
+                   for e in group_exprs):
+            return None
+        return node, "aggregate"
+    from ..physical.compiled_select import _extract
+
+    got = _extract(plan)
+    if got is not None:
+        _, _, _, sort_keys, sort_fetch, limit, inner_limit = got
+        if sort_keys is None and limit is None and inner_limit is None \
+                and sort_fetch is None:
+            return plan, "select"
+    return None
+
+
+def stream_decision(plan: p.LogicalPlan, estimate, context, config,
+                    budget: int
+                    ) -> Optional[Tuple[p.LogicalPlan, StreamDecision]]:
+    """Route one provably-over-budget plan to streamed execution:
+    ``(streamable node, decision)``, or None (the caller sheds).  The node
+    is the SAME object the eligibility walk validated — callers hand it to
+    the executor directly, so the verdict can never attach to a node the
+    sizing was not computed for.  Pure read: no plan mutation."""
+    if not config.get("serving.stream.enabled", True):
+        return None
+    if not config.get("sql.compile", True):
+        # MIRROR of the streamed rungs' own precondition: routing a plan
+        # the rung will decline would bypass the shed and execute the full
+        # over-budget working set on a lower rung — worse than the 429
+        return None
+    scan_lo = int(getattr(estimate, "scan_bytes_lo", 0) or 0)
+    if scan_lo <= 0:
+        return None  # nothing partitionable dominates the floor
+    rest = max(0, int(estimate.peak_bytes.lo) - scan_lo)
+    headroom = budget - rest
+    if headroom <= 0:
+        return None  # even a zero-row chunk cannot fit beside the rest
+    scans = [n for n in p.walk_plan(plan) if isinstance(n, p.TableScan)]
+    if len(scans) != 1:
+        return None
+    scan = scans[0]
+    container = context.schema.get(scan.schema_name)
+    dc = container.tables.get(scan.table_name) if container is not None \
+        else None
+    if dc is None:
+        return None
+    from ..datacontainer import LazyParquetContainer
+
+    if isinstance(dc, LazyParquetContainer):
+        return None  # the out-of-core parquet path already streams
+    table = dc.table
+    if table.row_valid is not None:
+        return None  # padded/sharded storage: the SPMD rungs own it
+    from ..parallel.dist_plan import table_is_sharded
+
+    if table_is_sharded(table):
+        return None
+    from ..columnar.encodings import Encoding
+
+    if any(getattr(c, "encoding", Encoding.PLAIN) is Encoding.RLE
+           for c in table.columns.values()):
+        return None  # run-aligned storage does not slice positionally
+    total = int(table.num_rows)
+    if total <= 1:
+        return None
+    got = _streamable_node(plan)
+    if got is None:
+        return None
+    node, kind = got
+    if kind == "select" and not config.get("sql.compile.select", True):
+        return None  # the select rung's extra precondition, mirrored
+
+    # ---- partition sizing over the provable floors ----------------------
+    # the largest chunk whose scan share provably fits the headroom (floor
+    # division: rounding must never overshoot the budget)
+    chunk_cap = headroom * total // scan_lo
+    chunk_rows = int(config.get("serving.stream.chunk_rows") or 0)
+    if chunk_rows <= 0:
+        chunk_rows = chunk_cap
+    min_rows = max(1, int(config.get("serving.stream.min_chunk_rows", 4096)))
+    chunk_rows = max(min(chunk_rows, total), min(min_rows, total))
+    if chunk_rows < 1:
+        return None
+    n_parts = -(-total // chunk_rows)
+    if n_parts < 2:
+        # the gate only calls for an over-budget plan; a single launch is
+        # what just proved infeasible
+        return None
+    max_parts = int(config.get("serving.stream.max_partitions", 256))
+    if n_parts > max_parts:
+        return None
+    chunk_scan_lo = -(-scan_lo * chunk_rows // total)
+    chunk_bytes_lo = chunk_scan_lo + rest
+    if chunk_bytes_lo > budget:
+        return None  # even one chunk provably cannot fit: shed
+    return node, StreamDecision(
+        kind=kind,
+        schema_name=scan.schema_name,
+        table_name=scan.table_name,
+        total_rows=total,
+        chunk_rows=chunk_rows,
+        partitions=n_parts,
+        chunk_bytes_lo=chunk_bytes_lo,
+        scan_bytes_lo=scan_lo,
+        peak_bytes_lo=int(estimate.peak_bytes.lo),
+        budget_bytes=int(budget),
+    )

@@ -1,0 +1,134 @@
+"""The partition drive loop: pipelined launches + mid-stream OOM recovery.
+
+This is the robustness core of streamed partitioned execution.  One loop
+serves both streamed rungs (aggregate and select); per iteration it
+
+- polls the serving ticket's cooperative cancellation checkpoint, so a
+  streamed batch scan stays responsive to ``X-Dsql-Deadline-Ms`` and
+  client cancels BETWEEN launches (a single fused launch was never
+  preemptible; N launches give N-1 preemption points);
+- arms the ``partition`` fault-injection site and launches one partition
+  under the engine's existing retry/backoff policy (resilience/retry.py)
+  — taxonomy-*retryable* failures (transient runtime errors) retry in
+  place, bounded by the ticket's deadline;
+- absorbs a *degradable* ``RESOURCE_EXHAUSTED`` — a real mid-stream device
+  OOM or the injected fault — by HALVING the partition size and RESUMING
+  from the first row no completed partition covered: the checkpointable
+  partial-combine state (the aggregate's running segment states, the
+  select's survivor list) lives in the caller's accumulator, so completed
+  partitions are never re-executed.  Only when halving would cross
+  ``serving.stream.min_chunk_rows`` does the failure propagate, where the
+  degradation ladder treats it like any rung failure: recorded, breaker-
+  charged per (family, rung), stepped down.
+
+Launches are pipelined, not synchronized: a partition launch enqueues
+asynchronously on the device (XLA async dispatch) and the combine consumes
+its output without a host round trip, so partition i+1's transfer overlaps
+partition i's compute — the morsel-driven pipelining argument of TQP
+(arXiv:2203.01877) on the time axis.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Callable
+
+from ..observability import stage, trace_event
+from ..resilience import faults
+from ..resilience.errors import ResourceExhaustedError, classify
+from ..resilience.retry import BackoffPolicy, retry_call
+
+logger = logging.getLogger(__name__)
+
+
+def drive_partitions(executor, decision, launch: Callable[[int, int], None],
+                     rung: str) -> int:
+    """Run every partition of ``decision``; returns the number of launches.
+
+    ``launch(lo, chunk_rows)`` executes ONE partition covering logical rows
+    ``[lo, min(lo + chunk_rows, total))`` and folds its output into the
+    caller's accumulator.  It is called with monotonically non-decreasing
+    ``lo`` and may see ``chunk_rows`` shrink after an absorbed OOM; the
+    caller's executable re-specializes per chunk shape (one extra compile
+    per repartition — the cost of surviving instead of failing)."""
+    config = executor.config
+    metrics = executor.context.metrics
+    from ..serving.runtime import current_ticket
+
+    ticket = current_ticket()
+    policy = BackoffPolicy.from_config(config)
+    total = int(decision.total_rows)
+    chunk_rows = min(int(decision.chunk_rows), total)
+    min_rows = min(
+        max(1, int(config.get("serving.stream.min_chunk_rows", 4096))),
+        total)
+    # recovery launch bound: halving must not multiply the admitted
+    # partition count unboundedly — the config documents
+    # serving.stream.max_partitions as a latency bound, so recovery may
+    # at most DOUBLE it before the failure degrades down the ladder
+    max_launches = 2 * max(1, int(
+        config.get("serving.stream.max_partitions", 256)))
+    rows_done = 0
+    part_idx = 0
+    launches = 0
+    while rows_done < total:
+        if ticket is not None:
+            # deadline/cancel checkpoint between launches: a deadline that
+            # expires mid-stream raises here, not after the full scan
+            ticket.checkpoint()
+        lo = rows_done
+        hi = min(lo + chunk_rows, total)
+        try:
+            with stage("stream_partition", rung=rung, index=part_idx,
+                       row_lo=lo, rows=hi - lo, chunk_rows=chunk_rows):
+
+                def attempt():
+                    faults.maybe_inject("partition", config)
+                    launch(lo, chunk_rows)
+
+                retry_call(attempt, policy, ticket=ticket, metrics=metrics)
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except BaseException as exc:  # dsql: allow-broad-except — classified
+            # below; only degradable RESOURCE_EXHAUSTED is absorbed (that is
+            # the repartition contract), everything else re-raises unchanged
+            err = classify(exc)
+            if not (err.degradable
+                    and isinstance(err, ResourceExhaustedError)):
+                raise
+            metrics.inc("resilience.partition.oom")
+            trace_event("stream_oom", rung=rung, row_lo=lo,
+                        chunk_rows=chunk_rows)
+            half = chunk_rows // 2
+            projected = launches + (-(-(total - rows_done) // half)
+                                    if half else 0)
+            if half < min_rows or projected > max_launches:
+                # recovery exhausted: the chunk floor was reached, or the
+                # halving would blow the documented launch bound.  Surface
+                # the OOM to the degradation ladder, which records/
+                # breaker-charges (family, rung) and steps down —
+                # completed partial state is discarded with the rung,
+                # exactly like any other rung failure
+                metrics.inc("resilience.partition.exhausted")
+                trace_event("stream_exhausted", rung=rung,
+                            chunk_rows=chunk_rows)
+                logger.warning(
+                    "streamed %s: partition of %d rows still exhausts "
+                    "resources at the %d-row floor; stepping down",
+                    rung, chunk_rows, min_rows)
+                raise
+            chunk_rows = half
+            metrics.inc("serving.stream.repartitions")
+            trace_event("stream_repartition", rung=rung,
+                        chunk_rows=chunk_rows, resume_row=rows_done)
+            logger.info(
+                "streamed %s: mid-stream OOM at row %d; repartitioning to "
+                "%d-row chunks and resuming from row %d (completed "
+                "partitions kept)", rung, lo, chunk_rows, rows_done)
+            continue  # rows_done unchanged: resume, never restart
+        rows_done = hi
+        part_idx += 1
+        launches += 1
+        metrics.inc("serving.stream.partitions")
+        metrics.inc("serving.stream.rows", hi - lo)
+    metrics.observe("serving.stream.chunk_rows", chunk_rows)
+    return launches

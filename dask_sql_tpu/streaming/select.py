@@ -1,0 +1,177 @@
+"""streamed_select: chunked root select chains, survivors in row order.
+
+Reuses `CompiledSelect` (physical/compiled_select.py) wholesale: ONE
+object is built against the full table (so string dictionaries and
+parameter slots are table-global), and each partition launch runs its
+mask + per-pow2-bucket gather kernels over a fixed-shape chunk — jit
+specializes once per chunk shape, so N launches share the executables and
+the second streamed run of the family pays zero foreground compiles (the
+same per-bucket re-specialization budget the SPMD select rung accepts).
+
+Survivor tables land host-side per chunk and concatenate in ascending
+chunk order; within a chunk the sized-nonzero gather already yields
+ascending row indices, so the concatenation IS the global row order the
+unconstrained single-launch path produces.  Sort/limit windows are global
+row properties a chunk cannot see — plans carrying them are never routed
+here (streaming/plan.py declines them at decision time).
+"""
+from __future__ import annotations
+
+import logging
+from collections import OrderedDict
+from typing import List, Optional, Tuple
+
+from ..columnar.table import Table
+from ..observability import trace_event
+from ..physical.compiled import _Unsupported, singleflight_get_or_build
+from ..physical.compiled_select import CompiledSelect, _extract
+from .partition import slice_chunk
+from .plan import StreamDecision
+from .runner import drive_partitions
+
+logger = logging.getLogger(__name__)
+
+
+class _StreamableSelect(CompiledSelect):
+    """CompiledSelect with PER-SHAPE mask-kernel warm tracking.
+
+    The parent's ``_mask_warm`` is a single boolean — correct for its own
+    rung, where one object only ever sees one table shape.  Streamed
+    execution feeds the same object different chunk shapes after a
+    mid-stream repartition; the recompile for the new shape must run with
+    ``may_compile=True`` so the compile watchdog
+    (``resilience.compile_timeout_ms``) covers exactly the OOM-recovery
+    path (the aggregate rung's ``_warm_shapes`` set, mirrored here).  The
+    hint is computed LOCALLY per call, never by mutating a shared flag —
+    cached objects serve concurrent worker threads, and a write/read dance
+    on shared state would let one thread's warm shape mark another
+    thread's cold compile unwatched."""
+
+    _RUNG = "streamed_select"  # compiles attribute to THIS rung's metrics
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._warm_shapes: set = set()
+
+    def run(self, table=None, params=()):
+        from ..observability import timed_jit_call
+        from ..utils import count_d2h
+
+        t = table if table is not None else self.table
+        shape = t.padded_rows
+        datas = tuple(t.columns[n].data for n in t.column_names)
+        valids = tuple(t.columns[n].validity for n in t.column_names)
+        mask, count_dev = timed_jit_call(
+            self._RUNG, self._mask_fn, datas, valids, t.row_valid,
+            tuple(params), may_compile=shape not in self._warm_shapes)
+        self._warm_shapes.add(shape)
+        count_d2h()
+        return self._finish(datas, valids, mask, int(count_dev),
+                            tuple(params))
+
+
+_CACHE_CAP = 8
+_cache: "OrderedDict[Tuple, CompiledSelect]" = OrderedDict()
+
+
+def reset_cache() -> None:
+    """Tests: drop cached streamed select executables."""
+    _cache.clear()
+
+
+def try_streamed_select(root, executor) -> Optional[Table]:
+    """The streamed_select ladder rung (physical/executor.py execute_root):
+    fires only for plans the admission layer routed to streaming (this
+    execution's ``executor.stream_decisions`` entry); None declines down
+    the ladder."""
+    decision: Optional[StreamDecision] = \
+        executor.stream_decisions.get(id(root))
+    if decision is None or decision.kind != "select":
+        return None
+    config = executor.config
+    if not config.get("serving.stream.enabled", True):
+        return None
+    if not config.get("sql.compile", True) \
+            or not config.get("sql.compile.select", True):
+        return None
+    got = _extract(root)
+    if got is None:
+        return None
+    scan, upper_filters, proj, sort_keys, sort_fetch, limit, inner_limit = got
+    if sort_keys is not None or limit is not None or inner_limit is not None:
+        return None  # global row windows: not a chunk-local shape
+    ctx = executor.context
+    # -- eligibility + executable build: construction-time ineligibility
+    # re-sheds with the gate's 429 (see streaming/aggregate.py) ----------
+    try:
+        dc = ctx.schema[scan.schema_name].tables.get(scan.table_name)
+        if dc is None:
+            return None
+        table = executor.get_table(scan.schema_name, scan.table_name)
+        if scan.projection is not None:
+            table = table.select(scan.projection)
+        if not table.column_names or table.row_valid is not None:
+            return None
+        from .. import families
+
+        pz = families.pipeline_parameterizer(config)
+        p_upper = [pz.rewrite(f) for f in upper_filters]
+        p_scan_flts = [pz.rewrite(f) for f in scan.filters]
+        p_exprs = [pz.rewrite(e) for e in proj.exprs]
+        params = pz.params
+        key = (
+            "streamed_select",
+            dc.uid,
+            tuple(scan.projection or ()),
+            tuple(str(f) for f in p_upper),
+            tuple(str(f) for f in p_scan_flts),
+            tuple(str(e) for e in p_exprs),
+            table.num_rows,
+        )
+
+        def build():
+            obj = _StreamableSelect(table, scan, p_upper, p_scan_flts, proj,
+                                    p_exprs, None, None, None, None, params)
+            obj.table = None
+            with ctx._plan_lock:
+                _cache[key] = obj
+                while len(_cache) > _CACHE_CAP:
+                    _cache.popitem(last=False)
+            return obj
+
+        compiled, built_here = singleflight_get_or_build(ctx, _cache, key,
+                                                         build)
+    except (_Unsupported, ValueError, TypeError, NotImplementedError) as e:
+        from .plan import shed_ineligible
+
+        shed_ineligible(decision, ctx.metrics, reason=str(e))
+        raise  # unreachable: shed_ineligible always raises
+    if compiled is None:
+        return None
+    if not built_here and params:
+        ctx.metrics.inc("families.hit")
+        trace_event("family_hit", rung="streamed_select",
+                    params=len(params))
+    ctx.metrics.inc("serving.stream.queries")
+    # -- pipelined partition drive (ladder semantics preserved) -----------
+    parts: List[Table] = []
+
+    def launch(lo: int, chunk_rows: int) -> None:
+        chunk = slice_chunk(table, lo, chunk_rows)
+        out = compiled.run(chunk, params)
+        if out.num_rows:
+            parts.append(out)
+
+    launches = drive_partitions(executor, decision, launch,
+                                "streamed_select")
+    trace_event("rung:streamed_select", rung="streamed_select",
+                partitions=launches, chunk_rows=decision.chunk_rows)
+    if not parts:
+        return _empty_like(compiled)
+    return Table.concat(parts)
+
+
+def _empty_like(compiled: CompiledSelect) -> Table:
+    """Zero-survivor result with the pipeline's output schema."""
+    cols, valids = compiled._decode_packed(None, 0)
+    return compiled._assemble(cols, valids, 0)
