@@ -1018,9 +1018,139 @@ def run_stream_smoke():
         raise SystemExit(1)
 
 
+def run_live_smoke():
+    """`bench.py --live`: live observability plane smoke, exit 1 on
+    violation (ISSUE 14 acceptance).
+
+    Starts a Presto server over a context whose admission budget forces a
+    multi-partition streamed execution, submits the query over the wire,
+    and while it is IN FLIGHT:
+
+    1. polls ``GET /v1/queries`` asserting the entry is visible with
+       ADVANCING partition progress and a NONZERO reserved-byte floor;
+    2. cancels it with the ``CANCEL QUERY '<qid>'`` SQL statement
+       (exercising the native parser path) and asserts the query
+       terminates cooperatively between launches;
+    3. asserts the flight recorder (``/v1/debug/events``) holds the
+       cancel event and the HBM ledger returns to idle (zero reserved
+       bytes) after the cancellation.
+    """
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    _ensure_backend()
+    import jax
+
+    from dask_sql_tpu import Context
+    from dask_sql_tpu.observability import flight
+    from dask_sql_tpu.server.app import run_server
+
+    n = 600_000
+    df = gen_lineitem(n, seed=0)
+    c = Context()
+    c.config.update({"serving.cache.enabled": False})
+    c.create_table("lineitem", df)
+    q = ("SELECT l_returnflag, SUM(l_quantity) AS sum_qty, "
+         "COUNT(*) AS count_order FROM lineitem GROUP BY l_returnflag")
+    # size the budget below the provable floor so the gate routes the
+    # query to a streamed rung; pin small chunks so the stream is long
+    # enough to observe mid-flight over HTTP
+    c.sql(q, return_futures=False)
+    cost = c.cost_hint(q)
+    floor = int(cost.bytes_lo) if cost is not None else 0
+    budget = max(1 << 16, floor // 3)
+    c.config.update({
+        "serving.admission.max_estimated_bytes": budget,
+        "serving.stream.chunk_rows": 4096,
+        "serving.stream.max_partitions": 512,
+    })
+    # re-plan under the final config so the submit-time cost hint (keyed
+    # on effective config) carries the streamed per-chunk floor
+    c.sql(q, return_futures=False)
+    srv = run_server(context=c, host="127.0.0.1", port=0, blocking=False)
+    base = f"http://127.0.0.1:{srv.port}"
+
+    def _get(path):
+        return _json.load(urllib.request.urlopen(base + path))
+
+    def _post(path, body=b""):
+        req = urllib.request.Request(base + path, data=body,
+                                     headers={"X-Dsql-Class": "batch",
+                                              "X-Dsql-Tenant": "bench"})
+        return _json.load(urllib.request.urlopen(req))
+
+    flight.RECORDER.clear()
+    qid = _post("/v1/statement", q.encode())["id"]
+    # poll the live table until the entry streams, sampling progress
+    samples, reserved_seen = [], 0
+    deadline = time.perf_counter() + 30.0
+    while time.perf_counter() < deadline:
+        snap = _get("/v1/queries")
+        entry = next((e for e in snap["queries"] if e["qid"] == qid), None)
+        if entry is not None and entry["state"] in ("failed", "cancelled",
+                                                    "done"):
+            break
+        if entry is not None and entry.get("stream"):
+            samples.append(entry["stream"]["partitionsDone"])
+            reserved_seen = max(reserved_seen,
+                                int(entry.get("reservedBytes") or 0),
+                                int(snap["ledger"]["reservedBytes"] or 0))
+            if len(samples) >= 2 and samples[-1] > samples[0] \
+                    and samples[-1] >= 2:
+                break
+        time.sleep(0.002)
+    advancing = len(samples) >= 2 and samples[-1] > samples[0]
+    # cancel through the SQL statement (native parser path) mid-flight
+    cancel_df = None
+    try:
+        cancel_df = _post("/v1/statement",
+                          f"CANCEL QUERY '{qid}'".encode())
+    except urllib.error.HTTPError:
+        pass
+    # wait for the cooperative cancellation to land between launches
+    final = None
+    deadline = time.perf_counter() + 30.0
+    while time.perf_counter() < deadline:
+        entry = _get(f"/v1/queries/{qid}")
+        if entry["state"] in ("failed", "cancelled", "done"):
+            final = entry
+            break
+        time.sleep(0.01)
+    cancelled = final is not None and final["state"] == "cancelled"
+    events = _get("/v1/debug/events?name=query.cancel")["events"]
+    cancel_recorded = any(e.get("qid") == qid for e in events)
+    ledger = _get("/v1/queries")["ledger"]
+    ledger_idle = int(ledger["reservedBytes"]) == 0 \
+        and int(ledger["inflightMeasuredBytes"]) == 0
+    srv.shutdown()
+    ok = (advancing and reserved_seen > 0 and cancelled
+          and cancel_recorded and ledger_idle)
+    print(_json.dumps({
+        "metric": "live_observability_smoke",
+        "backend": jax.default_backend(),
+        "ok": bool(ok),
+        "budget_bytes": budget,
+        "working_set_floor_bytes": floor,
+        "progress_samples": samples[:16],
+        "partitions_advancing": bool(advancing),
+        "reserved_bytes_seen": reserved_seen,
+        "cancel_submitted": cancel_df is not None,
+        "cancelled_cooperatively": bool(cancelled),
+        "final_state": None if final is None else final["state"],
+        "flight_cancel_recorded": bool(cancel_recorded),
+        "ledger_idle_after": bool(ledger_idle),
+    }), flush=True)
+    if not ok:
+        raise SystemExit(1)
+
+
 def main():
     import sys
 
+    if "--live" in sys.argv:
+        run_live_smoke()
+        return
     if "--lint" in sys.argv:
         run_lint_smoke()
         return

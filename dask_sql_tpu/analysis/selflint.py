@@ -37,13 +37,22 @@ DSQL301  host-sync
 
 DSQL401  metric-registry coverage
     Every string-literal metric name passed to ``metrics.inc`` /
-    ``metrics.observe`` (and the cache's ``self._mark`` forwarder) must
-    appear in the documented registry
+    ``metrics.observe`` / ``metrics.gauge`` (and the cache's ``self._mark``
+    forwarder) must appear in the documented registry
     (``serving/metrics.py DOCUMENTED_METRICS`` /
     ``DOCUMENTED_METRIC_PREFIXES`` for f-string families) — a typo'd name
     silently splits a time series and dashboards go dark.  Dynamic names
     (plain variables) make no claim; suppress deliberate one-offs with
     ``# dsql: allow-metric-name``.
+
+DSQL501  flight-recorder event vocabulary
+    Every string-literal event name passed to ``flight.record(...)``
+    (observability/flight.py) must be in the registered event vocabulary
+    (``EVENT_NAMES`` / ``EVENT_NAME_PREFIXES``) — the flight recorder is
+    the engine's postmortem timeline, and a typo'd event name silently
+    splits it exactly like an unregistered metric splits a time series.
+    Same literal/prefix machinery as DSQL401; suppress deliberate
+    one-offs with ``# dsql: allow-flight-event``.
 
 Suppression comments live on the offending line or the line above it, so
 ``git blame`` keeps the reason next to the decision.
@@ -60,6 +69,7 @@ RULES: Dict[str, str] = {
     "DSQL201": "lock-guarded attribute mutated outside its lock",
     "DSQL301": "host-sync call inside jit-traced code",
     "DSQL401": "metric name not in the documented metric registry",
+    "DSQL501": "flight-recorder event not in the registered vocabulary",
 }
 
 _SUPPRESS = {
@@ -67,6 +77,7 @@ _SUPPRESS = {
     "DSQL201": "dsql: allow-unlocked",
     "DSQL301": "dsql: allow-host-sync",
     "DSQL401": "dsql: allow-metric-name",
+    "DSQL501": "dsql: allow-flight-event",
 }
 
 #: modules whose closure factories build jit-traced kernels: a nested def
@@ -382,7 +393,7 @@ def _check_host_sync(tree: ast.AST, path: str,
 #: (``metrics.inc(...)``, ``self.metrics.observe(...)``,
 #: ``executor.context.metrics.inc(...)``, the cache's ``self._mark(...)``)
 _METRIC_RECEIVERS = {"metrics", "_metrics"}
-_METRIC_METHODS = {"inc", "observe"}
+_METRIC_METHODS = {"inc", "observe", "gauge"}
 _METRIC_WRAPPERS = {"_mark"}  # helpers that forward a name to metrics.inc
 
 
@@ -438,6 +449,45 @@ def _check_metric_names(tree: ast.AST, path: str,
 
 
 # ---------------------------------------------------------------------------
+# DSQL501 — flight-recorder event vocabulary coverage
+# ---------------------------------------------------------------------------
+#: receiver names that mean "the flight recorder" at a call site:
+#: ``flight.record(...)`` with the module imported as ``flight``, the
+#: process recorder ``RECORDER.record(...)``, and flight.py's own bare
+#: module-level ``record(...)`` calls (matched as a plain Name)
+_FLIGHT_RECEIVERS = {"flight", "RECORDER"}
+
+
+def _check_flight_events(tree: ast.AST, path: str,
+                         lines: Sequence[str]) -> List[LintFinding]:
+    from ..observability.flight import is_registered_event
+
+    out: List[LintFinding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr == "record":
+            recv = _name_of(f.value)
+            if recv is None or recv.split(".")[-1] not in _FLIGHT_RECEIVERS:
+                continue
+        elif not (isinstance(f, ast.Name) and f.id == "record"):
+            continue
+        name, is_prefix = _metric_name_of(node.args[0])
+        if name is None or is_registered_event(name, prefix_only=is_prefix):
+            continue
+        if _suppressed(lines, node.lineno, "DSQL501"):
+            continue
+        out.append(LintFinding(
+            "DSQL501", path, node.lineno,
+            f"flight event {name!r} is not in the registered vocabulary "
+            f"(observability/flight.py EVENT_NAMES); a typo here silently "
+            f"splits the postmortem timeline — register the name or "
+            f"annotate `# {_SUPPRESS['DSQL501']}`"))
+    return out
+
+
+# ---------------------------------------------------------------------------
 # driver
 # ---------------------------------------------------------------------------
 def lint_source(source: str, path: str) -> List[LintFinding]:
@@ -452,6 +502,7 @@ def lint_source(source: str, path: str) -> List[LintFinding]:
     out += _check_lock_coverage(tree, path, lines)
     out += _check_host_sync(tree, path, lines)
     out += _check_metric_names(tree, path, lines)
+    out += _check_flight_events(tree, path, lines)
     return sorted(out, key=lambda f: (f.path, f.line, f.rule))
 
 

@@ -215,6 +215,9 @@ DEFAULTS: Dict[str, Any] = {
     "observability.slow_query_path": None,  # JSONL sink for slow queries (None = python logger)
     "observability.profiles.window": 64,  # rolling samples kept per fingerprint (exec/compile/bytes)
     "observability.profiles.keep": 512,  # max fingerprints in the profile store (LRU)
+    "observability.live.keep": 64,  # finished queries retained in the SHOW QUERIES / /v1/queries table
+    "observability.flight.capacity": 4096,  # flight-recorder ring size (events; always on)
+    "observability.flight.dump_path": None,  # JSONL sink auto-flushed with the full ring on any query failure (None = in-memory ring only)
     # Resilient execution (resilience/) — error taxonomy, degradation ladder,
     # retry/backoff, circuit breaker, fault injection.  docs/resilience.md.
     "resilience.ladder.enabled": True,  # degradable failures step down a rung instead of failing

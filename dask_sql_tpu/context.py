@@ -141,6 +141,63 @@ class TpuFrame:
                 stack.enter_context(observability.compile_sink(
                     ctx.metrics, ctx.profiles, fp, sql_text,
                     family=family_fp))
+                # in-flight query table (observability/live.py): the server
+                # registered an entry at submit (found via the serving
+                # ticket); a direct Context-API execution registers its own
+                # here — WITH a cancellable ticket installed for the
+                # executor's checkpoints, so CANCEL QUERY reaches it too
+                from .serving.runtime import current_ticket, ticket_scope
+
+                live_ticket = current_ticket()
+                entry = None
+                if live_ticket is not None:
+                    entry = ctx.live_queries.get(live_ticket.qid)
+                owned_entry = entry is None
+                if owned_entry:
+                    live_qid = tr.qid if tr is not None else None
+                    if live_ticket is None:
+                        from .serving.admission import QueryTicket
+                        import uuid as _uuid
+
+                        live_qid = live_qid or _uuid.uuid4().hex[:16]
+                        live_ticket = QueryTicket(live_qid)
+                        stack.enter_context(ticket_scope(live_ticket))
+                    entry = ctx.live_queries.begin(
+                        live_qid or live_ticket.qid, sql=sql_text,
+                        ticket=live_ticket, trace=tr,
+                        priority_class=live_ticket.priority_class)
+                ctx.live_queries.start(entry.qid)
+                entry.family = family_fp
+                entry.fingerprint = fp
+                stack.enter_context(observability.live.activate(entry))
+
+                def _finish_live(exc_type, exc, tb):
+                    if exc is None:
+                        if owned_entry:
+                            ctx.live_queries.finish(entry.qid, "done")
+                        return False
+                    if not owned_entry:
+                        # the server registry owns the terminal outcome
+                        # (this attempt may be retried by the worker)
+                        return False
+                    from .serving.admission import QueryCancelledError
+
+                    code = getattr(exc, "code", None) or exc_type.__name__
+                    state = "cancelled" if isinstance(
+                        exc, QueryCancelledError) else "failed"
+                    ctx.live_queries.finish(entry.qid, state, code)
+                    if state == "failed":
+                        # cancels are user-initiated, not failures: they
+                        # already recorded query.cancel at the request
+                        # site and must not dump a failure postmortem
+                        observability.flight.flush_on_failure(
+                            entry.qid, code, ctx.config, ctx.metrics)
+                    return False
+
+                # pushed AFTER the trace hook so it runs first on unwind
+                # (the live table should be terminal before the slow-query
+                # check reads the trace)
+                stack.push(_finish_live)
                 with observability.stage("cache_lookup"):
                     key = ctx._result_cache_key(self._plan,
                                                 self._config_options)
@@ -221,6 +278,9 @@ class TpuFrame:
                         + ctx._measured_scan_bytes(
                             self._plan,
                             routed[1] if routed is not None else None)
+                    # the ledger's measured-vs-reserved reconciliation
+                    # reads the same number off the live entry
+                    entry.measured_bytes = ticket.measured_bytes
                 est = getattr(self._plan, "_dsql_estimate", None)
                 if est is not None:
                     # the "estimated" side of SHOW PROFILES' observed-vs-
@@ -335,6 +395,19 @@ class Context:
         #: finished lifecycle traces, qid -> QueryTrace (/v1/trace/{qid})
         self.traces = observability.TraceStore(
             int(self.config.get("observability.trace.keep", 256)))
+        #: the in-flight query table (observability/live.py) behind
+        #: SHOW QUERIES / GET /v1/queries and the target of CANCEL QUERY
+        self.live_queries = observability.QueryRegistry(
+            keep_finished=int(self.config.get("observability.live.keep",
+                                              64)))
+        #: live HBM accounting (observability/ledger.py): scheduler
+        #: reservations + measured in-flight footprints + result-cache +
+        #: at-rest table bytes reconciled against the device budget
+        self.ledger = observability.DeviceLedger(self)
+        # the process flight recorder is always on; the capacity key only
+        # resizes its ring
+        observability.flight.RECORDER.resize(
+            int(self.config.get("observability.flight.capacity", 4096)))
         #: the most recently started lifecycle trace (bench --profile and
         #: notebook introspection; per-query lookups go through `traces`)
         self.last_trace: Optional[observability.QueryTrace] = None
@@ -1381,6 +1454,19 @@ class Context:
         if model_name not in models:
             raise KeyError(f"A model with the name {model_name} is not present.")
         return models[model_name]
+
+    def cancel_query(self, qid: str) -> bool:
+        """Cooperatively cancel an in-flight query by qid — the engine
+        behind ``CANCEL QUERY '<qid>'`` and ``POST /v1/queries/{qid}/
+        cancel``.  Resolves the live-registry entry's `QueryTicket` and
+        flags it; the executor's per-node checkpoints (and the streaming
+        loop's between-launch checkpoints) raise at the next poll, and a
+        still-queued serving ticket is skipped by the worker that pops it.
+        Returns False for an unknown or already-terminal qid."""
+        ok = self.live_queries.cancel(qid)
+        self.metrics.inc("serving.cancel_requested")
+        observability.flight.record("query.cancel", qid=qid, ok=ok)
+        return ok
 
     # ------------------------------------------------------------ front-ends
     def run_server(self, **kwargs):  # pragma: no cover - thin wrapper

@@ -35,15 +35,25 @@ _FOLLOWER_WAIT_S = 600.0
 
 
 class _Group:
-    __slots__ = ("members", "outputs", "error", "done", "full", "closed")
+    __slots__ = ("members", "outputs", "error", "done", "full", "closed",
+                 "gid", "leader_qid", "qids")
 
     def __init__(self):
+        import uuid
+
         self.members: List[Any] = []  # one params tuple per member
         self.outputs: Optional[List[Any]] = None
         self.error: Optional[BaseException] = None
         self.done = threading.Event()
         self.full = threading.Event()
         self.closed = False
+        #: flow-link namespace of this rendezvous: member i's causality
+        #: arrow into the leader's stacked launch is id "<gid>:<i>"
+        self.gid = uuid.uuid4().hex[:12]
+        self.leader_qid: Optional[str] = None
+        #: member trace qids (index-aligned with `members`, None where a
+        #: member ran untraced) — the leader links them after the launch
+        self.qids: List[Optional[str]] = []
 
 
 class FamilyBatcher:
@@ -78,6 +88,9 @@ class FamilyBatcher:
             batched: Callable[[List[Any]], List[Any]]) -> Any:
         if self.max_queries <= 1:
             return solo()
+        from ..observability import current_trace
+
+        tr = current_trace()
         with self._lock:
             group = self._groups.get(key)
             if group is None or group.closed \
@@ -89,16 +102,32 @@ class FamilyBatcher:
                 leader = False
             index = len(group.members)
             group.members.append(params)
+            group.qids.append(tr.qid if tr is not None else None)
             if not leader and len(group.members) >= self.max_queries:
                 group.full.set()
         if leader:
             return self._lead(key, group, solo, batched)
+        if tr is not None:
+            # causality flow OUT of this member, terminating at the
+            # leader's stacked launch (the leader emits the matching
+            # flow_in after it runs) — Perfetto draws the arrow when the
+            # linked traces are merged into one export
+            tr.event("batch_join", flow_out=f"{group.gid}:{index}")
         group.done.wait(_FOLLOWER_WAIT_S)
         if group.error is not None:
             raise group.error
         if group.outputs is None:  # leader never finished (stalled/killed)
             logger.warning("family batch leader stalled; running solo")
             return solo()
+        if len(group.members) > 1:
+            from ..observability import flight, live
+
+            live.update(batch_role="member", batch_size=len(group.members))
+            flight.record("batch.member",
+                          qid=tr.qid if tr is not None else None,
+                          leader=group.leader_qid, size=len(group.members))
+            if tr is not None:
+                tr.link(group.leader_qid)
         self._mark_member(len(group.members))
         return group.outputs[index]
 
@@ -113,6 +142,10 @@ class FamilyBatcher:
     def _lead(self, key: Any, group: _Group,
               solo: Callable[[], Any],
               batched: Callable[[List[Any]], List[Any]]) -> Any:
+        from ..observability import current_trace
+
+        tr = current_trace()
+        group.leader_qid = tr.qid if tr is not None else None
         try:
             if self.window_s:
                 grace = min(self.window_s, self._GRACE_S)
@@ -138,6 +171,24 @@ class FamilyBatcher:
                     self.metrics.inc("serving.batch.launches")
                     self.metrics.inc("serving.batch.queries", len(members))
                     self.metrics.observe("serving.batch.size", len(members))
+                from ..observability import flight, live
+
+                live.update(batch_role="leader", batch_size=len(members))
+                flight.record("batch.lead",
+                              qid=group.leader_qid, size=len(members))
+                if tr is not None:
+                    # terminate each member's causality arrow at THIS
+                    # stacked launch, and link the member traces so the
+                    # merged /v1/trace export carries both endpoints
+                    with self._lock:
+                        qids = list(group.qids)
+                    for i, member_qid in enumerate(qids):
+                        if i == 0:
+                            continue  # the leader itself
+                        tr.event("batch_launch",
+                                 flow_in=f"{group.gid}:{i}",
+                                 member=member_qid)
+                        tr.link(member_qid)
         except BaseException as exc:
             group.error = exc
             raise

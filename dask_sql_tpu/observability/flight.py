@@ -1,0 +1,187 @@
+"""Flight recorder: an always-on bounded ring of structured engine events.
+
+The slow-query log (slowlog.py) answers "why was THIS query slow" — but it
+must be armed before the incident, and a failed query's context is often
+another query's behavior (the batch scan that held the budget, the breaker
+that tripped two minutes ago, the repartition storm that preceded the OOM).
+The flight recorder is the postmortem tool that needs no pre-arming: every
+engine decision that changes what runs — admits, sheds, packs, quota
+throttles, ladder degradations, breaker trips/restores, stream
+repartitions, compiles, cancellations — appends one structured event to a
+process-global bounded ring buffer.
+
+- ``GET /v1/debug/events`` dumps the ring (filterable by name/qid);
+- on any query failure the ring is auto-flushed as one JSONL record to
+  ``observability.flight.dump_path`` when configured (the in-memory ring
+  stays dumpable either way — failures never require pre-arming);
+- event *names* are a registered vocabulary (`EVENT_NAMES` /
+  `EVENT_NAME_PREFIXES`): self-lint rule DSQL501 checks every literal name
+  at a ``flight.record(...)`` call site against it, exactly like DSQL401
+  does for metric names — a typo'd event name silently splits a postmortem
+  timeline.
+
+The recorder is process-global (`RECORDER`) because the layers that emit
+events — scheduler, breaker, ladder, streaming loop — do not all hold a
+Context; events carry the qid where one is known.  Recording is O(1)
+(deque append under a lock) and always on: the ring costs bounded memory
+and nothing else.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+#: Registered event-name vocabulary.  Self-lint rule DSQL501 checks every
+#: string-literal name at a ``flight.record(...)`` call site against this
+#: set (plus the prefixes below for f-string families) — add the name here
+#: when introducing an event; docs/observability.md describes each.
+EVENT_NAMES = frozenset({
+    # query lifecycle (serving runtime / server / TpuFrame)
+    "query.admit",
+    "query.shed",
+    "query.finish",
+    "query.fail",
+    "query.cancel",
+    # packing scheduler (serving/scheduler.py)
+    "sched.pack",
+    "sched.quota_throttle",
+    # degradation ladder + breaker (resilience/)
+    "ladder.degrade",
+    "breaker.trip",
+    "breaker.restore",
+    # streamed partitioned execution (streaming/runner.py)
+    "stream.repartition",
+    "stream.exhausted",
+    # XLA compiles (observability/spans.py timed_jit_call)
+    "compile.start",
+    "compile.end",
+    # family batching (families/batcher.py)
+    "batch.lead",
+    "batch.member",
+    # background work (serving/background.py, serving/warmup.py)
+    "bg.recompile",
+    "warmup.replay",
+})
+
+#: prefixes legitimizing dynamic event families (none today; the slot
+#: exists so DSQL501 shares the DSQL401 literal/prefix machinery)
+EVENT_NAME_PREFIXES: tuple = ()
+
+
+def is_registered_event(name: str, prefix_only: bool = False) -> bool:
+    """True when ``name`` is covered by the registered vocabulary —
+    DSQL501's oracle, mirroring `serving.metrics.is_documented_metric`."""
+    if name in EVENT_NAMES:
+        return True
+    if any(name.startswith(p) for p in EVENT_NAME_PREFIXES):
+        return True
+    return prefix_only and any(p.startswith(name)
+                               for p in EVENT_NAME_PREFIXES)
+
+
+class FlightRecorder:
+    """Bounded ring of ``{ts, event, qid?, **attrs}`` dicts."""
+
+    def __init__(self, capacity: int = 4096):
+        self._lock = threading.Lock()
+        self._ring: "deque[Dict[str, Any]]" = deque(
+            maxlen=max(16, int(capacity)))
+        self.recorded = 0
+
+    def record(self, event: str, qid: Optional[str] = None,
+               ts: Optional[float] = None, **attrs) -> None:
+        rec: Dict[str, Any] = {
+            "ts": time.time() if ts is None else float(ts),
+            "event": event,
+        }
+        if qid is not None:
+            rec["qid"] = qid
+        for k, v in attrs.items():
+            if v is not None:
+                rec[k] = v
+        with self._lock:
+            self._ring.append(rec)
+            self.recorded += 1
+
+    def events(self, limit: Optional[int] = None,
+               name: Optional[str] = None,
+               qid: Optional[str] = None) -> List[Dict[str, Any]]:
+        """Oldest-first dump, optionally filtered; ``limit`` keeps the
+        newest N after filtering."""
+        with self._lock:
+            out = list(self._ring)
+        if name is not None:
+            out = [e for e in out if e["event"] == name]
+        if qid is not None:
+            out = [e for e in out if e.get("qid") == qid]
+        if limit is not None and limit >= 0:
+            out = out[-int(limit):]
+        return out
+
+    def resize(self, capacity: int) -> None:
+        with self._lock:
+            if self._ring.maxlen != max(16, int(capacity)):
+                self._ring = deque(self._ring,
+                                   maxlen=max(16, int(capacity)))
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+
+#: THE process flight recorder — always on
+RECORDER = FlightRecorder()
+
+
+def record(event: str, qid: Optional[str] = None,
+           ts: Optional[float] = None, **attrs) -> None:
+    """Append one event to the process recorder (module-level convenience:
+    ``from ..observability import flight; flight.record("query.admit",
+    qid=qid)``).  ``event`` must be in the registered vocabulary — enforced
+    statically by DSQL501, not at runtime (a hot path never pays a set
+    lookup for an event nobody typo'd)."""
+    RECORDER.record(event, qid=qid, ts=ts, **attrs)
+
+
+#: serializes failure dumps so concurrent failing queries cannot
+#: interleave JSONL lines mid-record
+_dump_lock = threading.Lock()
+
+
+def flush_on_failure(qid: Optional[str], error_code: Optional[str],
+                     config, metrics=None) -> bool:
+    """Auto-flush hook run on any query failure: records the failure event
+    and, when ``observability.flight.dump_path`` is configured, appends one
+    JSONL record carrying the failure plus the entire current ring — the
+    postmortem context of every engine decision leading up to it."""
+    record("query.fail", qid=qid, code=error_code)
+    path = None if config is None else config.get(
+        "observability.flight.dump_path")
+    if not path:
+        return False
+    rec = {
+        "ts": time.time(),
+        "qid": qid,
+        "error": error_code,
+        "events": RECORDER.events(),
+    }
+    try:
+        with _dump_lock, open(path, "a", encoding="utf-8") as f:
+            f.write(json.dumps(rec) + "\n")
+    except OSError:
+        logger.warning("flight-recorder dump to %r failed", path,
+                       exc_info=True)
+        return False
+    if metrics is not None:
+        metrics.inc("observability.flight.dumps")
+    return True

@@ -83,6 +83,11 @@ class QueryTrace:
         self.profiles = profiles
         self.fingerprint: Optional[str] = None
         self.spans: List[Span] = []
+        #: qids of causally linked queries (a batch member links its
+        #: leader, the leader links its members): /v1/trace/{qid} merges
+        #: linked traces into one multi-process Chrome export so the flow
+        #: arrows have both endpoints loaded
+        self.links: List[str] = []
         self._lock = threading.Lock()
         self.created_perf = time.perf_counter()
         #: epoch - perf offset: export wall-clock timestamps from perf spans
@@ -132,6 +137,14 @@ class QueryTrace:
         t = time.perf_counter()
         return self.add_span(name, t, t, EVENT, **attrs)
 
+    def link(self, qid: Optional[str]) -> None:
+        """Record a causal link to another query's trace (idempotent)."""
+        if not qid or qid == self.qid:
+            return
+        with self._lock:
+            if qid not in self.links:
+                self.links.append(qid)
+
     def finish(self, config=None, metrics=None) -> None:
         """Idempotent end-of-lifecycle hook: first call wins and runs the
         slow-query check (observability/slowlog.py)."""
@@ -179,18 +192,22 @@ class QueryTrace:
             stack.extend(node.children)
 
     # ------------------------------------------------------------- export
-    def to_chrome_trace(self) -> Dict[str, Any]:
-        """The Chrome `trace event profiling` JSON object (ph=X complete
-        events, microsecond timestamps) chrome://tracing and Perfetto load
-        directly.  Stages and their nested details share tid 1 (nesting by
-        containment); events become ph=i instants."""
+    def chrome_events(self, pid: int = 1) -> List[Dict[str, Any]]:
+        """This trace's Chrome-trace event list under process id ``pid``.
+        Spans/events carrying ``flow_out`` / ``flow_in`` attrs (cross-query
+        causality: batch member -> leader launch, background recompile ->
+        trigger) additionally emit flow events (ph=s / ph=f) sharing a
+        stable numeric id, so Perfetto draws the arrow — across processes
+        when linked traces are merged into one export."""
+        import zlib
+
         with self._lock:
             spans = list(self.spans)
         events: List[Dict[str, Any]] = [{
-            "name": "process_name", "ph": "M", "pid": 1,
+            "name": "process_name", "ph": "M", "pid": pid,
             "args": {"name": f"dask-sql-tpu query {self.qid}"},
         }, {
-            "name": "thread_name", "ph": "M", "pid": 1, "tid": 1,
+            "name": "thread_name", "ph": "M", "pid": pid, "tid": 1,
             "args": {"name": "query lifecycle"},
         }]
         for s in spans:
@@ -200,19 +217,40 @@ class QueryTrace:
                 args["stage"] = s.parent
             if s.kind == EVENT:
                 events.append({"name": s.name, "ph": "i", "ts": ts,
-                               "pid": 1, "tid": 1, "s": "t", "args": args})
-                continue
-            dur = 0.0 if s.t1 is None else (s.t1 - s.t0) * 1e6
-            events.append({"name": s.name, "ph": "X", "ts": ts, "dur": dur,
-                           "cat": s.kind, "pid": 1, "tid": 1, "args": args})
+                               "pid": pid, "tid": 1, "s": "t", "args": args})
+            else:
+                dur = 0.0 if s.t1 is None else (s.t1 - s.t0) * 1e6
+                events.append({"name": s.name, "ph": "X", "ts": ts,
+                               "dur": dur, "cat": s.kind, "pid": pid,
+                               "tid": 1, "args": args})
+            for key, ph in (("flow_out", "s"), ("flow_in", "f")):
+                flow = s.attrs.get(key)
+                if flow is None:
+                    continue
+                ev = {"name": s.name, "cat": "dsql.flow", "ph": ph,
+                      "id": zlib.crc32(str(flow).encode()), "ts": ts,
+                      "pid": pid, "tid": 1}
+                if ph == "f":
+                    ev["bp"] = "e"  # bind to the enclosing slice
+                events.append(ev)
+        return events
+
+    def to_chrome_trace(self) -> Dict[str, Any]:
+        """The Chrome `trace event profiling` JSON object (ph=X complete
+        events, microsecond timestamps) chrome://tracing and Perfetto load
+        directly.  Stages and their nested details share tid 1 (nesting by
+        containment); events become ph=i instants."""
+        with self._lock:
+            links = list(self.links)
         return {
             "displayTimeUnit": "ms",
-            "traceEvents": events,
+            "traceEvents": self.chrome_events(),
             "otherData": {
                 "traceId": self.trace_id,
                 "qid": self.qid,
                 "sql": self.sql,
                 "fingerprint": self.fingerprint,
+                "links": links,
             },
         }
 
@@ -234,6 +272,27 @@ class QueryTrace:
             pad = "    " if s.kind == DETAIL else "  "
             lines.append(f"{pad}{s.name:<14} {dur}")
         return lines
+
+
+def merge_chrome_traces(traces: List["QueryTrace"]) -> Dict[str, Any]:
+    """One Chrome-trace JSON over several causally linked traces — each
+    query its own process row, flow arrows crossing between them (the
+    ``/v1/trace/{qid}`` export when the trace carries links)."""
+    events: List[Dict[str, Any]] = []
+    for i, tr in enumerate(traces):
+        events.extend(tr.chrome_events(pid=i + 1))
+    head = traces[0]
+    return {
+        "displayTimeUnit": "ms",
+        "traceEvents": events,
+        "otherData": {
+            "traceId": head.trace_id,
+            "qid": head.qid,
+            "sql": head.sql,
+            "fingerprint": head.fingerprint,
+            "merged": [tr.qid for tr in traces],
+        },
+    }
 
 
 class TraceStore:
@@ -285,11 +344,26 @@ def activate(trace: Optional[QueryTrace]):
 
 def stage(name: str, **attrs):
     """Scoped stage span on the active trace — a no-op context manager
-    when no trace is active, so instrumented code never branches."""
+    when no trace is active, so instrumented code never branches.  Also
+    stamps the stage onto the in-flight query table (live.py), which works
+    with tracing disabled too."""
+    from . import live
+
+    live.update(stage=name)
     tr = current_trace()
     if tr is None:
         return contextlib.nullcontext({})
     return tr.span(name, kind=STAGE, **attrs)
+
+
+def detail(name: str, parent: str = "execute", **attrs):
+    """Scoped DETAIL span nested under ``parent`` on the active trace —
+    a no-op context manager without one.  The streaming drive loop uses
+    this so each partition renders as a child of the execute stage."""
+    tr = current_trace()
+    if tr is None:
+        return contextlib.nullcontext({})
+    return tr.span(name, kind=DETAIL, parent=parent, **attrs)
 
 
 def trace_event(name: str, **attrs) -> None:
@@ -406,4 +480,20 @@ def timed_jit_call(rung: str, fn, *args, may_compile: Optional[bool] = None,
     if profiles is not None and fingerprint:
         profiles.record_compile(fingerprint, rung, ms, sql=sql,
                                 family=family)
+    from . import flight
+
+    qid = tr.qid if tr is not None else None
+    if qid is None:
+        from ..serving.runtime import current_ticket
+
+        ticket = current_ticket()
+        qid = ticket.qid if ticket is not None else None
+    # start/end pair stamped retrospectively — a compile is only known to
+    # have happened once the jit cache grew, but the recorder accepts
+    # explicit timestamps so the timeline still shows the true window
+    wall_end = time.time()
+    flight.record("compile.start", qid=qid, ts=wall_end - ms / 1e3,
+                  rung=rung)
+    flight.record("compile.end", qid=qid, ts=wall_end, rung=rung,
+                  ms=round(ms, 3), persistent_hit=persistent_hit)
     return out

@@ -18,6 +18,7 @@ from __future__ import annotations
 import logging
 import re
 import threading
+import uuid
 from typing import Callable, Dict, List, Optional, Tuple
 
 import jax
@@ -1371,8 +1372,19 @@ def defer_rebuild(ctx, rung: str, cache, cache_cap: int, key, family,
     # thread-local per-query config overlays are invisible on the bg
     # thread; capture the effective view so the rebuild matches its key
     effective = dict(ctx.config.effective_items())
+    # causality: the background recompile points back at the query whose
+    # plugin-cache miss triggered it — a flow link from the trigger's
+    # deferral event into the recompile span the bg thread appends, plus
+    # a flight-recorder event carrying the trigger's qid
+    from ..observability import current_trace
+
+    trigger_trace = current_trace()
+    flow_id = f"bg:{rung}:{uuid.uuid4().hex[:12]}"
 
     def task():
+        import time as _time
+
+        t0 = _time.perf_counter()
         try:
             from .. import observability
 
@@ -1384,6 +1396,18 @@ def defer_rebuild(ctx, rung: str, cache, cache_cap: int, key, family,
                 while len(cache) > cache_cap:
                     cache.popitem(last=False)
                 _remember_family_locked(ctx, family, bucket)
+            observability.flight.record(
+                "bg.recompile", rung=rung,
+                qid=trigger_trace.qid if trigger_trace is not None
+                else None)
+            if trigger_trace is not None:
+                # append the recompile to the TRIGGERING query's trace (it
+                # may already be finished — spans still append), with the
+                # flow arrow from its deferral event
+                trigger_trace.add_span(
+                    f"bg_recompile:{rung}", t0, _time.perf_counter(),
+                    kind="detail", parent="execute", rung=rung,
+                    flow_in=flow_id)
         except BaseException:
             # un-mark the family: the next query takes the foreground path
             # where the ladder/breaker apply their normal failure policy
@@ -1399,7 +1423,7 @@ def defer_rebuild(ctx, rung: str, cache, cache_cap: int, key, family,
     ctx.metrics.inc("serving.bg_compile.deferred")
     from ..observability import trace_event
 
-    trace_event(f"bg_compile_deferred:{rung}")
+    trace_event(f"bg_compile_deferred:{rung}", flow_out=flow_id)
     logger.debug("%s family bucket changed; compiling in background and "
                  "serving a lower rung", rung)
     return True

@@ -241,6 +241,9 @@ class ShowMetricsPlugin(BaseRelPlugin):
 
     def convert(self, rel: p.ShowMetricsNode, executor) -> Table:
         ctx = executor.context
+        if getattr(ctx, "ledger", None) is not None:
+            # refresh the HBM-ledger gauges so this snapshot carries them
+            ctx.ledger.publish(ctx.metrics)
         rows = list(ctx.metrics.rows())
         rows.extend(_flatten_metrics("result_cache",
                                      ctx._result_cache.snapshot()))
@@ -280,6 +283,47 @@ class ShowProfilesPlugin(BaseRelPlugin):
                               "Family": [r[1] for r in rows],
                               "Metric": [r[2] for r in rows],
                               "Value": [r[3] for r in rows]})
+
+
+@Executor.add_plugin_class
+class ShowQueriesPlugin(BaseRelPlugin):
+    """SHOW QUERIES [LIKE 'pat'] — the in-flight query table
+    (observability/live.py) as a result set: one (Qid, Field, Value) row
+    per populated live fact (stage, rung, class, tenant, family, batch
+    role, streaming progress, reserved/measured bytes, deadline
+    remaining), live queries first, a bounded tail of recently finished
+    ones after, and the HBM-ledger summary under the ``(ledger)``
+    pseudo-qid.  LIKE filters on the qid or the field name."""
+
+    class_name = "ShowQueriesNode"
+
+    def convert(self, rel: p.ShowQueriesNode, executor) -> Table:
+        ctx = executor.context
+        rows = list(ctx.live_queries.rows())
+        rows.extend(ctx.ledger.rows())
+        if rel.like:
+            rows = [r for r in rows
+                    if _like_match(rel.like, r[0])
+                    or _like_match(rel.like, r[1])]
+        return _string_table({"Qid": [r[0] for r in rows],
+                              "Field": [r[1] for r in rows],
+                              "Value": [r[2] for r in rows]})
+
+
+@Executor.add_plugin_class
+class CancelQueryPlugin(BaseRelPlugin):
+    """CANCEL QUERY '<qid>' — cooperative cancellation through the live
+    registry's `QueryTicket`: the executor raises at its next checkpoint
+    (per plan node; between streamed partition launches), a queued query
+    is skipped by the worker that pops it.  Returns one row reporting
+    whether a live, cancellable query was found."""
+
+    class_name = "CancelQueryNode"
+
+    def convert(self, rel: p.CancelQueryNode, executor) -> Table:
+        ok = executor.context.cancel_query(rel.qid)
+        return _string_table({"Qid": [rel.qid],
+                              "Cancelled": [str(bool(ok)).lower()]})
 
 
 @Executor.add_plugin_class

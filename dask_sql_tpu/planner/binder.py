@@ -179,6 +179,17 @@ class Binder:
                  Field("Metric", SqlType.VARCHAR),
                  Field("Value", SqlType.VARCHAR)],
                 stmt.like)
+        if isinstance(stmt, a.ShowQueries):
+            return p.ShowQueriesNode(
+                [Field("Qid", SqlType.VARCHAR),
+                 Field("Field", SqlType.VARCHAR),
+                 Field("Value", SqlType.VARCHAR)],
+                stmt.like)
+        if isinstance(stmt, a.CancelQuery):
+            return p.CancelQueryNode(
+                [Field("Qid", SqlType.VARCHAR),
+                 Field("Cancelled", SqlType.VARCHAR)],
+                stmt.qid)
         if isinstance(stmt, a.AnalyzeTable):
             return p.AnalyzeTableNode([], stmt.table, stmt.columns)
         if isinstance(stmt, a.CreateModel):

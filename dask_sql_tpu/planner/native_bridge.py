@@ -129,6 +129,7 @@ _K_SHOW_MODELS = 91; _K_ANALYZE_TABLE = 92; _K_CREATE_MODEL = 93
 _K_DROP_MODEL = 94; _K_DESCRIBE_MODEL = 95; _K_EXPORT_MODEL = 96
 _K_CREATE_EXPERIMENT = 97; _K_KWARGS = 98; _K_KV = 99; _K_KWLIST = 100
 _K_SHOW_METRICS = 101; _K_SHOW_PROFILES = 102
+_K_SHOW_QUERIES = 103; _K_CANCEL_QUERY = 104
 
 _FRAME_KINDS = ["UNBOUNDED_PRECEDING", "PRECEDING", "CURRENT_ROW",
                 "FOLLOWING", "UNBOUNDED_FOLLOWING"]
@@ -150,10 +151,10 @@ def _get_parser_lib():
             ]
             lib.dsql_buf_free.argtypes = [ctypes.POINTER(ctypes.c_uint8)]
             lib.dsql_parser_abi_version.restype = ctypes.c_int32
-            # grammar version 4 = SHOW PROFILES + EXPLAIN ANALYZE FORMAT
-            # JSON; a stale .so predating it is rejected here so the
-            # Python parser handles the syntax
-            _parser_ok = lib.dsql_parser_abi_version() == 4
+            # grammar version 5 = SHOW QUERIES + CANCEL QUERY; a stale
+            # .so predating it is rejected here so the Python parser
+            # handles the syntax
+            _parser_ok = lib.dsql_parser_abi_version() == 5
         except AttributeError:
             _parser_ok = False
     return lib if _parser_ok else None
@@ -563,6 +564,10 @@ def _decode_statement(f: "_FlatAst", sid: int):
         return a.ShowMetrics(f.s(s0))
     if kind == _K_SHOW_PROFILES:
         return a.ShowProfiles(f.s(s0))
+    if kind == _K_SHOW_QUERIES:
+        return a.ShowQueries(f.s(s0))
+    if kind == _K_CANCEL_QUERY:
+        return a.CancelQuery(f.s(s0) or "")
     if kind == _K_ANALYZE_TABLE:
         cols = [f.s(f.nodes[p][4]) for p in kids[1:]]
         return a.AnalyzeTable(_decode_qname(f, kids[0]), cols)
@@ -603,6 +608,7 @@ _P_SHOW_TABLES = 29; _P_SHOW_COLUMNS = 30; _P_SHOW_MODELS = 31
 _P_ANALYZE_TABLE = 32; _P_CREATE_MODEL = 33; _P_DROP_MODEL = 34
 _P_DESCRIBE_MODEL = 35; _P_EXPORT_MODEL = 36; _P_CREATE_EXPERIMENT = 37
 _P_PREDICT_MODEL = 38; _P_SHOW_METRICS = 39; _P_SHOW_PROFILES = 40
+_P_SHOW_QUERIES = 41; _P_CANCEL_QUERY = 42
 _P_FIELD = 50; _P_SORTKEY = 51; _P_ON_PAIR = 52; _P_VALUES_ROW = 53
 _P_PART = 54; _P_KWARGS = 55; _P_KV = 56; _P_KWLIST = 57; _P_WINSPEC = 58
 _P_FRAME_BOUND = 59
@@ -641,9 +647,8 @@ def _get_binder_lib():
                 ctypes.POINTER(ctypes.c_int64),
             ]
             lib.dsql_binder_abi_version.restype = ctypes.c_int32
-            # version 5 = P_SHOW_PROFILES + the FORMAT JSON flag bit
-            # riding through P_EXPLAIN
-            _binder_ok = lib.dsql_binder_abi_version() == 5
+            # version 6 = P_SHOW_QUERIES + P_CANCEL_QUERY
+            _binder_ok = lib.dsql_binder_abi_version() == 6
         except AttributeError:
             _binder_ok = False
     return lib if _binder_ok else None
@@ -1006,6 +1011,11 @@ class _PlanDecoder:
         if kind == _P_SHOW_PROFILES:
             like = F.s(s0) if flags & 1 else None
             return p.ShowProfilesNode(self.fields(kids), like)
+        if kind == _P_SHOW_QUERIES:
+            like = F.s(s0) if flags & 1 else None
+            return p.ShowQueriesNode(self.fields(kids), like)
+        if kind == _P_CANCEL_QUERY:
+            return p.CancelQueryNode(self.fields(kids), F.s(s0) or "")
         if kind == _P_ANALYZE_TABLE:
             table = [F.s(F.nodes[i][4]) for i in kids if F.nodes[i][1] == 0]
             columns = [F.s(F.nodes[i][4]) for i in kids if F.nodes[i][1] == 1]
@@ -1117,7 +1127,7 @@ def _get_planner_lib():
                 ctypes.POINTER(ctypes.c_int64),
             ]
             lib.dsql_optimizer_abi_version.restype = ctypes.c_int32
-            _planner_ok = lib.dsql_optimizer_abi_version() == 5
+            _planner_ok = lib.dsql_optimizer_abi_version() == 6
         except AttributeError:
             _planner_ok = False
     return lib if _planner_ok else None

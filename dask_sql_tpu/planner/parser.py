@@ -183,6 +183,12 @@ class Parser:
             return a.UseSchema(self.parse_identifier())
         if self.at_keyword("ALTER"):
             return self.parse_alter()
+        if self.at_keyword("CANCEL"):
+            self.next()
+            self.expect_keyword("QUERY")
+            # the qid is a string literal ('uuid'); a bare identifier is
+            # accepted too so copy-pasting an unquoted qid still works
+            return a.CancelQuery(self.next().value)
         if self.at_keyword("EXPORT"):
             self.next()
             self.expect_keyword("MODEL")
@@ -293,9 +299,14 @@ class Parser:
             if self.accept_keyword("LIKE"):
                 like = self.next().value
             return a.ShowProfiles(like)
+        if self.accept_keyword("QUERIES"):
+            like = None
+            if self.accept_keyword("LIKE"):
+                like = self.next().value
+            return a.ShowQueries(like)
         raise self.error(
-            "Expected SCHEMAS, TABLES, COLUMNS, MODELS, METRICS or PROFILES "
-            "after SHOW")
+            "Expected SCHEMAS, TABLES, COLUMNS, MODELS, METRICS, PROFILES "
+            "or QUERIES after SHOW")
 
     def parse_alter(self) -> a.Statement:
         self.expect_keyword("ALTER")

@@ -454,6 +454,22 @@ class ShowProfilesNode(CustomNode):
 
 
 @dataclass(eq=False)
+class ShowQueriesNode(CustomNode):
+    """SHOW QUERIES — the in-flight query table + HBM-ledger summary
+    (observability/live.py, observability/ledger.py)."""
+
+    like: Optional[str] = None
+
+
+@dataclass(eq=False)
+class CancelQueryNode(CustomNode):
+    """CANCEL QUERY '<qid>' — cooperative in-flight cancellation
+    (observability/live.py -> QueryTicket)."""
+
+    qid: str = ""
+
+
+@dataclass(eq=False)
 class AnalyzeTableNode(CustomNode):
     table: List[str] = None
     columns: List[str] = None

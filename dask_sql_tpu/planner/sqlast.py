@@ -418,6 +418,24 @@ class ShowProfiles(Statement):
 
 
 @dataclass
+class ShowQueries(Statement):
+    """SHOW QUERIES: the in-flight query table (observability/live.py —
+    live stage/rung/batch-role/stream-progress per admitted query) plus
+    the HBM-ledger summary block."""
+
+    like: Optional[str] = None
+
+
+@dataclass
+class CancelQuery(Statement):
+    """CANCEL QUERY '<qid>': cooperative cancellation of an in-flight
+    query through its `QueryTicket` (executor checkpoints raise at the
+    next poll)."""
+
+    qid: str = ""
+
+
+@dataclass
 class AnalyzeTable(Statement):
     table: List[str]
     columns: List[str] = field(default_factory=list)

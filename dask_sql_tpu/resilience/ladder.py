@@ -214,10 +214,19 @@ def attempt(executor, rung: str, fn: Callable[[], Optional[T]],
         metrics.inc("resilience.degraded")
         metrics.inc(f"resilience.degraded.{rung}")
         trace_event(f"degraded:{rung}", code=err.code)
+        from ..observability import flight
+        from ..serving.runtime import current_ticket
+
+        ticket = current_ticket()
+        flight.record("ladder.degrade",
+                      qid=ticket.qid if ticket is not None else None,
+                      rung=rung, code=err.code)
         if executor.tracer.enabled:
             executor.tracer.event(f"degraded: {rung} [{err.code}]")
         if key is not None and breaker.record_failure(key):
             metrics.inc("resilience.breaker.trip")
+            flight.record("breaker.trip", rung=rung, fingerprint=key[0],
+                          code=err.code)
             logger.warning(
                 "breaker tripped for rung %s (plan %s): %s",
                 rung, key[0], err)
@@ -225,12 +234,20 @@ def attempt(executor, rung: str, fn: Callable[[], Optional[T]],
         return None
     if out is not None:
         metrics.inc(f"resilience.rung.{rung}")
+        from ..observability import live
+
+        live.update(rung=rung)
         if rung.startswith("spmd_"):
             # the acceptance-visible marker that a query executed on a
             # sharded rung: a zero-duration span with spmd attrs
             trace_event(f"rung:{rung}", rung=rung, spmd=True)
-        if key is not None:
-            breaker.record_success(key)
+        if key is not None and breaker.record_success(key):
+            # an OPEN circuit just closed on its half-open trial: the
+            # rung is healthy again for this family
+            from ..observability import flight
+
+            flight.record("breaker.restore", rung=rung,
+                          fingerprint=key[0])
         if rel is not None:
             # per-(family, rung) exec evidence for the cost-based selector
             # and SHOW PROFILES (wall time includes any compile this rung
@@ -281,6 +298,13 @@ def execute_interpreted(executor, rel):
         metrics.inc("resilience.degraded")
         metrics.inc("resilience.degraded.interpreted")
         trace_event("degraded:interpreted", code=err.code)
+        from ..observability import flight
+        from ..serving.runtime import current_ticket
+
+        _ticket = current_ticket()
+        flight.record("ladder.degrade",
+                      qid=_ticket.qid if _ticket is not None else None,
+                      rung="interpreted", code=err.code)
         if executor.tracer.enabled:
             executor.tracer.event(f"degraded: interpreted [{err.code}]")
         logger.warning("interpreted path failed degradably (%s); "
@@ -293,6 +317,9 @@ def execute_interpreted(executor, rel):
                 "sql.compile": False}), jax.default_device(cpu):
             out = executor.execute(rel)
         metrics.inc("resilience.rung.cpu")
+        from ..observability import live
+
+        live.update(rung="cpu")
         return out
 
 

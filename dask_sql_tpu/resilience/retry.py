@@ -166,9 +166,13 @@ class CircuitBreaker:
             self._evict_locked()
             return tripped
 
-    def record_success(self, key: Tuple) -> None:
+    def record_success(self, key: Tuple) -> bool:
+        """Clear the key's failure state; returns True when this success
+        closed an OPEN circuit (the half-open trial passed), so callers
+        can record the restore exactly once."""
         with self._lock:
-            self._state.pop(key, None)
+            st = self._state.pop(key, None)
+            return bool(st is not None and st[1] is not None)
 
     def is_open(self, key: Tuple) -> bool:
         with self._lock:

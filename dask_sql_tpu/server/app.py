@@ -165,28 +165,56 @@ class _QueryRegistry:
                     self.n_running += 1
                     if trace is not None:
                         # stage recorded once, guarded by the same
-                        # started-transition that makes retries idempotent
+                        # started-transition that makes retries idempotent.
+                        # `cause` attributes the wait (byte_blocked /
+                        # quota_throttled from the packing scheduler,
+                        # workers_busy otherwise) so a long queue_wait span
+                        # in the slow-query log explains itself
                         trace.add_span("queue_wait", trace.created_perf,
-                                       time.perf_counter())
+                                       time.perf_counter(),
+                                       cause=ticket.queue_reason)
             if trace is None:
                 return fn(lambda: self._mark_planned(qid))
             with observability.activate(trace):
                 return fn(lambda: self._mark_planned(qid))
 
-        with self.lock:
-            # entry registered (and future attached) under one lock hold so
-            # a status poll can never observe a half-built entry
-            try:
-                _, fut, ticket = self.runtime.submit(
-                    run, qid=qid, priority_class=priority_class,
-                    deadline_s=deadline_s, cost=cost)
-            except QueueFullError:
-                self.rejected += 1
-                raise
-            self.entries[qid] = _QueryEntry(future=fut,
-                                            submitted=time.monotonic(),
-                                            ticket=ticket, trace=trace)
-            self.n_queued += 1
+        live_entry = None
+        if self.context is not None:
+            # the in-flight query table (SHOW QUERIES / GET /v1/queries):
+            # registered BEFORE runtime.submit makes the ticket poppable —
+            # a fast worker could otherwise reach TpuFrame.execute, find
+            # no entry, and take ownership of a duplicate; TpuFrame finds
+            # this entry through the serving ticket and updates it in place
+            from ..serving.admission import CLASSES
+
+            live_entry = self.context.live_queries.begin(
+                qid, sql=sql, trace=trace, tenant=tenant,
+                priority_class=priority_class
+                if priority_class in CLASSES else "interactive")
+        try:
+            with self.lock:
+                # entry registered (and future attached) under one lock
+                # hold so a status poll can never observe a half-built
+                # entry
+                try:
+                    _, fut, ticket = self.runtime.submit(
+                        run, qid=qid, priority_class=priority_class,
+                        deadline_s=deadline_s, cost=cost)
+                except QueueFullError:
+                    self.rejected += 1
+                    raise
+                if live_entry is not None:
+                    live_entry.ticket = ticket
+                self.entries[qid] = _QueryEntry(future=fut,
+                                                submitted=time.monotonic(),
+                                                ticket=ticket, trace=trace)
+                self.n_queued += 1
+        except QueueFullError:
+            if live_entry is not None:
+                # never admitted: a shed must not occupy the live table
+                # (the registry has its own lock; no self.lock needed)
+                self.context.live_queries.discard(qid)
+            raise
         if trace is not None:
             self.context.traces.put(qid, trace)
             self.context.last_trace = trace
@@ -202,6 +230,7 @@ class _QueryRegistry:
     def _finish(self, qid: str, fut):
         """Done-callback: single finalization point for every outcome
         (result, error, deadline, cancel-while-queued, cancel-mid-run)."""
+        live_state, live_code = "done", None
         with self.lock:
             e = self.entries.get(qid)
             if e is None or e.finished is not None:
@@ -213,14 +242,20 @@ class _QueryRegistry:
                 self.n_running -= 1
             if fut.cancelled():
                 self.cancelled += 1
+                live_state = "cancelled"
             else:
                 exc = fut.exception()
                 if isinstance(exc, QueryCancelledError):
                     e.error = True
                     self.cancelled += 1
+                    live_state = "cancelled"
+                    live_code = getattr(exc, "code", None)
                 elif exc is not None:
                     e.error = True
                     self.failed += 1
+                    live_state = "failed"
+                    live_code = getattr(exc, "code", None) \
+                        or type(exc).__name__
                 else:
                     self.completed += 1
             # the latency average divides by its own sample count: only
@@ -235,6 +270,14 @@ class _QueryRegistry:
             self._terminal.append(qid)
             while len(self._terminal) > self.KEEP_TERMINAL:
                 self.entries.pop(self._terminal.popleft(), None)
+        if self.context is not None:
+            # the live table's terminal outcome — recorded AFTER any
+            # worker retries, so one retried attempt never shows failed
+            self.context.live_queries.finish(qid, live_state, live_code)
+            if live_state == "failed":
+                observability.flight.flush_on_failure(
+                    qid, live_code, self.context.config,
+                    self.context.metrics)
         if e.trace is not None and self.context is not None:
             # terminal for EVERY outcome (result, error, deadline, cancel):
             # close the lifecycle so failed/cancelled outliers reach the
@@ -282,6 +325,11 @@ class _QueryRegistry:
                 "avgQueuedMillis": int(self.total_queued_s / n * 1000) if n else 0,
             }
         out["serving"] = self.runtime.snapshot()
+        if self.context is not None:
+            # refresh the HBM-ledger gauges on every scrape, BEFORE the
+            # registry snapshot so they ride this response
+            out["ledger"] = self.context.ledger.publish(
+                self.metrics_registry)
         out["registry"] = self.metrics_registry.snapshot()
         if self.context is not None:
             out["resultCache"] = self.context._result_cache.snapshot()
@@ -315,7 +363,21 @@ def _make_handler(context, registry: _QueryRegistry, jdbc_meta: bool):
 
         # ------------------------------------------------------------ POST
         def do_POST(self):
-            if self.path.rstrip("/") != "/v1/statement":
+            path, _, _query = self.path.partition("?")
+            parts = path.strip("/").split("/")
+            if len(parts) == 4 and parts[0] == "v1" \
+                    and parts[1] == "queries" and parts[3] == "cancel":
+                # cooperative cancel by qid: flags the query's ticket so
+                # the executor's next checkpoint (per plan node / between
+                # streamed launches) raises; a queued query is skipped by
+                # the worker that pops it.  Also tries the HTTP registry's
+                # Future (covers queued-not-started statements).
+                qid = parts[2]
+                ok = registry.cancel(qid)
+                ok = context.cancel_query(qid) or ok
+                self._send({"cancelled": bool(ok)}, 200 if ok else 404)
+                return
+            if path.rstrip("/") != "/v1/statement":
                 self._send({"error": "unknown endpoint"}, 404)
                 return
             length = int(self.headers.get("Content-Length", 0))
@@ -387,13 +449,53 @@ def _make_handler(context, registry: _QueryRegistry, jdbc_meta: bool):
                 return
             if len(parts) == 3 and parts[0] == "v1" and parts[1] == "trace":
                 # the query's lifecycle trace as Chrome-trace JSON — load
-                # the download straight into chrome://tracing / Perfetto
+                # the download straight into chrome://tracing / Perfetto.
+                # A trace with causal links (batch member <-> leader) is
+                # merged with its linked traces into one multi-process
+                # export so the flow arrows have both endpoints loaded.
                 trace = context.traces.get(parts[2])
                 if trace is None:
                     self._send({"error": f"no trace for query {parts[2]}"},
                                404)
                     return
-                self._send(trace.to_chrome_trace())
+                linked = [t for t in
+                          (context.traces.get(q) for q in trace.links)
+                          if t is not None]
+                if linked:
+                    self._send(observability.merge_chrome_traces(
+                        [trace] + linked))
+                else:
+                    self._send(trace.to_chrome_trace())
+                return
+            if len(parts) == 3 and parts[0] == "v1" \
+                    and parts[1] == "queries":
+                entry = context.live_queries.get(parts[2])
+                if entry is None:
+                    self._send({"error": f"unknown query {parts[2]}"}, 404)
+                    return
+                self._send(entry.as_dict())
+                return
+            if path.rstrip("/") == "/v1/queries":
+                # the in-flight query table + the HBM ledger, live
+                self._send({
+                    "queries": context.live_queries.snapshot(),
+                    "ledger": context.ledger.snapshot(),
+                })
+                return
+            if path.rstrip("/") == "/v1/debug/events":
+                # the flight recorder's ring, oldest first; ?limit= keeps
+                # the newest N, ?name=/&qid= filter
+                params = parse_qs(query)
+                limit = None
+                if params.get("limit"):
+                    try:
+                        limit = int(params["limit"][0])
+                    except ValueError:
+                        limit = None
+                self._send({"events": observability.flight.RECORDER.events(
+                    limit=limit,
+                    name=(params.get("name") or [None])[0],
+                    qid=(params.get("qid") or [None])[0])})
                 return
             if path.rstrip("/") == "/v1/empty":
                 self._send(self._empty_results())

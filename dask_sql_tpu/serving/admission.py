@@ -127,6 +127,13 @@ def check_estimated_bytes(estimate, config, metrics=None, plan=None,
     if metrics is not None:
         metrics.inc("serving.shed_estimated_bytes")
     trace_event("shed:estimated_bytes", bytes_lo=lo, budget=budget)
+    from ..observability import flight
+    from .runtime import current_ticket
+
+    ticket = current_ticket()
+    flight.record("query.shed",
+                  qid=ticket.qid if ticket is not None else None,
+                  reason="estimated_bytes", bytes_lo=lo, budget=budget)
     raise EstimatedBytesExceededError(lo, budget)
 
 
@@ -146,7 +153,8 @@ class QueryTicket:
     """
 
     __slots__ = ("qid", "priority_class", "deadline", "admitted_at",
-                 "started_at", "_cancelled", "cost", "measured_bytes")
+                 "started_at", "_cancelled", "cost", "measured_bytes",
+                 "queue_reason")
 
     def __init__(self, qid: str, priority_class: str = "interactive",
                  deadline: Optional[float] = None):
@@ -157,6 +165,11 @@ class QueryTicket:
         self.admitted_at = time.monotonic()
         self.started_at: Optional[float] = None
         self._cancelled = False
+        #: why this query waited in the queue, stamped at dispatch by the
+        #: packing scheduler (``byte_blocked`` / ``quota_throttled``) or
+        #: defaulted to ``workers_busy`` — the queue_wait span's cause
+        #: attribution the slow-query log surfaces
+        self.queue_reason: Optional[str] = None
         #: the packing scheduler's `QueryCost` (serving/scheduler.py) when
         #: the submit carried one — rides the ticket so the executing
         #: thread (family batcher, metrics) can see its own cost view

@@ -66,8 +66,20 @@ DOCUMENTED_METRICS = frozenset({
     "parallel.dist.sort_kernel",
     "parallel.dist.join_kernel",
     "parallel.dist.broadcast_join",
-    # observability/ — lifecycle tracing + slow-query log
+    # observability/ — lifecycle tracing + slow-query log + flight recorder
     "observability.slow_query",
+    "observability.flight.dumps",
+    # observability/ — HBM ledger gauges (ledger.py, published on every
+    # /v1/metrics scrape and SHOW METRICS)
+    "serving.ledger.budget_bytes",
+    "serving.ledger.reserved_bytes",
+    "serving.ledger.inflight_measured_bytes",
+    "serving.ledger.cache_bytes",
+    "serving.ledger.table_bytes",
+    "serving.ledger.headroom_bytes",
+    "serving.ledger.reserve_drift_bytes",
+    # observability/ — live query table (live.py, CANCEL QUERY)
+    "serving.cancel_requested",
     # planner
     "planner.optimize.fallback",
     # query lifecycle (Context / TpuFrame)
@@ -134,6 +146,9 @@ DOCUMENTED_METRICS = frozenset({
     "serving.stream.repartitions",
     "serving.stream.rows",
     "serving.stream.chunk_rows",
+    # liveness gauges: advancing = healthy long stream, stalled = hang
+    "serving.stream.partitions_done",
+    "serving.stream.rows_done",
     # serving/ — zero-cold-start: pre-warm + background recompile
     "serving.warmup.started",
     "serving.warmup.warmed",

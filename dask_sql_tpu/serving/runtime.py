@@ -19,6 +19,7 @@ reference's overlapping distributed futures, reference server/app.py:89).
 """
 from __future__ import annotations
 
+import contextlib
 import logging
 import threading
 import time
@@ -48,6 +49,21 @@ def current_ticket() -> Optional[QueryTicket]:
     """The ticket of the query running on this thread, if any — the
     executor's cancellation checkpoints poll this."""
     return getattr(_tls, "ticket", None)
+
+
+@contextlib.contextmanager
+def ticket_scope(ticket: QueryTicket):
+    """Install ``ticket`` as this thread's current ticket for the dynamic
+    extent.  The serving workers install tickets directly; this scope is
+    for executions OUTSIDE the worker pool (the Context API path), so
+    ``CANCEL QUERY`` on their live-registry entry reaches the executor's
+    cooperative checkpoints too."""
+    prev = getattr(_tls, "ticket", None)
+    _tls.ticket = ticket
+    try:
+        yield ticket
+    finally:
+        _tls.ticket = prev
 
 
 class ServingRuntime:
@@ -199,11 +215,14 @@ class ServingRuntime:
             raise ShutdownError("serving runtime is shut down")
         from .admission import QueueFullError
 
+        from ..observability import flight
+
         if priority_class == "batch" and self.batch_max_running == 0:
             # batch is paused: shed immediately instead of admitting work
             # that no worker would ever pop (client would hang in QUEUED)
             self.metrics.inc("serving.rejected")
             self.metrics.inc("serving.rejected.batch")
+            flight.record("query.shed", qid=qid, reason="batch_paused")
             raise QueueFullError("batch", 0, self.admission.retry_after_s)
         qid = qid or str(uuid.uuid4())
         if deadline_s is None:
@@ -211,6 +230,8 @@ class ServingRuntime:
         try:
             ticket = self.admission.admit(qid, priority_class, deadline_s)
         except QueueFullError as e:
+            flight.record("query.shed", qid=qid, reason="queue_full",
+                          cls=priority_class)
             drain = self._predicted_drain_s()
             if drain is not None and drain > e.retry_after_s:
                 # the scheduler predicts the drain from running queries'
@@ -220,6 +241,9 @@ class ServingRuntime:
                                      min(60.0, drain)) from None
             raise
         ticket.cost = cost
+        flight.record("query.admit", qid=qid, cls=priority_class,
+                      tenant=(cost.tenant or None) if cost is not None
+                      else None)
         fut: Future = Future()
         with self._cv:
             if self._shutdown:
@@ -285,6 +309,10 @@ class ServingRuntime:
                         f"query {ticket.qid} expired while queued"))
                 self._release(ticket)
                 continue
+            if ticket.queue_reason is None:
+                # the scheduler stamps byte_blocked/quota_throttled at
+                # dispatch; anything else waited only for a free worker
+                ticket.queue_reason = "workers_busy"
             self.admission.on_start(ticket)
             _tls.ticket = ticket
             try:

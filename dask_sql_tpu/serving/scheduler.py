@@ -245,10 +245,14 @@ class PackingScheduler:
             return None
         # a token-less tenant made way for the chosen query: that is the
         # quota actually biting (counted once per item per episode)
+        from ..observability import flight
+
         for item in throttled:
             if item is not chosen and not item.throttled:
                 item.throttled = True
                 self._inc("serving.scheduler.quota_throttled")
+                flight.record("sched.quota_throttle", qid=item.ticket.qid,
+                              tenant=item.cost.tenant or None)
         self._dispatch(chosen)
         return chosen.ticket, chosen.fn, chosen.fut
 
@@ -306,9 +310,21 @@ class PackingScheduler:
         dead = item.ticket.cancelled or item.ticket.expired()
         reserve = 0 if dead or self.budget_bytes is None \
             else item.cost.reserve_bytes()
+        # queue-wait attribution for the slow-query log: why did this
+        # query sit in the queue?  byte-blocked and quota-throttled beat
+        # plain workers-busy (the runtime defaults the rest)
+        if item.throttled:
+            item.ticket.queue_reason = "quota_throttled"
+        elif item.waited:
+            item.ticket.queue_reason = "byte_blocked"
         if not dead:
             if self._running:
                 self._inc("serving.scheduler.packed")
+                from ..observability import flight
+
+                flight.record("sched.pack", qid=item.ticket.qid,
+                              reserved=reserve,
+                              inflight=len(self._running))
             if self.tenant_rate is not None:
                 bucket = self._buckets.get(item.cost.tenant)
                 if bucket is not None:

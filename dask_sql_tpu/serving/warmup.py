@@ -154,8 +154,17 @@ class WarmupManager:
                 self._current_ticket = ticket
             _runtime._tls.ticket = ticket
             try:
+                from ..observability import flight
+
+                flight.record("warmup.replay", fingerprint=fp)
                 frame = ctx.sql(sql)
                 if frame is not None:
+                    if frame._trace is not None:
+                        # causality: this trace exists because the warm-up
+                        # replayed the profile of fingerprint `fp` — the
+                        # export shows why an idle process ran a query
+                        frame._trace.event("warmup_replay",
+                                           source_profile=fp)
                     # device-side execute only: warming compiles + caches;
                     # the d2h/pandas tail is per-request work
                     frame.execute()

@@ -32,7 +32,7 @@ from __future__ import annotations
 import logging
 from typing import Callable
 
-from ..observability import stage, trace_event
+from ..observability import detail, flight, live, trace_event
 from ..resilience import faults
 from ..resilience.errors import ResourceExhaustedError, classify
 from ..resilience.retry import BackoffPolicy, retry_call
@@ -70,6 +70,12 @@ def drive_partitions(executor, decision, launch: Callable[[int, int], None],
     rows_done = 0
     part_idx = 0
     launches = 0
+    # live progress: the in-flight query table (SHOW QUERIES /
+    # /v1/queries) shows partitions done/total so a long stream is
+    # distinguishable from a hang while it runs
+    live.update(stream_partitions_total=-(-total // chunk_rows),
+                stream_partitions_done=0, stream_rows_total=total,
+                stream_rows_done=0, stream_chunk_rows=chunk_rows)
     while rows_done < total:
         if ticket is not None:
             # deadline/cancel checkpoint between launches: a deadline that
@@ -78,8 +84,10 @@ def drive_partitions(executor, decision, launch: Callable[[int, int], None],
         lo = rows_done
         hi = min(lo + chunk_rows, total)
         try:
-            with stage("stream_partition", rung=rung, index=part_idx,
-                       row_lo=lo, rows=hi - lo, chunk_rows=chunk_rows):
+            # a DETAIL span nested under the execute stage: the Chrome
+            # trace shows every streamed partition as a child of execute
+            with detail("stream_partition", rung=rung, index=part_idx,
+                        row_lo=lo, rows=hi - lo, chunk_rows=chunk_rows):
 
                 def attempt():
                     faults.maybe_inject("partition", config)
@@ -111,6 +119,9 @@ def drive_partitions(executor, decision, launch: Callable[[int, int], None],
                 metrics.inc("resilience.partition.exhausted")
                 trace_event("stream_exhausted", rung=rung,
                             chunk_rows=chunk_rows)
+                flight.record("stream.exhausted",
+                              qid=ticket.qid if ticket else None,
+                              rung=rung, chunk_rows=chunk_rows)
                 logger.warning(
                     "streamed %s: partition of %d rows still exhausts "
                     "resources at the %d-row floor; stepping down",
@@ -120,6 +131,13 @@ def drive_partitions(executor, decision, launch: Callable[[int, int], None],
             metrics.inc("serving.stream.repartitions")
             trace_event("stream_repartition", rung=rung,
                         chunk_rows=chunk_rows, resume_row=rows_done)
+            flight.record("stream.repartition",
+                          qid=ticket.qid if ticket else None, rung=rung,
+                          chunk_rows=chunk_rows, resume_row=rows_done)
+            live.update(
+                stream_chunk_rows=chunk_rows,
+                stream_partitions_total=part_idx + (
+                    -(-(total - rows_done) // chunk_rows)))
             logger.info(
                 "streamed %s: mid-stream OOM at row %d; repartitioning to "
                 "%d-row chunks and resuming from row %d (completed "
@@ -130,5 +148,11 @@ def drive_partitions(executor, decision, launch: Callable[[int, int], None],
         launches += 1
         metrics.inc("serving.stream.partitions")
         metrics.inc("serving.stream.rows", hi - lo)
+        # liveness gauges: a stalled stream stops advancing these on
+        # /v1/metrics, a healthy long stream keeps moving them
+        metrics.gauge("serving.stream.partitions_done", part_idx)
+        metrics.gauge("serving.stream.rows_done", rows_done)
+        live.update(stream_partitions_done=part_idx,
+                    stream_rows_done=rows_done)
     metrics.observe("serving.stream.chunk_rows", chunk_rows)
     return launches

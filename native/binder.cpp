@@ -437,6 +437,7 @@ enum AstKind : int32_t {
   K_DROP_MODEL = 94, K_DESCRIBE_MODEL = 95, K_EXPORT_MODEL = 96,
   K_CREATE_EXPERIMENT = 97, K_KWARGS = 98, K_KV = 99, K_KWLIST = 100,
   K_SHOW_METRICS = 101, K_SHOW_PROFILES = 102,
+  K_SHOW_QUERIES = 103, K_CANCEL_QUERY = 104,
 };
 
 struct AstNode {
@@ -650,6 +651,7 @@ enum PKind : int32_t {
   P_ANALYZE_TABLE = 32, P_CREATE_MODEL = 33, P_DROP_MODEL = 34,
   P_DESCRIBE_MODEL = 35, P_EXPORT_MODEL = 36, P_CREATE_EXPERIMENT = 37,
   P_PREDICT_MODEL = 38, P_SHOW_METRICS = 39, P_SHOW_PROFILES = 40,
+  P_SHOW_QUERIES = 41, P_CANCEL_QUERY = 42,
   // aux
   P_FIELD = 50, P_SORTKEY = 51, P_ON_PAIR = 52, P_VALUES_ROW = 53,
   P_PART = 54, P_KWARGS = 55, P_KV = 56, P_KWLIST = 57, P_WINSPEC = 58,
@@ -3142,6 +3144,19 @@ class Binder {
                               {"Value", TY_VARCHAR, true}};
         return b.add(P_SHOW_PROFILES, mk_fields(f), a.has_s(n.s0) ? 1 : 0, 0,
                      0.0, a.has_s(n.s0) ? b.intern(a.s(n.s0)) : -1);
+      }
+      case K_SHOW_QUERIES: {
+        std::vector<BField> f{{"Qid", TY_VARCHAR, true},
+                              {"Field", TY_VARCHAR, true},
+                              {"Value", TY_VARCHAR, true}};
+        return b.add(P_SHOW_QUERIES, mk_fields(f), a.has_s(n.s0) ? 1 : 0, 0,
+                     0.0, a.has_s(n.s0) ? b.intern(a.s(n.s0)) : -1);
+      }
+      case K_CANCEL_QUERY: {
+        std::vector<BField> f{{"Qid", TY_VARCHAR, true},
+                              {"Cancelled", TY_VARCHAR, true}};
+        return b.add(P_CANCEL_QUERY, mk_fields(f), 0, 0, 0.0,
+                     b.intern(a.s(n.s0)));
       }
       case K_ANALYZE_TABLE: {
         std::vector<int32_t> cols;
@@ -5675,7 +5690,8 @@ int32_t dsql_bind(const char* sql, int64_t n, const uint8_t* catalog_buf,
 
 // version 5: SHOW PROFILES (P_SHOW_PROFILES) + EXPLAIN ... FORMAT JSON
 // (flag bit 8 riding through P_EXPLAIN)
-int32_t dsql_binder_abi_version() { return 5; }
+// version 6: SHOW QUERIES (P_SHOW_QUERIES) + CANCEL QUERY (P_CANCEL_QUERY)
+int32_t dsql_binder_abi_version() { return 6; }
 
 // Parse + bind + run the structural optimizer rule loop, all native.
 // Same rc codes as dsql_bind; `predicate_pushdown` mirrors the
@@ -5740,6 +5756,6 @@ int32_t dsql_plan(const char* sql, int64_t n, const uint8_t* catalog_buf,
 }
 
 // bumped in lockstep with the binder: dsql_plan shares its EXPLAIN encoding
-int32_t dsql_optimizer_abi_version() { return 5; }
+int32_t dsql_optimizer_abi_version() { return 6; }
 
 }  // extern "C"

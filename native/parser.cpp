@@ -72,6 +72,7 @@ enum Kind : int32_t {
   K_DROP_MODEL = 94, K_DESCRIBE_MODEL = 95, K_EXPORT_MODEL = 96,
   K_CREATE_EXPERIMENT = 97, K_KWARGS = 98, K_KV = 99, K_KWLIST = 100,
   K_SHOW_METRICS = 101, K_SHOW_PROFILES = 102,
+  K_SHOW_QUERIES = 103, K_CANCEL_QUERY = 104,
 };
 
 // statement flag bits
@@ -445,6 +446,13 @@ class Parser {
                     b_.intern(parse_identifier()));
     }
     if (at_keyword("ALTER")) return parse_alter();
+    if (at_keyword("CANCEL")) {
+      next();
+      expect_keyword("QUERY");
+      // the qid is a string literal ('uuid'); a bare identifier is
+      // accepted too so an unquoted copy-pasted qid still works
+      return b_.add(K_CANCEL_QUERY, {}, 0, 0, 0.0, b_.intern(next().value));
+    }
     if (at_keyword("EXPORT")) {
       next();
       expect_keyword("MODEL");
@@ -579,9 +587,14 @@ class Parser {
       if (accept_keyword("LIKE")) like = b_.intern(next().value);
       return b_.add(K_SHOW_PROFILES, {}, 0, 0, 0.0, like);
     }
+    if (accept_keyword("QUERIES")) {
+      int32_t like = -1;
+      if (accept_keyword("LIKE")) like = b_.intern(next().value);
+      return b_.add(K_SHOW_QUERIES, {}, 0, 0, 0.0, like);
+    }
     throw ParseErr{peek().pos,
-                   "Expected SCHEMAS, TABLES, COLUMNS, MODELS, METRICS or "
-                   "PROFILES after SHOW"};
+                   "Expected SCHEMAS, TABLES, COLUMNS, MODELS, METRICS, "
+                   "PROFILES or QUERIES after SHOW"};
   }
 
   int32_t parse_alter() {
@@ -1692,6 +1705,7 @@ void dsql_buf_free(uint8_t* p) { std::free(p); }
 // version 4: SHOW PROFILES (K_SHOW_PROFILES) + EXPLAIN ... FORMAT JSON
 // (flag bit 8 on K_EXPLAIN_STMT) — bumped so a stale prebuilt .so is
 // rejected and the Python parser handles the syntax
-int32_t dsql_parser_abi_version() { return 4; }
+// version 5: SHOW QUERIES (K_SHOW_QUERIES) + CANCEL QUERY (K_CANCEL_QUERY)
+int32_t dsql_parser_abi_version() { return 5; }
 
 }  // extern "C"
