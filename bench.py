@@ -710,6 +710,92 @@ def run_spmd_smoke():
         raise SystemExit(1)
 
 
+def run_predict_smoke():
+    """`bench.py --predict`: compiled in-plan inference smoke.
+
+    Trains a gradient-boosted model on TPC-H-shaped data, then asserts
+    (exit 1 on violation):
+
+    1. *Fused rung*: the PREDICT query answers on ``compiled_predict``
+       (the ``rung:compiled_predict`` span is present — model inference
+       ran in the scan's executable, no mid-plan host round trip);
+    2. *Correctness*: the fused predictions match ``model.predict`` over
+       the pandas-filtered rows within float tolerance;
+    3. *Zero recompile*: a second literal variant AND a retrained
+       same-shape model both serve with ZERO foreground compile spans.
+    """
+    import json as _json
+
+    _ensure_backend()
+    import jax
+
+    from dask_sql_tpu import Context
+
+    df = gen_lineitem(100_000, seed=0)
+    c = Context()
+    c.config.update({"serving.cache.enabled": False})
+    c.create_table("lineitem", df)
+
+    def train(seed):
+        c.sql("""CREATE OR REPLACE MODEL revenue WITH (
+                 model_class = 'sklearn.ensemble.GradientBoostingRegressor',
+                 target_column = 'l_extendedprice',
+                 n_estimators = 10, max_depth = 3, random_state = {})
+                 AS (SELECT l_quantity, l_discount, l_tax, l_extendedprice
+                     FROM lineitem)""".format(seed), return_futures=False)
+
+    def q(disc):
+        return ("SELECT * FROM PREDICT(MODEL revenue, "
+                "SELECT l_quantity, l_discount, l_tax FROM lineitem "
+                f"WHERE l_discount > {disc})")
+
+    def compile_spans(tr):
+        return [s.name for s in tr.spans if s.name.startswith("compile:")]
+
+    train(0)
+    res1 = c.sql(q(0.02), return_futures=False)
+    tr1 = c.last_trace
+    fused = any(s.name == "rung:compiled_predict" for s in tr1.spans)
+    model, cols = c.get_model(c.schema_name, "revenue")
+    sub = df[df.l_discount > 0.02]
+    expected = model.predict(sub[cols].to_numpy())
+    correct = len(res1) == len(sub) and np.allclose(
+        res1["target"].to_numpy(dtype=np.float64), expected, rtol=1e-6)
+    # second literal variant: zero foreground compiles
+    c.sql(q(0.021), return_futures=False)  # warm this survivor bucket
+    res2 = c.sql(q(0.0215), return_futures=False)
+    tr2 = c.last_trace
+    variant_compiles = compile_spans(tr2)
+    # retrain with the same hyper-shape: weights swap, zero compiles
+    train(7)
+    res3 = c.sql(q(0.0215), return_futures=False)
+    tr3 = c.last_trace
+    retrain_compiles = compile_spans(tr3)
+    model2, _ = c.get_model(c.schema_name, "revenue")
+    sub3 = df[df.l_discount > 0.0215]
+    retrain_correct = np.allclose(
+        res3["target"].to_numpy(dtype=np.float64),
+        model2.predict(sub3[cols].to_numpy()), rtol=1e-6)
+    swaps = c.metrics.counter("inference.model.swap")
+
+    ok = (fused and correct and not variant_compiles
+          and not retrain_compiles and retrain_correct and swaps >= 1)
+    print(_json.dumps({
+        "metric": "compiled_predict_smoke",
+        "backend": jax.default_backend(),
+        "fused_rung": bool(fused),
+        "predictions_match": bool(correct),
+        "variant_foreground_compiles": variant_compiles,
+        "retrain_foreground_compiles": retrain_compiles,
+        "retrain_predictions_match": bool(retrain_correct),
+        "model_swaps": swaps,
+        "rows": len(res1),
+        "ok": bool(ok),
+    }, indent=2), flush=True)
+    if not ok:
+        raise SystemExit(1)
+
+
 def run_lint_smoke():
     """`bench.py --lint`: static-analysis smoke.
 
@@ -1180,6 +1266,9 @@ def main():
         return
     if "--schedule" in sys.argv:
         run_schedule_smoke()
+        return
+    if "--predict" in sys.argv:
+        run_predict_smoke()
         return
 
     import jax

@@ -205,6 +205,10 @@ class PlanEstimate:
     #: the non-scan remainder (materialized root, per-device exchange) does
     #: not shrink with partitioning and must stay whole
     scan_bytes_lo: int = 0
+    #: one ``model: ...`` line per PREDICT node (inference/): serving tier,
+    #: device-resident param bytes, program shape — rendered by EXPLAIN
+    #: ESTIMATE so admission decisions over inference plans are explainable
+    model_rows: List[str] = None
 
     def format_rows(self) -> List[str]:
         rows = [
@@ -216,6 +220,7 @@ class PlanEstimate:
                 else self.peak_bytes.hi),
             f"result: bytes={self.result_bytes.fmt()} (d2h transfer)",
         ]
+        rows.extend(self.model_rows or [])
         if self.devices > 1:
             rows.insert(1, f"mesh: devices={self.devices} "
                            "(sharded scans budgeted per device)")
@@ -255,6 +260,7 @@ class _Estimator:
             = []  # (node, packed-matrix lower bound)
         self._memo: Dict[int, Tuple[Interval, Interval]] = {}
         self._scan_lo: Dict[Tuple[str, str], int] = {}
+        self.model_rows: List[str] = []
         #: id(TableScan) -> exact resident bytes when the scanned table is
         #: registered with compressed encodings (columnar/encodings.py)
         self._scan_actual: Dict[int, int] = {}
@@ -293,6 +299,8 @@ class _Estimator:
         scratch_hi: Optional[int] = 0
         if isinstance(node, p.Aggregate):
             scratch_hi = self._aggregate_scratch(node, child)
+        elif isinstance(node, p.PredictModelNode):
+            scratch_hi = self._predict_scratch(node, rows)
         elif isinstance(node, (p.Sort, p.Distinct, p.Window)):
             # sort-based paths keep permutation indices + a key copy: bound
             # by 2x the input's padded bytes
@@ -403,6 +411,11 @@ class _Estimator:
             return Interval(1, None)
         if isinstance(node, (p.SubqueryAlias, p.DistributeBy)):
             return child_rows[0]
+        if isinstance(node, p.PredictModelNode):
+            # PREDICT appends one column per input row — cardinality is the
+            # input's, so inference plans get FINITE bounds and admission /
+            # packing / streaming see them like any other operator
+            return child_rows[0] if child_rows else Interval.unknown()
         if isinstance(node, p.CustomNode):
             return Interval(0, None)
         return child_rows[0] if child_rows else Interval.unknown()
@@ -469,6 +482,59 @@ class _Estimator:
             return None
         return cap_hi + gid_hi + self._exchange_scratch(node, domain,
                                                         all_known)
+
+    def _predict_scratch(self, node: p.PredictModelNode,
+                         rows: Interval) -> Optional[int]:
+        """Transient device bytes of one PREDICT node, and (side effect)
+        the ``model:`` EXPLAIN ESTIMATE row.
+
+        The fused rung (physical/compiled_predict.py) materializes the
+        feature matrix and, for tree programs, (rows, trees)-shaped
+        navigation buffers over the survivor bucket; the host tier
+        materializes the feature matrix host-side but the estimate charges
+        it identically (conservative).  Model params feed the UPPER bound
+        only: they are device-resident only IF the fused rung serves this
+        plan, which per-plan eligibility (lazy/view/sharded scans,
+        nullable or string features) can deny — so charging them to the
+        provable floor could shed a host-served plan.  Actual committed
+        bytes are the HBM ledger's job (``serving.ledger.model_bytes``)."""
+        program = None
+        param_bytes = 0
+        tier = "host"
+        label = "?"
+        n_features = max(len(node.schema) - 1, 1)
+        try:
+            ctx = self.context
+            # the fused rung is what makes params device-resident: with it
+            # disabled every PREDICT serves host-side
+            fused_on = ctx is not None \
+                and ctx.config.get("sql.compile.predict", True) \
+                and ctx.config.get("sql.compile", True)
+            if ctx is not None:
+                schema_name, name = ctx._table_schema_name(node.model_name)
+                label = name
+                model, cols = ctx.get_model(schema_name, name)
+                n_features = max(len(cols), 1)
+                from ..inference import program_for
+
+                program, _reason = program_for(ctx, schema_name, name,
+                                               model)
+                if program is not None and fused_on:
+                    param_bytes = program.param_bytes
+                    tier = "compiled"
+        except Exception:  # dsql: allow-broad-except — estimation is
+            # advisory; an unresolvable model keeps the host-tier claim
+            logger.debug("predict estimate model lookup failed",
+                         exc_info=True)
+        from ..inference import predict_scratch_bytes
+
+        per_row = predict_scratch_bytes(program, n_features)
+        self.model_rows.append(
+            f"model: name={label} tier={tier} param_bytes={param_bytes} "
+            f"features={n_features} row_floor={per_row}")
+        if rows.hi is None:
+            return None
+        return param_bytes + (_pow2_bucket(rows.hi) or 0) * per_row
 
     def _exchange_scratch(self, node: p.Aggregate, domain, all_known) -> int:
         """Per-device exchange-buffer bytes of the sharded aggregation
@@ -539,6 +605,7 @@ class _Estimator:
             rung_proofs=[],
             devices=self.devices,
             scan_bytes_lo=sum(self._scan_lo.values()),
+            model_rows=list(self.model_rows),
         )
 
 

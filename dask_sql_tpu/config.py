@@ -42,6 +42,12 @@ DEFAULTS: Dict[str, Any] = {
     "sql.compile": True,  # whole-pipeline jit for hot aggregation shapes
     "sql.compile.join": "auto",  # jit the shape-stable join probe phase
     "sql.compile.select": True,  # one-kernel root select chains
+    # fused PREDICT (inference/, physical/compiled_predict.py): run a
+    # registered model's tensor program in the SAME executable as the
+    # scan/filter feeding it (the compiled_predict ladder rung).  Off =
+    # every PREDICT takes the host predict path (pull to pandas,
+    # model.predict on numpy, re-upload).
+    "sql.compile.predict": True,
     "sql.compile.segsum": "auto",  # scatter | matmul | pallas segment sums
     "sql.streaming.enabled": True,  # out-of-core parquet batch aggregation
     "sql.streaming.batch_rows": 2_000_000,

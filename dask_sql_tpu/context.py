@@ -813,6 +813,11 @@ class Context:
         """Parity: context.py:626."""
         schema_name = schema_name or self.schema_name
         self.schema[schema_name].models[model_name] = (model, list(training_columns))
+        self.metrics.inc("inference.model.registered")
+        # the lowered-program cache is NOT invalidated here: it detects the
+        # replaced object lazily (id mismatch -> re-lower), and the stale
+        # entry is what lets inference/registry.py recognize a same-shape
+        # retrain as a zero-recompile model.swap
         self._catalog_serial += 1
         self._on_catalog_change()
 

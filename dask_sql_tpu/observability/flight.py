@@ -67,6 +67,9 @@ EVENT_NAMES = frozenset({
     # background work (serving/background.py, serving/warmup.py)
     "bg.recompile",
     "warmup.replay",
+    # model lowering + zero-recompile weight swaps (inference/registry.py)
+    "model.lower",
+    "model.swap",
 })
 
 #: prefixes legitimizing dynamic event families (none today; the slot

@@ -25,7 +25,9 @@ Accounting identities (all bytes):
     inflight_measured = measured footprints live queries reported so far
     result_cache      = resident bytes of cached result Tables
     tables            = at-rest bytes of registered (non-lazy) tables
-    headroom          = budget - reserved - result_cache - tables
+    models            = device-resident lowered model params
+                        (inference/registry.py — the compiled-PREDICT tier)
+    headroom          = budget - reserved - result_cache - tables - models
     drift             = inflight_measured - reserved   (surfaced, not hidden)
 
 Every read is advisory and failure-isolated: a broken accounting input
@@ -105,6 +107,18 @@ class DeviceLedger:
             self._tables_cache = (key, total)
         return total
 
+    def model_bytes(self) -> int:
+        """Device-resident bytes of lowered model params (the
+        compiled-PREDICT tier's weights, committed to device at lowering —
+        inference/registry.py)."""
+        try:
+            from ..inference import context_model_bytes
+
+            return int(context_model_bytes(self.context))
+        except Exception:  # dsql: allow-broad-except — advisory accounting
+            logger.debug("ledger model accounting failed", exc_info=True)
+            return 0
+
     # ------------------------------------------------------------- outputs
     def snapshot(self) -> Dict[str, Any]:
         ctx = self.context
@@ -113,16 +127,18 @@ class DeviceLedger:
         measured = int(ctx.live_queries.inflight_measured_bytes())
         cache_bytes = int(ctx._result_cache.stats.bytes)
         tables = self.table_bytes()
+        models = self.model_bytes()
         out: Dict[str, Any] = {
             "budgetBytes": budget,
             "reservedBytes": reserved,
             "inflightMeasuredBytes": measured,
             "resultCacheBytes": cache_bytes,
             "tableBytes": tables,
+            "modelBytes": models,
             "driftBytes": measured - reserved,
         }
         out["headroomBytes"] = None if budget is None else (
-            budget - reserved - cache_bytes - tables)
+            budget - reserved - cache_bytes - tables - models)
         return out
 
     def publish(self, metrics) -> Dict[str, Any]:
@@ -136,6 +152,7 @@ class DeviceLedger:
         metrics.gauge("serving.ledger.cache_bytes",
                       snap["resultCacheBytes"])
         metrics.gauge("serving.ledger.table_bytes", snap["tableBytes"])
+        metrics.gauge("serving.ledger.model_bytes", snap["modelBytes"])
         metrics.gauge("serving.ledger.reserve_drift_bytes",
                       snap["driftBytes"])
         if snap["budgetBytes"] is not None:
@@ -150,7 +167,7 @@ class DeviceLedger:
         pseudo-qid."""
         snap = self.snapshot()
         order = ("budgetBytes", "reservedBytes", "inflightMeasuredBytes",
-                 "resultCacheBytes", "tableBytes", "headroomBytes",
-                 "driftBytes")
+                 "resultCacheBytes", "tableBytes", "modelBytes",
+                 "headroomBytes", "driftBytes")
         return [("(ledger)", name, "" if snap[name] is None
                  else str(snap[name])) for name in order]

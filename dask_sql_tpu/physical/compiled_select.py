@@ -216,6 +216,15 @@ class CompiledSelect:
                     v = jnp.broadcast_to(v, (bucket,))
                 flat.append(d)
                 flat.append(v if v is not None else jnp.ones(bucket, dtype=bool))
+            # extension seam: the fused PREDICT rung (compiled_predict.py)
+            # appends its model-program outputs here, INSIDE the same
+            # traced gather — one executable, one packed transfer
+            for d, v in self._extra_pack_outputs(ev, slots, bucket):
+                if d.ndim == 0:
+                    d = jnp.broadcast_to(d, (bucket,))
+                flat.append(d)
+                flat.append(v if v is not None
+                            else jnp.ones(bucket, dtype=bool))
             tags: List[Tuple[str, np.dtype]] = []
             out = pack_flat(flat, tags)
             self._pack_tags = tags
@@ -225,7 +234,11 @@ class CompiledSelect:
         # plugin cache ever sees this object
         datas_s = tuple(table.columns[n].data for n in table.column_names)
         valids_s = tuple(table.columns[n].validity for n in table.column_names)
-        params_s = tuple(np.asarray(v) for v in params)
+        # eval_shape needs only shapes/dtypes: anything already exposing
+        # them (numpy values, committed DEVICE weight arrays from the
+        # fused PREDICT rung) passes through without a d2h pull
+        params_s = tuple(v if hasattr(v, "shape") and hasattr(v, "dtype")
+                         else np.asarray(v) for v in params)
         jax.eval_shape(mask_fn, datas_s, valids_s, table.row_valid, params_s)
         jax.eval_shape(lambda d, v, m, q: gather_fn(d, v, m, q, 8), datas_s,
                        valids_s,
@@ -244,6 +257,15 @@ class CompiledSelect:
         #: gather kernel once per distinct pow2 survivor bucket
         self._mask_warm = False
         self._warm_buckets: set = set()
+
+    def _extra_pack_outputs(self, ev, slots, bucket):
+        """Extra (data, validity_or_None) pairs appended to the packed
+        gather output under trace — the seam the fused PREDICT rung
+        (CompiledPredict) overrides to run its model program over the
+        gathered survivors in the SAME jit.  ``slots`` holds the gathered
+        per-column (data, valid) pairs plus the runtime parameter vector
+        under PARAMS_SLOT."""
+        return ()
 
     def _survivor_ordinal(self, mask):
         """1-based running survivor count the inner-LIMIT window slices.
@@ -269,6 +291,14 @@ class CompiledSelect:
         count = int(count_dev)  # one scalar round trip
         return self._finish(datas, valids, mask, count, tuple(params))
 
+    def _batched_param_split(self) -> Optional[int]:
+        """Count of leading runtime-parameter slots the batched vmap maps
+        over the batch axis; None = every slot (the family literal
+        vector).  The fused PREDICT rung (CompiledPredict) returns its
+        family-prefix length so the shared model weight tail rides
+        UNMAPPED instead of being stacked per batch slot."""
+        return None
+
     def run_batched(self, table: Table, params_list: List[Tuple]
                     ) -> List[Table]:
         """Family-batched execution: member literal vectors stack along a
@@ -281,22 +311,36 @@ class CompiledSelect:
         from ..observability import timed_jit_call
 
         n = len(params_list)
-        stacked, bucket = stack_params(params_list)
+        base = self._batched_param_split()
+        if base is None:
+            stacked, bucket = stack_params(params_list)
+            launch_params, axes = stacked, 0
+            member_params = params_list
+        else:
+            # shared unmapped tail (e.g. model weights): every member
+            # references the same arrays, so stacking would copy them per
+            # batch slot for a mask kernel that never reads them
+            tail = tuple(params_list[0][base:])
+            stacked, bucket = stack_params([m[:base] for m in params_list])
+            launch_params = tuple(stacked) + tail
+            axes = tuple([0] * base) + tuple([None] * len(tail))
+            member_params = [tuple(m[:base]) + tail for m in params_list]
         if self._mask_batched is None:
             self._mask_batched = jax.jit(
-                jax.vmap(self._mask_fn_raw, in_axes=(None, None, None, 0)))
+                jax.vmap(self._mask_fn_raw,
+                         in_axes=(None, None, None, axes)))
         datas = tuple(table.columns[c].data for c in table.column_names)
         valids = tuple(table.columns[c].validity
                        for c in table.column_names)
         masks, counts_dev = timed_jit_call(
             self._RUNG, self._mask_batched, datas, valids,
-            table.row_valid, stacked,
+            table.row_valid, launch_params,
             may_compile=bucket not in self._warm_mask_batch)
         self._warm_mask_batch.add(bucket)
         count_d2h()
         counts = np.asarray(jax.device_get(counts_dev))
         return [self._finish(datas, valids, masks[b], int(counts[b]),
-                             params_list[b]) for b in range(n)]
+                             member_params[b]) for b in range(n)]
 
     def _finish(self, datas, valids, mask, count: int,
                 params: Tuple) -> Table:
@@ -424,6 +468,45 @@ def _bucket_of(key: Tuple) -> Tuple:
     return (key[0], key[-2], key[-1])  # (uid, num_rows, padded_rows)
 
 
+def resolve_pipeline_inputs(scan, upper_filters, proj, executor):
+    """Shared eligibility preamble + family parameterization of a root
+    select chain — used by BOTH try_compiled_select and the fused PREDICT
+    rung (compiled_predict.py), so a new eligibility rule can never
+    silently apply to one and not the other.  Returns ``(dc, table,
+    p_upper, p_scan_flts, p_exprs, params)`` or None (decline)."""
+    dc = executor.context.schema[scan.schema_name].tables.get(scan.table_name)
+    if dc is None:
+        return None  # view-backed scans take the eager path
+    from ..datacontainer import LazyParquetContainer
+
+    if isinstance(dc, LazyParquetContainer):
+        return None  # IO-pushdown path already minimizes transfers
+    table = executor.get_table(scan.schema_name, scan.table_name)
+    if scan.projection is not None:
+        table = table.select(scan.projection)
+    if not table.column_names:
+        return None
+    from ..parallel.dist_plan import table_is_sharded
+
+    if table_is_sharded(table):
+        # mesh-sharded scans keep the distributed operators (range-
+        # partition sort leaves results sharded in sort order; pulling
+        # the whole table to one host defeats the layout)
+        return None
+    # parameterize (families/): filter and projection literals become
+    # runtime parameters so the cache key — and the mask/gather
+    # executables — are shared by the whole query family.  LIMIT /
+    # sort-fetch windows stay static (they steer host slicing and the
+    # survivor pull), so each window is its own family.
+    from .. import families
+
+    pz = families.pipeline_parameterizer(executor.config)
+    p_upper = [pz.rewrite(f) for f in upper_filters]
+    p_scan_flts = [pz.rewrite(f) for f in scan.filters]
+    p_exprs = [pz.rewrite(e) for e in proj.exprs]
+    return dc, table, p_upper, p_scan_flts, p_exprs, pz.params
+
+
 def try_compiled_select(root, executor) -> Optional[Table]:
     """Attempt the one-kernel/one-transfer path for a ROOT select chain."""
     mode = executor.config.get("sql.compile.select", True)
@@ -434,37 +517,13 @@ def try_compiled_select(root, executor) -> Optional[Table]:
         return None
     scan, upper_filters, proj, sort_keys, sort_fetch, limit, inner_limit = got
     try:
-        dc = executor.context.schema[scan.schema_name].tables.get(scan.table_name)
-        if dc is None:
-            return None  # view-backed scans take the eager path
-        from ..datacontainer import LazyParquetContainer
-
-        if isinstance(dc, LazyParquetContainer):
-            return None  # IO-pushdown path already minimizes transfers
-        table = executor.get_table(scan.schema_name, scan.table_name)
-        if scan.projection is not None:
-            table = table.select(scan.projection)
-        if not table.column_names:
-            return None
-        from ..parallel.dist_plan import table_is_sharded
-
-        if table_is_sharded(table):
-            # mesh-sharded scans keep the distributed operators (range-
-            # partition sort leaves results sharded in sort order; pulling
-            # the whole table to one host defeats the layout)
-            return None
-        # parameterize (families/): filter and projection literals become
-        # runtime parameters so the cache key — and the mask/gather
-        # executables — are shared by the whole query family.  LIMIT /
-        # sort-fetch windows stay static (they steer host slicing and the
-        # survivor pull), so each window is its own family.
         from .. import families
 
-        pz = families.pipeline_parameterizer(executor.config)
-        p_upper = [pz.rewrite(f) for f in upper_filters]
-        p_scan_flts = [pz.rewrite(f) for f in scan.filters]
-        p_exprs = [pz.rewrite(e) for e in proj.exprs]
-        params = pz.params
+        resolved = resolve_pipeline_inputs(scan, upper_filters, proj,
+                                           executor)
+        if resolved is None:
+            return None
+        dc, table, p_upper, p_scan_flts, p_exprs, params = resolved
         key = (
             dc.uid,
             tuple(scan.projection or ()),

@@ -62,6 +62,7 @@ class Executor:
         ServingRuntime's retry/backoff path is testable end to end."""
         from ..resilience import faults, ladder
         from ..spmd import try_spmd_select
+        from .compiled_predict import root_has_predict, try_compiled_predict
         from .compiled_select import try_compiled_select
 
         ticket = current_ticket()
@@ -80,7 +81,20 @@ class Executor:
         # (family, rung) breaker entity, stepping down to the single-launch
         # rungs below
         streamed_mark = id(rel) in self.stream_decisions
+        # fused PREDICT (physical/compiled_predict.py): a root
+        # PredictModelNode whose input is a compilable select chain runs
+        # model inference in the SAME executable as the scan — its own
+        # (family, compiled_predict) breaker entity, stepping down to the
+        # host predict path (PredictModelPlugin) below
+        predict_root = root_has_predict(rel)
         if self.config.get("resilience.ladder.enabled", True):
+            if predict_root:
+                out = ladder.attempt(
+                    self, "compiled_predict",
+                    lambda: try_compiled_predict(rel, self),
+                    rel=rel, inject_site="predict")
+                if out is not None:
+                    return out
             if streamed_mark:
                 from ..streaming import try_streamed_select
 
@@ -109,6 +123,11 @@ class Executor:
             return ladder.execute_interpreted(self, rel)
         # ladder disabled: injection sites still fire (a forced compile
         # fault must propagate here — that is what disabling proves)
+        if predict_root:
+            faults.maybe_inject("predict", self.config)
+            out = try_compiled_predict(rel, self)
+            if out is not None:
+                return out
         if streamed_mark:
             from ..streaming import try_streamed_select
 

@@ -200,9 +200,21 @@ class ShowModelsPlugin(BaseRelPlugin):
     class_name = "ShowModelsNode"
 
     def convert(self, rel: p.ShowModelsNode, executor) -> Table:
+        from ....inference import lowering_verdict
+
         ctx = executor.context
         schema = rel.schema_name or ctx.schema_name
-        return _string_table({"Model": list(ctx.schema[schema].models.keys())})
+        names = list(ctx.schema[schema].models.keys())
+        # the lowering verdict per model (inference/): which models serve
+        # on the compiled fused-PREDICT tier vs. the host predict path,
+        # their device-resident param bytes, and the program shape
+        verdicts = [lowering_verdict(ctx, schema, n) for n in names]
+        return _string_table({
+            "Model": names,
+            "Tier": [v["tier"] for v in verdicts],
+            "ParamBytes": [v["param_bytes"] for v in verdicts],
+            "Shape": [v["shape"] for v in verdicts],
+        })
 
 
 def _like_match(pattern: str, name: str) -> bool:

@@ -13,10 +13,40 @@ import numpy as np
 from ....columnar.column import Column
 from ....columnar.table import Table
 from ....planner import plan as p
+from ....resilience.errors import (
+    ModelError,
+    ModelNotFoundError,
+    QueryError,
+    ResourceExhaustedError,
+    classify,
+)
 from ..base import BaseRelPlugin, unique_names
 from ...executor import Executor
 
 _EMPTY = Table({}, 0)
+
+
+def _model_boundary(stage: str, fn):
+    """Run one model-layer step under the structured error taxonomy: a
+    failing fit/predict/class-resolution leaves here as a `ModelError`
+    (USER_ERROR on the Presto wire) instead of a raw traceback that
+    bypasses the QueryError code mapping."""
+    try:
+        return fn()
+    except QueryError:
+        raise
+    except (KeyboardInterrupt, SystemExit):
+        raise
+    except BaseException as exc:
+        # resource exhaustion (MemoryError on the pulled-to-host frame, an
+        # XLA RESOURCE_EXHAUSTED from a wrapped jax model) keeps its
+        # taxonomy class: the host tier is itself a degradation target and
+        # USER_ERROR would tell the client their statement is wrong
+        wrapped = classify(exc)
+        if isinstance(wrapped, ResourceExhaustedError):
+            raise wrapped
+        raise ModelError(
+            f"{stage} failed: {type(exc).__name__}: {exc}") from exc
 
 
 def _split_xy(df, target_column):
@@ -42,12 +72,19 @@ class CreateModelPlugin(BaseRelPlugin):
             if rel.if_not_exists:
                 return _EMPTY
             if not rel.or_replace:
-                raise RuntimeError(f"A model with the name {name} is already present.")
+                raise ModelError(
+                    f"A model with the name {name} is already present.")
         kwargs = dict(rel.kwargs)
         model_class = kwargs.pop("model_class", None)
         if model_class is None:
-            raise ValueError("CREATE MODEL requires a model_class parameter")
-        experiment_class = kwargs.pop("experiment_class", None)
+            raise ModelError("CREATE MODEL requires a model_class parameter")
+        if kwargs.pop("experiment_class", None) is not None:
+            # historically popped and silently dropped — surface the
+            # misdirected option instead of training something else
+            raise ModelError(
+                "experiment_class is a CREATE EXPERIMENT option; CREATE "
+                "MODEL trains model_class directly — use CREATE "
+                "EXPERIMENT for tuned fits")
         target_column = kwargs.pop("target_column", "")
         wrap_predict = _boolish(kwargs.pop("wrap_predict", False))
         wrap_fit = _boolish(kwargs.pop("wrap_fit", False))
@@ -59,14 +96,21 @@ class CreateModelPlugin(BaseRelPlugin):
         df = training_table.to_pandas()
         X, y = _split_xy(df, target_column)
 
-        ModelClass = get_model_class(str(model_class), backend=str(backend))
-        model = ModelClass(**kwargs)
-        if wrap_fit:
-            model = Incremental(model)
-        if y is not None:
-            model.fit(X.to_numpy(), y.to_numpy(), **fit_kwargs)
-        else:
-            model.fit(X.to_numpy(), **fit_kwargs)
+        ModelClass = _model_boundary(
+            "model_class resolution",
+            lambda: get_model_class(str(model_class), backend=str(backend)))
+
+        def fit():
+            model = ModelClass(**kwargs)
+            if wrap_fit:
+                model = Incremental(model)
+            if y is not None:
+                model.fit(X.to_numpy(), y.to_numpy(), **fit_kwargs)
+            else:
+                model.fit(X.to_numpy(), **fit_kwargs)
+            return model
+
+        model = _model_boundary(f"CREATE MODEL {name} fit", fit)
         if wrap_predict and not isinstance(model, (ParallelPostFit, Incremental)):
             model = ParallelPostFit(model)
         ctx.register_model(name, model, list(X.columns), schema_name=schema_name)
@@ -80,10 +124,19 @@ class PredictModelPlugin(BaseRelPlugin):
     def convert(self, rel: p.PredictModelNode, executor) -> Table:
         ctx = executor.context
         schema_name, name = ctx._table_schema_name(rel.model_name)
+        if name not in ctx.schema[schema_name].models:
+            raise ModelNotFoundError(
+                f"A model with the name {name} is not present.")
         model, training_columns = ctx.get_model(schema_name, name)
         inp = executor.execute(rel.input)
         df = inp.to_pandas()
-        pred = model.predict(df[training_columns].to_numpy())
+        # the host tier: pull to pandas, predict on numpy, re-upload —
+        # where PREDICTs land when the fused compiled_predict rung
+        # (physical/compiled_predict.py) declines or degrades
+        ctx.metrics.inc("inference.predict.host")
+        pred = _model_boundary(
+            f"PREDICT(MODEL {name})",
+            lambda: model.predict(df[training_columns].to_numpy()))
         names = unique_names([f.name for f in rel.schema])
         cols = dict(zip(names[:-1], [inp.columns[c] for c in inp.column_names]))
         cols[names[-1]] = Column.from_numpy(np.asarray(pred))
@@ -100,8 +153,12 @@ class DropModelPlugin(BaseRelPlugin):
         if name not in ctx.schema[schema_name].models:
             if rel.if_exists:
                 return _EMPTY
-            raise RuntimeError(f"A model with the name {name} is not present.")
+            raise ModelNotFoundError(
+                f"A model with the name {name} is not present.")
         del ctx.schema[schema_name].models[name]
+        from ....inference import invalidate
+
+        invalidate(ctx, schema_name, name)  # ledger stops charging params
         return _EMPTY
 
 
@@ -112,9 +169,20 @@ class DescribeModelPlugin(BaseRelPlugin):
     def convert(self, rel: p.DescribeModelNode, executor) -> Table:
         ctx = executor.context
         schema_name, name = ctx._table_schema_name(rel.name)
+        if name not in ctx.schema[schema_name].models:
+            raise ModelNotFoundError(
+                f"A model with the name {name} is not present.")
         model, training_columns = ctx.get_model(schema_name, name)
         params = model.get_params() if hasattr(model, "get_params") else {}
         params["training_columns"] = training_columns
+        # the lowering verdict (inference/): does this model serve on the
+        # compiled tier, how many device param bytes, what shape
+        from ....inference import lowering_verdict
+
+        verdict = lowering_verdict(ctx, schema_name, name)
+        params["lowering.tier"] = verdict["tier"]
+        params["lowering.param_bytes"] = verdict["param_bytes"]
+        params["lowering.shape"] = verdict["shape"]
         keys = np.array([str(k) for k in params.keys()], dtype=object)
         vals = np.array([str(v) for v in params.values()], dtype=object)
         return Table({"Params": Column.from_numpy(keys),
@@ -148,10 +216,10 @@ class ExportModelPlugin(BaseRelPlugin):
                 raise RuntimeError("mlflow is not installed") from e
             mlflow.sklearn.save_model(model, location, **kwargs)
         elif fmt == "onnx":
-            raise RuntimeError(
+            raise ModelError(
                 "ONNX export requires skl2onnx, which is not installed here")
         else:
-            raise NotImplementedError(f"EXPORT MODEL format {fmt!r}")
+            raise ModelError(f"EXPORT MODEL format {fmt!r} is not supported")
         return _EMPTY
 
 
@@ -186,7 +254,7 @@ class CreateExperimentPlugin(BaseRelPlugin):
             raise NotImplementedError(
                 "AutoML (TPOT-style) experiments need the automl package installed")
         if model_class is None:
-            raise ValueError("CREATE EXPERIMENT requires a model_class")
+            raise ModelError("CREATE EXPERIMENT requires a model_class")
         ModelClass = get_model_class(str(model_class), backend="cpu")
         base = ModelClass()
         ExperimentClass = get_model_class(str(experiment_class), backend="cpu")

@@ -152,6 +152,24 @@ class ShutdownError(QueryError):
     retryable = True  # another replica (or a restart) can take the query
 
 
+class ModelError(QueryError, ValueError):
+    """CREATE MODEL / PREDICT / EXPORT MODEL failed on the model layer
+    (unresolvable model_class, fit/predict raising, bad WITH options).
+    USER_ERROR: the statement — not the engine — is wrong, so the Presto
+    wire reports it as such instead of an INTERNAL_ERROR traceback.
+    ValueError base kept for compatibility with the historical raw raises
+    (the ParseError/BindingError pattern)."""
+
+    code = "MODEL_ERROR"
+    error_type = USER_ERROR
+
+
+class ModelNotFoundError(ModelError):
+    """The referenced model is not registered in the target schema."""
+
+    code = "MODEL_NOT_FOUND"
+
+
 class InjectedFault(QueryError):
     """Marker mixin-style base for faults raised by resilience/faults.py so
     tests and logs can tell injected failures from organic ones."""

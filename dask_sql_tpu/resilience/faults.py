@@ -18,6 +18,10 @@
 Sites wired through the engine (each raises the matching taxonomy error):
 
     compile     entry of the compiled planners (CompileError)
+    predict     entry of the fused-inference rung only (compiled_predict,
+                physical/compiled_predict.py) — proves the
+                fused->host-predict step-down without touching the select
+                rungs (ResourceExhaustedError)
     spmd        entry of the SPMD sharded rungs only (spmd_select /
                 spmd_aggregate / spmd_join_aggregate) — proves the
                 sharded->single-chip step-down without touching the
@@ -82,6 +86,7 @@ class InjectedWriteError(InjectedFault, ExecutionError):
 #: site -> error class raised when the site arms
 SITE_ERRORS = {
     "compile": InjectedCompileError,
+    "predict": InjectedOomError,
     "spmd": InjectedOomError,
     "oom": InjectedOomError,
     "exec_oom": InjectedOomError,

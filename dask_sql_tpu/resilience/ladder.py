@@ -30,6 +30,10 @@ single-chip without poisoning the family):
                             (fires only for admission-routed oversize plans)
     streamed_aggregate      streaming/aggregate.py morsel partial-state
                             aggregation with time-axis combines (ditto)
+    compiled_predict        physical/compiled_predict.py fused PREDICT:
+                            model inference in the scan's executable
+                            (fires only for root PredictModelNode plans;
+                            steps down to the host predict path)
     spmd_select             spmd/select.py shard_map root select chain
     spmd_aggregate          spmd/aggregate.py psum tree-reduce aggregation
     spmd_join_aggregate     spmd/join.py broadcast-join SPMD pipeline

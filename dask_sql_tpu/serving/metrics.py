@@ -45,6 +45,13 @@ DOCUMENTED_METRICS = frozenset({
     "columnar.encoding.codespace_pred",
     "columnar.encoding.late_rows",
     "columnar.encoding.decode",
+    # inference/ — model lowering + fused PREDICT (docs/ml.md)
+    "inference.model.registered",
+    "inference.model.lowered",
+    "inference.model.declined",
+    "inference.model.swap",
+    "inference.predict.compiled",
+    "inference.predict.host",
     # families/ — parameterized plan families + inter-query batching
     "families.parameterized",
     "families.hit",
@@ -77,6 +84,7 @@ DOCUMENTED_METRICS = frozenset({
     "serving.ledger.cache_bytes",
     "serving.ledger.table_bytes",
     "serving.ledger.headroom_bytes",
+    "serving.ledger.model_bytes",
     "serving.ledger.reserve_drift_bytes",
     # observability/ — live query table (live.py, CANCEL QUERY)
     "serving.cancel_requested",

@@ -219,7 +219,9 @@ def test_export_unknown_format(c, training_df, tmp_path):
     c.sql("""CREATE MODEL ef WITH (
                  model_class = 'LinearRegression', target_column = 'target'
              ) AS (SELECT x, y, target FROM timeseries)""")
-    with pytest.raises(NotImplementedError):
+    from dask_sql_tpu.resilience.errors import ModelError
+
+    with pytest.raises(ModelError, match="carbonite"):
         c.sql(f"EXPORT MODEL ef WITH (format = 'carbonite', "
               f"location = '{tmp_path / 'm.x'}')")
 
