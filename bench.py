@@ -1231,11 +1231,197 @@ def run_live_smoke():
         raise SystemExit(1)
 
 
+def run_reuse_smoke():
+    """`bench.py --reuse`: semantic result reuse smoke, exit 1 on
+    violation (ISSUE 16 acceptance).
+
+    Replays a 20-query dashboard twice against one context:
+
+    1. *Cold wave*: 20 distinct queries — sibling projections sharing
+       scan->filter stems, filtered point-lookups, grouped aggregates —
+       populate the exact-match cache, pin hot stems, register
+       subsumption candidates and incremental aggregate states.
+    2. *Warm wave*: the replay (exact repeats + TIGHTER int literals +
+       a NEVER-SEEN sibling projection) must be served entirely by the
+       reuse tiers: >=1 materialized-stem hit, >=1 subsumption answer,
+       ZERO foreground compiles (no ``compile.start`` flight events) and
+       ZERO base-table scan launches (every surviving TableScan reads a
+       pinned stem, never the catalog).
+    3. *Append*: ``INSERT INTO ... SELECT`` folds the delta through the
+       pinned stems (refresh, not rescan) and the stored combine states;
+       the re-queried aggregate matches pandas over base+delta and is
+       served as an incremental hit.
+    """
+    import json as _json
+
+    _ensure_backend()
+    import jax
+
+    from dask_sql_tpu import Context
+    from dask_sql_tpu.observability import flight
+    from dask_sql_tpu.physical.rel.logical import basic
+
+    n = 200_000
+    df = gen_lineitem(n, seed=0)
+    rng = np.random.RandomState(1)
+    # non-null int columns: the provable-interval domain for subsumption
+    df["l_orderkey"] = (rng.randint(0, 1_500_000, n) * 4).astype(np.int64)
+    df["l_linenumber"] = rng.randint(1, 8, n).astype(np.int64)
+
+    ctx = Context()
+    ctx.config.update({"serving.materialize.min_bytes": 1})
+    ctx.create_table("lineitem", df)
+
+    stem_where = "l_quantity < 30 AND l_discount < 0.05"
+    wave1 = [
+        # stem A siblings: pinned at the 2nd observation
+        f"SELECT l_extendedprice FROM lineitem WHERE {stem_where}",
+        f"SELECT l_quantity FROM lineitem WHERE {stem_where}",
+        f"SELECT l_tax FROM lineitem WHERE {stem_where}",
+        # subsumption families (int comparators, loose literals)
+        "SELECT l_orderkey, l_quantity FROM lineitem WHERE l_orderkey < 5000000",
+        "SELECT l_orderkey, l_linenumber FROM lineitem WHERE l_linenumber <= 6",
+        # incremental aggregate states + cacheable aggregates
+        "SELECT l_linenumber, SUM(l_quantity) AS s, COUNT(*) AS c "
+        "FROM lineitem GROUP BY l_linenumber",
+        "SELECT SUM(l_extendedprice) AS s FROM lineitem",
+        "SELECT l_returnflag, COUNT(*) AS c FROM lineitem GROUP BY l_returnflag",
+        "SELECT MAX(l_orderkey) AS m FROM lineitem",
+        "SELECT AVG(l_discount) AS a FROM lineitem",
+        # stem B siblings
+        "SELECT l_returnflag FROM lineitem WHERE l_tax < 0.04",
+        "SELECT l_discount FROM lineitem WHERE l_tax < 0.04",
+        # assorted dashboard panels (exact repeats in wave 2)
+        "SELECT l_linestatus, SUM(l_tax) AS s FROM lineitem GROUP BY l_linestatus",
+        "SELECT COUNT(*) AS c FROM lineitem WHERE l_returnflag = 'A'",
+        "SELECT COUNT(*) AS c FROM lineitem WHERE l_returnflag = 'R'",
+        "SELECT SUM(l_quantity) AS s FROM lineitem WHERE l_linestatus = 'F'",
+        "SELECT SUM(l_quantity) AS s FROM lineitem WHERE l_linestatus = 'O'",
+        "SELECT l_orderkey FROM lineitem WHERE l_orderkey >= 5900000",
+        "SELECT MIN(l_shipdate) AS d FROM lineitem",
+        "SELECT MAX(l_shipdate) AS d FROM lineitem",
+    ]
+    assert len(wave1) == 20
+    for q in wave1:
+        ctx.sql(q).compute()
+
+    # warm wave: exact repeats + tighter literals + a new stem sibling
+    wave2 = list(wave1[5:])  # 15 exact repeats
+    wave2 += [
+        f"SELECT l_linestatus FROM lineitem WHERE {stem_where}",  # new sibling
+        "SELECT l_orderkey, l_quantity FROM lineitem WHERE l_orderkey < 2000000",
+        "SELECT l_orderkey, l_linenumber FROM lineitem WHERE l_linenumber <= 3",
+        "SELECT l_orderkey FROM lineitem WHERE l_orderkey >= 5950000",
+        f"SELECT l_quantity FROM lineitem WHERE {stem_where}",  # repeat
+    ]
+    assert len(wave2) == 20
+
+    base_scans = {"n": 0}
+    orig_convert = basic.TableScanPlugin.convert
+
+    def counting_convert(self, rel, executor):
+        if executor.table_overrides.get(
+                (rel.schema_name, rel.table_name)) is None:
+            base_scans["n"] += 1
+        return orig_convert(self, rel, executor)
+
+    m = ctx.metrics
+    cache0 = ctx._result_cache.stats.hits
+    sub0 = m.counter("serving.reuse.subsumption.hits")
+    stem0 = m.counter("serving.materialize.hits")
+    incr0 = m.counter("serving.reuse.incremental.hits")
+    flight.RECORDER.clear()
+    basic.TableScanPlugin.convert = counting_convert
+    try:
+        results2 = [ctx.sql(q).compute() for q in wave2]
+    finally:
+        basic.TableScanPlugin.convert = orig_convert
+    compiles2 = len(flight.RECORDER.events(name="compile.start"))
+    cache_d = ctx._result_cache.stats.hits - cache0
+    sub_d = m.counter("serving.reuse.subsumption.hits") - sub0
+    stem_d = m.counter("serving.materialize.hits") - stem0
+    incr_d = m.counter("serving.reuse.incremental.hits") - incr0
+    served = cache_d + sub_d + stem_d + incr_d
+    ok_warm = (sub_d >= 1 and stem_d >= 1 and served >= len(wave2)
+               and compiles2 == 0 and base_scans["n"] == 0)
+
+    # spot-check the reuse-served answers against pandas
+    sub_df = results2[16]
+    ok_sub = len(sub_df) == int((df["l_orderkey"] < 2_000_000).sum())
+    sel = (df["l_quantity"] < 30) & (df["l_discount"] < 0.05)
+    ok_stem = len(results2[15]) == int(sel.sum())
+
+    # append phase: INSERT INTO folds the delta, never rescans history
+    refreshed0 = m.counter("serving.materialize.refreshed")
+    folds0 = m.counter("serving.reuse.incremental.folds")
+    ins = ctx.sql(
+        "INSERT INTO lineitem SELECT * FROM lineitem "
+        "WHERE l_orderkey < 40000").compute()
+    delta = df[df["l_orderkey"] < 40000]
+    ok_insert = int(ins["Inserted"][0]) == len(delta)
+    agg = ctx.sql(wave1[5]).compute()
+    incr_hit = m.counter("serving.reuse.incremental.hits") - incr0 - incr_d
+    full = df if not len(delta) else \
+        __import__("pandas").concat([df, delta], ignore_index=True)
+    exp = (full.groupby("l_linenumber", as_index=False)
+           .agg(s=("l_quantity", "sum"), c=("l_quantity", "count")))
+    got = agg.sort_values("l_linenumber").reset_index(drop=True)
+    exp = exp.sort_values("l_linenumber").reset_index(drop=True)
+    ok_incr = (incr_hit >= 1
+               and got["c"].tolist() == exp["c"].tolist()
+               and np.allclose(got["s"].to_numpy(),
+                               exp["s"].to_numpy(), rtol=1e-4))
+    ok_append = (ok_insert and ok_incr
+                 and m.counter("serving.materialize.refreshed") > refreshed0
+                 and m.counter("serving.reuse.incremental.folds") > folds0)
+
+    # ledger reconciliation: pinned bytes visible, idle after eviction
+    pinned = ctx.materialize.pinned_bytes()
+    ok_ledger = (pinned > 0
+                 and ctx.ledger.snapshot()["materializedBytes"] == pinned)
+    ctx.materialize.invalidate_all()
+    ok_ledger = ok_ledger and ctx.ledger.snapshot()["materializedBytes"] == 0
+
+    ok = ok_warm and ok_sub and ok_stem and ok_append and ok_ledger
+    print(_json.dumps({
+        "metric": "semantic_reuse_smoke",
+        "backend": jax.default_backend(),
+        "ok": bool(ok),
+        "rows": n,
+        "warm_wave": {
+            "queries": len(wave2),
+            "served_by_reuse": int(served),
+            "cache_hits": int(cache_d),
+            "subsumption_hits": int(sub_d),
+            "stem_hits": int(stem_d),
+            "incremental_hits": int(incr_d),
+            "foreground_compiles": int(compiles2),
+            "base_table_scans": int(base_scans["n"]),
+            "ok": bool(ok_warm and ok_sub and ok_stem),
+        },
+        "append": {
+            "rows_appended": int(ins["Inserted"][0]),
+            "stem_refreshes": int(
+                m.counter("serving.materialize.refreshed") - refreshed0),
+            "incremental_folds": int(
+                m.counter("serving.reuse.incremental.folds") - folds0),
+            "aggregate_matches_pandas": bool(ok_incr),
+            "ok": bool(ok_append),
+        },
+        "ledger": {"pinned_bytes_seen": int(pinned), "ok": bool(ok_ledger)},
+    }), flush=True)
+    if not ok:
+        raise SystemExit(1)
+
+
 def main():
     import sys
 
     if "--live" in sys.argv:
         run_live_smoke()
+        return
+    if "--reuse" in sys.argv:
+        run_reuse_smoke()
         return
     if "--lint" in sys.argv:
         run_lint_smoke()

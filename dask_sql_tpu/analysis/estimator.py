@@ -791,3 +791,103 @@ def estimate_and_apply(plan: p.LogicalPlan, context) -> PlanEstimate:
             metrics.inc("analysis.estimate.rung_proof")
     plan._dsql_estimate = verdict
     return verdict
+
+
+# ---------------------------------------------------------------------------
+# provable predicate-interval algebra (semantic reuse / subsumption)
+# ---------------------------------------------------------------------------
+#: comparator ops a single ParamRef predicate maps onto a value interval.
+#: ``eq`` included: an equality slot subsumes only the identical value.
+COMPARATOR_OPS = frozenset({"lt", "le", "gt", "ge", "eq"})
+
+#: mirror op when the comparison is written ``literal OP column`` —
+#: normalizing to column-on-the-left so one interval table covers both
+MIRRORED_OPS = {"lt": "gt", "le": "ge", "gt": "lt", "ge": "le", "eq": "eq"}
+
+
+@dataclass(frozen=True)
+class PredInterval:
+    """The value set ``{x : x OP v}`` of one comparator predicate as an
+    interval over the column domain.  ``None`` bound = unbounded on that
+    side; ``*_open`` marks a strict (exclusive) endpoint.  This is the
+    *predicate* lattice the subsumption check reasons in — distinct from
+    the cardinality/byte `Interval` above, which is always closed."""
+
+    lo: Optional[float]
+    hi: Optional[float]
+    lo_open: bool = False
+    hi_open: bool = False
+
+
+def pred_interval(op: str, value) -> Optional[PredInterval]:
+    """The interval of column values ``column OP value`` selects, or None
+    when ``op`` is not a plain comparator (the slot then declines
+    subsumption entirely)."""
+    if op not in COMPARATOR_OPS:
+        return None
+    # keep the native scalar: Python's int/float comparisons are exact
+    # (coercing int64 through float would lose precision past 2**53)
+    v = int(value) if isinstance(value, bool) else value
+    if op == "lt":
+        return PredInterval(None, v, hi_open=True)
+    if op == "le":
+        return PredInterval(None, v)
+    if op == "gt":
+        return PredInterval(v, None, lo_open=True)
+    if op == "ge":
+        return PredInterval(v, None)
+    return PredInterval(v, v)  # eq
+
+
+def _bound_contains(outer_v, outer_open: bool, inner_v, inner_open: bool,
+                    side: str, float_domain: bool) -> bool:
+    """Does the outer interval's ``side`` bound admit the inner's?  PROOF
+    ONLY: returns False whenever the decision rests on exact endpoint
+    equality in a float domain — host-side equality of the two parameter
+    values does not prove the device-cast (e.g. float64 -> float32 column
+    dtype) boundary semantics coincide, so equal float endpoints decline
+    rather than guess."""
+    if outer_v is None:
+        return True      # outer unbounded on this side: anything fits
+    if inner_v is None:
+        return False     # inner unbounded where outer is not
+    if side == "lo":
+        if outer_v < inner_v:
+            return True
+        if outer_v > inner_v:
+            return False
+    else:
+        if outer_v > inner_v:
+            return True
+        if outer_v < inner_v:
+            return False
+    # endpoints exactly equal on the host: the decision IS the boundary
+    if float_domain:
+        return False
+    return (not outer_open) or inner_open
+
+
+def interval_contains(outer: PredInterval, inner: PredInterval,
+                      float_domain: bool = False) -> bool:
+    """PROVABLE containment ``inner ⊆ outer`` — the subsumption oracle.
+    True only when every row the inner predicate selects is provably a row
+    the outer predicate selected; never heuristic.  ``float_domain`` marks
+    a float column or parameter dtype: any containment that would be
+    decided by endpoint *equality* then declines (see `_bound_contains`)."""
+    return (_bound_contains(outer.lo, outer.lo_open, inner.lo,
+                            inner.lo_open, "lo", float_domain)
+            and _bound_contains(outer.hi, outer.hi_open, inner.hi,
+                                inner.hi_open, "hi", float_domain))
+
+
+def param_slot_contains(op: str, cached_value, new_value,
+                        float_domain: bool = False) -> bool:
+    """One family parameter slot's containment verdict: does the cached
+    execution's ``column OP cached_value`` provably cover the incoming
+    ``column OP new_value``?  Both predicates share the op (same family),
+    so this reduces to interval containment of the two value sets."""
+    outer = pred_interval(op, cached_value)
+    inner = pred_interval(op, new_value)
+    if outer is None or inner is None:
+        return False
+    return interval_contains(outer, inner, float_domain=float_domain)

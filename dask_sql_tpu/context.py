@@ -84,11 +84,16 @@ class TpuFrame:
         """Run the plan to a device Table (cached).
 
         Serving integration: before executing, the context's result cache is
-        consulted under a key of (plan fingerprint, catalog signature,
-        config) — a repeated identical query returns the materialized Table
-        without touching the executor; any DDL/DML on a referenced table
-        changes the key (uid / `_catalog_serial` versioning), so stale
-        results can never be served."""
+        consulted under a key of (plan fingerprint, parameter vector,
+        per-referenced-table versions, config) — a repeated identical query
+        returns the materialized Table without touching the executor; any
+        DDL/DML on a referenced table changes the key (uid / delta-epoch
+        versioning), so stale results can never be served.  On an exact
+        miss the semantic reuse tiers (materialize/) get a shot: an
+        incrementally-maintained aggregate state or a provably-subsuming
+        cached sibling serves without executing, and a plan whose
+        scan->filter stem is pinned executes against the materialized stem
+        instead of the base table."""
         if self._result is None:
             from .physical.executor import Executor
             from .resilience.ladder import plan_fingerprint, wrap_boundary
@@ -211,6 +216,25 @@ class TpuFrame:
                                              family=family_fp)
                     self._result = hit
                     return self._result
+                # semantic reuse tiers (materialize/): an incremental
+                # aggregate state or a PROVABLY-subsuming cached sibling
+                # answers the query without compiling or scanning anything
+                reuse = ctx.materialize.try_reuse(self._plan, family, key)
+                if reuse is not None:
+                    served, tier = reuse
+                    if tr is not None:
+                        tr.event(f"semantic_reuse:{tier}")
+                    ctx.profiles.record_exec(fp, sql=sql_text,
+                                             cache_hit=True,
+                                             family=family_fp)
+                    if key is not None:
+                        # promote to tier 0: an exact repeat of THIS query
+                        # now hits the result cache directly
+                        ctx._result_cache.put(
+                            key, served,
+                            deps=ctx._plan_table_deps(self._plan))
+                    self._result = served
+                    return self._result
                 estimate = ctx._plan_estimate(self._plan)
                 routed = None
                 if estimate is not None:
@@ -242,13 +266,27 @@ class TpuFrame:
                     # different budget cannot null it mid-flight
                     node, decision = routed
                     executor.stream_decisions[id(node)] = decision
+                exec_plan = self._plan
+                if routed is None:
+                    # sub-plan materialization (materialize/manager.py):
+                    # when this plan's scan->filter stem is pinned, execute
+                    # a rewritten copy that scans the materialized stem —
+                    # the base table is never touched and nothing compiles.
+                    # Streamed executions keep the original plan: their
+                    # routing decision is keyed on ITS node identity.
+                    rewritten = ctx.materialize.try_stem_rewrite(self._plan)
+                    if rewritten is not None:
+                        exec_plan, stem_overrides = rewritten
+                        executor.table_overrides.update(stem_overrides)
+                        if tr is not None:
+                            tr.event("materialized_stem_scan")
                 t0 = time.perf_counter()
                 # executor boundary: every failure leaves here as a taxonomy
                 # QueryError (code/retryable/degradable), never a raw
                 # device traceback (resilience/errors.py)
                 with observability.stage("execute"):
                     self._result = wrap_boundary(
-                        lambda: executor.execute_root(self._plan))
+                        lambda: executor.execute_root(exec_plan))
                 exec_ms = (time.perf_counter() - t0) * 1000.0
                 ctx.metrics.observe("query.execute_ms", exec_ms)
                 ctx.metrics.inc("query.executed")
@@ -288,8 +326,16 @@ class TpuFrame:
                     # now exists (record_estimate never creates entries)
                     ctx.profiles.record_estimate(fp, est.rows.hi,
                                                  family=family_fp)
+                deps = ctx._plan_table_deps(self._plan)
                 if key is not None:
-                    ctx._result_cache.put(key, self._result)
+                    # deps-tagged: append_rows/DDL invalidate exactly the
+                    # entries reading the mutated tables (epoch-scoped)
+                    ctx._result_cache.put(key, self._result, deps=deps)
+                # semantic reuse observation (materialize/): stem hit
+                # counting (pin at threshold), subsumption candidate
+                # registration, incremental capture registration
+                ctx.materialize.observe(self._plan, family, key, deps,
+                                        self._result)
         return self._result
 
     def compute(self):
@@ -404,6 +450,17 @@ class Context:
         #: reservations + measured in-flight footprints + result-cache +
         #: at-rest table bytes reconciled against the device budget
         self.ledger = observability.DeviceLedger(self)
+        #: per-(schema, table) delta epoch: bumped by append_rows (and any
+        #: create/drop of the name) WITHOUT replacing the container — the
+        #: result-cache key and the semantic reuse tiers (materialize/)
+        #: version on it, so an append invalidates exactly its dependents
+        self._table_epochs: Dict[Tuple[str, str], int] = {}
+        from .materialize import MaterializationManager
+
+        #: semantic result reuse (materialize/): pinned sub-plan stems,
+        #: subsumption answering over cached results, incremental
+        #: maintenance of aggregate states across append_rows
+        self.materialize = MaterializationManager(self)
         # the process flight recorder is always on; the capacity key only
         # resizes its ring
         observability.flight.RECORDER.resize(
@@ -484,21 +541,47 @@ class Context:
             parts.append(tuple(sorted(container.function_lists)))
         return parts
 
-    def _on_catalog_change(self) -> None:
-        """Called by every DDL-shaped mutation (table/view/function/model/
-        schema changes).  The result-cache keys embed the catalog signature,
-        so stale entries could never be *hit* — but unreachable entries
-        would stay pinned in HBM until byte-pressure from new inserts.
-        Dropping the cache eagerly frees those buffers now."""
-        self._result_cache.invalidate_all()
+    def table_epoch(self, schema_name: str, table_name: str) -> int:
+        """The (schema, table) delta epoch — 0 until the first append or
+        create/drop of the name.  Rides the result-cache key's per-table
+        parts and the materialize/ validity checks."""
+        return self._table_epochs.get((schema_name, table_name), 0)
+
+    def _bump_table_epoch(self, schema_name: str, table_name: str) -> int:
+        tkey = (schema_name, table_name)
+        epoch = self._table_epochs.get(tkey, 0) + 1
+        self._table_epochs[tkey] = epoch
+        return epoch
+
+    def _on_catalog_change(self, tables=None) -> None:
+        """Called by every DDL-shaped mutation.  The result-cache keys
+        embed per-referenced-table versions, so stale entries could never
+        be *hit* — but unreachable entries would stay pinned in HBM until
+        byte-pressure from new inserts; eager invalidation frees those
+        buffers now.  With ``tables`` (a set of (schema, table) names) the
+        invalidation is TARGETED: only cached results and materializations
+        depending on those tables drop — results over other tables
+        survive.  Without it (view/function/schema/model DDL, whose blast
+        radius is not table-attributable) everything drops, as before."""
+        if tables:
+            n = self._result_cache.invalidate_tables(tables)
+            n += self.materialize.invalidate_tables(tables)
+            if n:
+                self.metrics.inc("query.cache.invalidated", n)
+        else:
+            self._result_cache.invalidate_all()
+            self.materialize.invalidate_all()
         with self._plan_lock:
             self._family_estimates.clear()
 
     def _result_cache_key(self, plan, config_options) -> Optional[Tuple]:
-        """Result-cache key: (normalized plan fingerprint, catalog
-        signature + serial, config options) — or None when this result must
-        not be cached (caching disabled, side-effecting/model statements,
-        unhashable config)."""
+        """Result-cache key: (normalized plan fingerprint, parameter
+        vector, per-referenced-table versions (uid, rows, delta epoch),
+        config options) — or None when this result must not be cached
+        (caching disabled, side-effecting/model statements, unhashable
+        config).  Versioning only the REFERENCED tables (not the whole
+        catalog signature) is what lets an append to one table leave every
+        other table's cached results valid."""
         if not self.config.get("serving.cache.enabled", True):
             return None
         if isinstance(plan, plan_nodes.CustomNode):
@@ -511,6 +594,7 @@ class Context:
             return None
         from .datacontainer import LazyParquetContainer
 
+        table_parts: List[Tuple] = []
         stack = [plan]
         while stack:
             node = stack.pop()
@@ -525,6 +609,24 @@ class Context:
                     # file-backed scan: the files can change on disk without
                     # any catalog version bump, so the result is uncacheable
                     return None
+                if dc is None:
+                    view = self._views.get(node.schema_name, {}).get(
+                        node.table_name)
+                    if view is not None:
+                        # the scan resolves through a view at execution
+                        # time: the UNDERLYING tables must version this key
+                        # (an append to one invalidates results over the
+                        # view), so the view plan joins the walk
+                        stack.append(view)
+                # per-referenced-table version: identity (uid), size and
+                # delta epoch — an append bumps the epoch, a replace the
+                # uid, so exactly the dependent keys go stale while results
+                # over OTHER tables keep their keys (and their entries)
+                table_parts.append(
+                    (node.schema_name, node.table_name,
+                     None if dc is None else dc.uid,
+                     None if dc is None else int(dc.table.num_rows),
+                     self.table_epoch(node.schema_name, node.table_name)))
             # volatile calls (RAND / CURRENT_TIMESTAMP) and UDFs (arbitrary
             # host code) must re-evaluate per query; nested subquery plans
             # join the walk so nothing hides inside an expression
@@ -543,14 +645,16 @@ class Context:
             # values back into the placeholder slots reconstructs it — so
             # family metrics and cache accounting see one family, while two
             # queries with different literals still get distinct entries.
+            # INVARIANT: the parameter vector sits at index 2 in BOTH
+            # shapes — subsumption answering (materialize/manager.py)
+            # admits a candidate by comparing every part EXCEPT index 2.
             family = getattr(plan, "_dsql_family", None)
             if family is not None:
                 parts: List[Any] = ["result", family.family_repr,
                                     family.key_values, self.schema_name]
             else:
-                parts = ["result", repr(plan), self.schema_name]
-            parts.extend(self._catalog_signature())
-            parts.append(self._catalog_serial)
+                parts = ["result", repr(plan), (), self.schema_name]
+            parts.extend(sorted(set(table_parts)))
             parts.append(self.config.effective_items())
             if config_options:
                 parts.append(tuple(sorted(config_options.items())))
@@ -560,6 +664,28 @@ class Context:
         except Exception:  # dsql: allow-broad-except — unhashable config /
             # unprintable plan just means this result is uncacheable
             return None
+
+    def _plan_table_deps(self, plan) -> frozenset:
+        """Every (schema, table) name a plan reads — nested subquery plans
+        and view expansions included.  Tags result-cache entries and
+        semantic-reuse state for targeted (epoch-scoped) invalidation."""
+        deps = set()
+        stack = [plan]
+        while stack:
+            node = stack.pop()
+            if isinstance(node, plan_nodes.TableScan):
+                deps.add((node.schema_name, node.table_name))
+                if node.table_name not in self.schema.get(
+                        node.schema_name,
+                        SchemaContainer(node.schema_name)).tables:
+                    view = self._views.get(node.schema_name, {}).get(
+                        node.table_name)
+                    if view is not None:
+                        stack.append(view)
+            nested, _ = _scan_node_exprs(node)
+            stack.extend(nested)
+            stack.extend(node.inputs())
+        return frozenset(deps)
 
     # ------------------------------------------------------------ tables
     def create_table(
@@ -638,17 +764,25 @@ class Context:
             self.metrics.inc("columnar.encoding.encoded_columns", n_enc)
             self.metrics.observe("columnar.encoding.encoded_bytes", enc_b)
             self.metrics.observe("columnar.encoding.decoded_bytes", dec_b)
+        self._bump_table_epoch(schema_name, table_name)
         if self._views.setdefault(schema_name, {}).pop(table_name, None) is not None:
+            # replacing a VIEW with a table: results over OTHER views may
+            # reference this name through their plans — full invalidation
             self._catalog_serial += 1
-        self._on_catalog_change()
+            self._on_catalog_change()
+        else:
+            self._on_catalog_change(tables={(schema_name, table_name)})
 
     def drop_table(self, table_name: str, schema_name: Optional[str] = None) -> None:
         schema_name = schema_name or self.schema_name
         self.schema[schema_name].tables.pop(table_name, None)
         self.schema[schema_name].statistics.pop(table_name, None)
+        self._bump_table_epoch(schema_name, table_name)
         if self._views.get(schema_name, {}).pop(table_name, None) is not None:
             self._catalog_serial += 1
-        self._on_catalog_change()
+            self._on_catalog_change()
+        else:
+            self._on_catalog_change(tables={(schema_name, table_name)})
 
     def alter_table(self, old_name: str, new_name: str,
                     schema_name: Optional[str] = None) -> None:
@@ -659,7 +793,73 @@ class Context:
         stats = self.schema[schema_name].statistics
         if old_name in stats:
             stats[new_name] = stats.pop(old_name)
-        self._on_catalog_change()
+        self._bump_table_epoch(schema_name, old_name)
+        self._bump_table_epoch(schema_name, new_name)
+        self._on_catalog_change(tables={(schema_name, old_name),
+                                        (schema_name, new_name)})
+
+    def append_rows(self, table_name: str, rows: Any,
+                    schema_name: Optional[str] = None) -> int:
+        """Append rows to a registered table IN PLACE — the engine behind
+        ``INSERT INTO``.  Unlike create_table (replace), the container and
+        its uid survive: only the per-table *delta epoch* bumps, so the
+        result cache drops exactly the entries depending on this table
+        (epoch-scoped keys) while results over other tables stay servable,
+        and the semantic reuse tiers (materialize/) fold ONLY the appended
+        chunk — pinned stems re-execute over the delta slice, stored
+        streamed-combine states absorb it as one more time-axis partition —
+        without rescanning history.
+
+        ``rows`` is anything `create_table` accepts (DataFrame, dict of
+        arrays, list of tuples...) with a column subset compatible with the
+        existing table.  Lazy parquet registrations and row-sharded tables
+        cannot concat in place and degrade to a replace (fresh uid,
+        wholesale invalidation for this table).  Returns the number of
+        appended rows."""
+        schema_name = schema_name or self.schema_name
+        container = self.schema.get(schema_name)
+        dc = container.tables.get(table_name) if container else None
+        if dc is None:
+            raise KeyError(f"Table {schema_name}.{table_name} not found")
+        delta_dc = InputUtil.to_dc(rows, table_name)
+        delta = delta_dc.table
+        appended = int(delta.num_rows)
+        self.metrics.inc("serving.reuse.append_rows", appended)
+        from .datacontainer import DataContainer, LazyParquetContainer
+
+        tkey = (schema_name, table_name)
+        if isinstance(dc, LazyParquetContainer) \
+                or dc.table.row_valid is not None:
+            # no in-place concat story for file-backed or padded/sharded
+            # storage: degrade to a replace — fresh uid, so every reuse
+            # tier fails closed on its identity checks
+            base = dc.table
+            merged = Table.concat(
+                [base.slice(0, base.num_rows), delta])
+            container.tables[table_name] = DataContainer(merged)
+            container.statistics[table_name] = Statistics(
+                float(merged.num_rows))
+            self._bump_table_epoch(schema_name, table_name)
+            self._on_catalog_change(tables={tkey})
+            return appended
+        old_rows = int(dc.table.num_rows)
+        # same container, same uid: concat decodes + promotes as needed,
+        # and raises on an incompatible column set before any state changes
+        dc.table = Table.concat([dc.table, delta])
+        container.statistics[table_name] = Statistics(
+            float(dc.table.num_rows))
+        epoch = self._bump_table_epoch(schema_name, table_name)
+        # targeted: exactly the cached results reading this table drop
+        # (their keys embed the old epoch and can never be hit again);
+        # reuse state REFRESHES instead of dropping — that is the point
+        n = self._result_cache.invalidate_tables({tkey})
+        if n:
+            self.metrics.inc("query.cache.invalidated", n)
+        with self._plan_lock:
+            self._family_estimates.clear()
+        self.materialize.on_append(schema_name, table_name, dc, old_rows,
+                                   epoch)
+        return appended
 
     # ------------------------------------------------------------ schemas
     def create_schema(self, schema_name: str) -> None:

@@ -35,10 +35,14 @@ from .batcher import FamilyBatcher
 from .parameterize import (
     FamilyInfo,
     Parameterizer,
+    StemInfo,
     compute_family,
+    compute_stem,
+    full_width_stem,
     normalize_in_values,
     pow2_bucket,
     stack_params,
+    stem_of,
 )
 
 logger = logging.getLogger(__name__)
@@ -47,14 +51,18 @@ __all__ = [
     "FamilyBatcher",
     "FamilyInfo",
     "Parameterizer",
+    "StemInfo",
     "batcher_of",
     "compute_family",
+    "compute_stem",
     "enabled",
     "family_of",
+    "full_width_stem",
     "normalize_in_values",
     "pipeline_parameterizer",
     "pow2_bucket",
     "stack_params",
+    "stem_of",
 ]
 
 

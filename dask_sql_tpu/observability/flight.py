@@ -70,6 +70,11 @@ EVENT_NAMES = frozenset({
     # model lowering + zero-recompile weight swaps (inference/registry.py)
     "model.lower",
     "model.swap",
+    # semantic reuse: materialized stems + incremental refresh (materialize/)
+    "materialize.store",
+    "materialize.hit",
+    "materialize.evict",
+    "materialize.refresh",
 })
 
 #: prefixes legitimizing dynamic event families (none today; the slot

@@ -27,7 +27,10 @@ Accounting identities (all bytes):
     tables            = at-rest bytes of registered (non-lazy) tables
     models            = device-resident lowered model params
                         (inference/registry.py — the compiled-PREDICT tier)
+    materialized      = device-resident pinned sub-plan stems
+                        (materialize/ — the semantic reuse tier)
     headroom          = budget - reserved - result_cache - tables - models
+                        - materialized
     drift             = inflight_measured - reserved   (surfaced, not hidden)
 
 Every read is advisory and failure-isolated: a broken accounting input
@@ -119,6 +122,19 @@ class DeviceLedger:
             logger.debug("ledger model accounting failed", exc_info=True)
             return 0
 
+    def materialized_bytes(self) -> int:
+        """Device-resident bytes of pinned sub-plan stems (the semantic
+        reuse tier's materializations — materialize/manager.py)."""
+        manager = getattr(self.context, "materialize", None)
+        if manager is None:
+            return 0
+        try:
+            return int(manager.pinned_bytes())
+        except Exception:  # dsql: allow-broad-except — advisory accounting
+            logger.debug("ledger materialization accounting failed",
+                         exc_info=True)
+            return 0
+
     # ------------------------------------------------------------- outputs
     def snapshot(self) -> Dict[str, Any]:
         ctx = self.context
@@ -128,6 +144,7 @@ class DeviceLedger:
         cache_bytes = int(ctx._result_cache.stats.bytes)
         tables = self.table_bytes()
         models = self.model_bytes()
+        materialized = self.materialized_bytes()
         out: Dict[str, Any] = {
             "budgetBytes": budget,
             "reservedBytes": reserved,
@@ -135,10 +152,11 @@ class DeviceLedger:
             "resultCacheBytes": cache_bytes,
             "tableBytes": tables,
             "modelBytes": models,
+            "materializedBytes": materialized,
             "driftBytes": measured - reserved,
         }
         out["headroomBytes"] = None if budget is None else (
-            budget - reserved - cache_bytes - tables - models)
+            budget - reserved - cache_bytes - tables - models - materialized)
         return out
 
     def publish(self, metrics) -> Dict[str, Any]:
@@ -153,6 +171,8 @@ class DeviceLedger:
                       snap["resultCacheBytes"])
         metrics.gauge("serving.ledger.table_bytes", snap["tableBytes"])
         metrics.gauge("serving.ledger.model_bytes", snap["modelBytes"])
+        metrics.gauge("serving.ledger.materialized_bytes",
+                      snap["materializedBytes"])
         metrics.gauge("serving.ledger.reserve_drift_bytes",
                       snap["driftBytes"])
         if snap["budgetBytes"] is not None:
@@ -168,6 +188,6 @@ class DeviceLedger:
         snap = self.snapshot()
         order = ("budgetBytes", "reservedBytes", "inflightMeasuredBytes",
                  "resultCacheBytes", "tableBytes", "modelBytes",
-                 "headroomBytes", "driftBytes")
+                 "materializedBytes", "headroomBytes", "driftBytes")
         return [("(ledger)", name, "" if snap[name] is None
                  else str(snap[name])) for name in order]

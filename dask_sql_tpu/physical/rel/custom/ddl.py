@@ -323,6 +323,61 @@ class ShowQueriesPlugin(BaseRelPlugin):
 
 
 @Executor.add_plugin_class
+class ShowMaterializedPlugin(BaseRelPlugin):
+    """SHOW MATERIALIZED [LIKE 'pat'] — the semantic-reuse state
+    (materialize/) as a result set: one row per pinned sub-plan stem
+    (device rows/bytes, rewrite hits, the base table's delta epoch it was
+    last refreshed to) and per incrementally-maintained aggregate state.
+    LIKE filters on the kind, the fingerprint or the table name."""
+
+    class_name = "ShowMaterializedNode"
+
+    def convert(self, rel: p.ShowMaterializedNode, executor) -> Table:
+        rows = executor.context.materialize.rows()
+        if rel.like:
+            rows = [r for r in rows
+                    if _like_match(rel.like, r[0])
+                    or _like_match(rel.like, r[1])
+                    or _like_match(rel.like, r[2])]
+        return _string_table({"Kind": [r[0] for r in rows],
+                              "Fingerprint": [r[1] for r in rows],
+                              "Table": [r[2] for r in rows],
+                              "Rows": [str(r[3]) for r in rows],
+                              "Bytes": [str(r[4]) for r in rows],
+                              "Hits": [str(r[5]) for r in rows],
+                              "Epoch": [str(r[6]) for r in rows]})
+
+
+@Executor.add_plugin_class
+class InsertIntoPlugin(BaseRelPlugin):
+    """INSERT INTO t VALUES (...) / INSERT INTO t SELECT ... — the append
+    path.  The body executes like any query, its columns bind to the
+    target POSITIONALLY (full rows in registration order, standard
+    column-list-free INSERT semantics), and the rows land through
+    `Context.append_rows`: same container, delta-epoch bump, incremental
+    maintenance — never a wholesale cache flush."""
+
+    class_name = "InsertIntoNode"
+
+    def convert(self, rel: p.InsertIntoNode, executor) -> Table:
+        ctx = executor.context
+        schema_name, name = ctx._table_schema_name(rel.name)
+        dc = ctx.schema[schema_name].tables.get(name)
+        if dc is None:
+            raise RuntimeError(f"A table with the name {name} is not present.")
+        delta = executor.execute(rel.input)
+        target_names = list(dc.table.columns)
+        if len(delta.columns) != len(target_names):
+            raise RuntimeError(
+                f"INSERT INTO {name} expects {len(target_names)} columns "
+                f"({', '.join(target_names)}), got {len(delta.columns)}")
+        renamed = Table(dict(zip(target_names, delta.columns.values())),
+                        delta.num_rows, row_valid=delta.row_valid)
+        n = ctx.append_rows(name, renamed, schema_name=schema_name)
+        return _string_table({"Inserted": [str(n)]})
+
+
+@Executor.add_plugin_class
 class CancelQueryPlugin(BaseRelPlugin):
     """CANCEL QUERY '<qid>' — cooperative cancellation through the live
     registry's `QueryTicket`: the executor raises at its next checkpoint

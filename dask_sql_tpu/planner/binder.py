@@ -185,6 +185,20 @@ class Binder:
                  Field("Field", SqlType.VARCHAR),
                  Field("Value", SqlType.VARCHAR)],
                 stmt.like)
+        if isinstance(stmt, a.ShowMaterialized):
+            return p.ShowMaterializedNode(
+                [Field("Kind", SqlType.VARCHAR),
+                 Field("Fingerprint", SqlType.VARCHAR),
+                 Field("Table", SqlType.VARCHAR),
+                 Field("Rows", SqlType.VARCHAR),
+                 Field("Bytes", SqlType.VARCHAR),
+                 Field("Hits", SqlType.VARCHAR),
+                 Field("Epoch", SqlType.VARCHAR)],
+                stmt.like)
+        if isinstance(stmt, a.InsertInto):
+            inner, _ = self.bind_query(stmt.query)
+            return p.InsertIntoNode([Field("Inserted", SqlType.VARCHAR)],
+                                    stmt.table, inner)
         if isinstance(stmt, a.CancelQuery):
             return p.CancelQueryNode(
                 [Field("Qid", SqlType.VARCHAR),

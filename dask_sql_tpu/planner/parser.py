@@ -196,6 +196,12 @@ class Parser:
             self.expect_keyword("WITH")
             kwargs = self.parse_kwargs()
             return a.ExportModel(name, kwargs)
+        if self.at_keyword("INSERT"):
+            self.next()
+            self.expect_keyword("INTO")
+            name = self.parse_qualified_name()
+            # the body is any query: VALUES (...), (...) or a full SELECT
+            return a.InsertInto(name, self.parse_query())
         raise self.error("Unsupported statement")
 
     def parse_create(self) -> a.Statement:
@@ -304,9 +310,14 @@ class Parser:
             if self.accept_keyword("LIKE"):
                 like = self.next().value
             return a.ShowQueries(like)
+        if self.accept_keyword("MATERIALIZED"):
+            like = None
+            if self.accept_keyword("LIKE"):
+                like = self.next().value
+            return a.ShowMaterialized(like)
         raise self.error(
-            "Expected SCHEMAS, TABLES, COLUMNS, MODELS, METRICS, PROFILES "
-            "or QUERIES after SHOW")
+            "Expected SCHEMAS, TABLES, COLUMNS, MODELS, METRICS, PROFILES, "
+            "QUERIES or MATERIALIZED after SHOW")
 
     def parse_alter(self) -> a.Statement:
         self.expect_keyword("ALTER")

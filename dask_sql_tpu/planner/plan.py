@@ -462,6 +462,29 @@ class ShowQueriesNode(CustomNode):
 
 
 @dataclass(eq=False)
+class ShowMaterializedNode(CustomNode):
+    """SHOW MATERIALIZED — the semantic-reuse state (materialize/):
+    pinned sub-plan stems and incremental aggregate states."""
+
+    like: Optional[str] = None
+
+
+@dataclass(eq=False)
+class InsertIntoNode(CustomNode):
+    """INSERT INTO — the append path (Context.append_rows): delta-epoch
+    bump + incremental maintenance instead of wholesale invalidation."""
+
+    name: List[str] = None
+    input: LogicalPlan = None
+
+    def inputs(self):
+        return [self.input]
+
+    def with_inputs(self, inputs):
+        return InsertIntoNode(self.schema, self.name, inputs[0])
+
+
+@dataclass(eq=False)
 class CancelQueryNode(CustomNode):
     """CANCEL QUERY '<qid>' — cooperative in-flight cancellation
     (observability/live.py -> QueryTicket)."""

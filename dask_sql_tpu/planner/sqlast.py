@@ -427,6 +427,26 @@ class ShowQueries(Statement):
 
 
 @dataclass
+class ShowMaterialized(Statement):
+    """SHOW MATERIALIZED: the semantic-reuse state (materialize/) —
+    pinned sub-plan stems (rows/bytes/hits) and incrementally-maintained
+    aggregate states, one row each."""
+
+    like: Optional[str] = None
+
+
+@dataclass
+class InsertInto(Statement):
+    """INSERT INTO t VALUES ... / INSERT INTO t SELECT ...: the append
+    path (Context.append_rows) — rows concat onto the existing container,
+    only the per-table delta epoch bumps, and the semantic reuse tiers
+    (materialize/) fold the delta instead of rescanning history."""
+
+    table: List[str] = None
+    query: Any = None  # a Select (SELECT or VALUES body)
+
+
+@dataclass
 class CancelQuery(Statement):
     """CANCEL QUERY '<qid>': cooperative cancellation of an in-flight
     query through its `QueryTicket` (executor checkpoints raise at the

@@ -20,7 +20,7 @@ import threading
 import time
 from collections import OrderedDict
 from dataclasses import dataclass, field
-from typing import Any, Dict, Hashable, Optional, Tuple
+from typing import Any, Dict, FrozenSet, Hashable, Iterable, Optional, Tuple
 
 
 def table_nbytes(table) -> int:
@@ -43,6 +43,10 @@ class _Entry:
     nbytes: int
     created: float
     hits: int = 0
+    #: (schema, table) pairs this result was computed from — the epoch-scoped
+    #: invalidation scope: an append/replace of one table drops exactly the
+    #: entries depending on it (`invalidate_tables`), never the whole cache
+    deps: FrozenSet[Tuple[str, str]] = frozenset()
 
 
 @dataclass
@@ -103,9 +107,12 @@ class ResultCache:
             return entry.value
 
     def put(self, key: Hashable, value: Any,
-            nbytes: Optional[int] = None) -> bool:
+            nbytes: Optional[int] = None,
+            deps: Optional[Iterable[Tuple[str, str]]] = None) -> bool:
         """Insert (or refresh) an entry; returns False when the value is
-        over the per-entry cap and was not cached."""
+        over the per-entry cap and was not cached.  ``deps`` is the set of
+        (schema, table) names the result was computed from — the scope
+        `invalidate_tables` drops on a targeted DML/DDL invalidation."""
         if nbytes is None:
             nbytes = table_nbytes(value)
         nbytes = int(nbytes)
@@ -119,7 +126,8 @@ class ResultCache:
             if old is not None:
                 self.stats.bytes -= old.nbytes
                 self.stats.entries -= 1
-            self._entries[key] = _Entry(value, nbytes, self._clock())
+            self._entries[key] = _Entry(value, nbytes, self._clock(),
+                                        deps=frozenset(deps or ()))
             self.stats.bytes += nbytes
             self.stats.entries += 1
             self.stats.inserts += 1
@@ -137,6 +145,22 @@ class ResultCache:
             self.stats.bytes = 0
             self.stats.entries = 0
         return n
+
+    def invalidate_tables(self, tables: Iterable[Tuple[str, str]]) -> int:
+        """Drop exactly the entries whose deps intersect ``tables`` —
+        the epoch-scoped invalidation an append/replace of one table
+        triggers.  Entries inserted without deps (legacy callers, direct
+        test puts) are dropped too: an unknown provenance must never
+        survive a catalog change it might depend on."""
+        targets = set(tables)
+        if not targets:
+            return 0
+        with self._lock:
+            doomed = [(k, e) for k, e in self._entries.items()
+                      if not e.deps or (e.deps & targets)]
+            for k, e in doomed:
+                self._drop_locked(k, e)
+        return len(doomed)
 
     # ------------------------------------------------------------- helpers
     def _drop_locked(self, key, entry) -> None:

@@ -85,6 +85,7 @@ DOCUMENTED_METRICS = frozenset({
     "serving.ledger.table_bytes",
     "serving.ledger.headroom_bytes",
     "serving.ledger.model_bytes",
+    "serving.ledger.materialized_bytes",
     "serving.ledger.reserve_drift_bytes",
     # observability/ — live query table (live.py, CANCEL QUERY)
     "serving.cancel_requested",
@@ -102,6 +103,7 @@ DOCUMENTED_METRICS = frozenset({
     "query.cache.oversize",
     "query.cache.evicted",
     "query.cache.estimate_skip",
+    "query.cache.invalidated",
     # resilience/ — ladder, breaker, retry, watchdog, persistent cache
     "resilience.compile_cache.enabled",
     "resilience.compile_cache.hit",
@@ -170,6 +172,20 @@ DOCUMENTED_METRICS = frozenset({
     "serving.bg_compile.dropped",
     "serving.bg_compile.deferred",
     "serving.bg_compile.ms",
+    # serving/ + materialize/ — semantic reuse: sub-plan materialization,
+    # subsumption answering, incremental maintenance (materialize/,
+    # docs/serving.md "Semantic reuse and materialization")
+    "serving.materialize.stored",
+    "serving.materialize.hits",
+    "serving.materialize.evicted",
+    "serving.materialize.refreshed",
+    "serving.materialize.declined",
+    "serving.reuse.subsumption.hits",
+    "serving.reuse.subsumption.declined",
+    "serving.reuse.incremental.hits",
+    "serving.reuse.incremental.folds",
+    "serving.reuse.incremental.declined",
+    "serving.reuse.append_rows",
 })
 
 #: Prefixes legitimizing *dynamic* metric families (f-string names keyed by

@@ -73,6 +73,7 @@ enum Kind : int32_t {
   K_CREATE_EXPERIMENT = 97, K_KWARGS = 98, K_KV = 99, K_KWLIST = 100,
   K_SHOW_METRICS = 101, K_SHOW_PROFILES = 102,
   K_SHOW_QUERIES = 103, K_CANCEL_QUERY = 104,
+  K_SHOW_MATERIALIZED = 105, K_INSERT_INTO = 106,
 };
 
 // statement flag bits
@@ -445,6 +446,12 @@ class Parser {
       return b_.add(K_USE_SCHEMA, {}, 0, 0, 0.0,
                     b_.intern(parse_identifier()));
     }
+    if (at_keyword("INSERT")) {
+      next();
+      expect_keyword("INTO");
+      int32_t qn = parse_qname();
+      return b_.add(K_INSERT_INTO, {qn, parse_query()});
+    }
     if (at_keyword("ALTER")) return parse_alter();
     if (at_keyword("CANCEL")) {
       next();
@@ -592,9 +599,14 @@ class Parser {
       if (accept_keyword("LIKE")) like = b_.intern(next().value);
       return b_.add(K_SHOW_QUERIES, {}, 0, 0, 0.0, like);
     }
+    if (accept_keyword("MATERIALIZED")) {
+      int32_t like = -1;
+      if (accept_keyword("LIKE")) like = b_.intern(next().value);
+      return b_.add(K_SHOW_MATERIALIZED, {}, 0, 0, 0.0, like);
+    }
     throw ParseErr{peek().pos,
                    "Expected SCHEMAS, TABLES, COLUMNS, MODELS, METRICS, "
-                   "PROFILES or QUERIES after SHOW"};
+                   "PROFILES, QUERIES or MATERIALIZED after SHOW"};
   }
 
   int32_t parse_alter() {
@@ -1706,6 +1718,8 @@ void dsql_buf_free(uint8_t* p) { std::free(p); }
 // (flag bit 8 on K_EXPLAIN_STMT) — bumped so a stale prebuilt .so is
 // rejected and the Python parser handles the syntax
 // version 5: SHOW QUERIES (K_SHOW_QUERIES) + CANCEL QUERY (K_CANCEL_QUERY)
-int32_t dsql_parser_abi_version() { return 5; }
+// version 6: SHOW MATERIALIZED (K_SHOW_MATERIALIZED) + INSERT INTO
+// (K_INSERT_INTO) — the semantic-reuse surface
+int32_t dsql_parser_abi_version() { return 6; }
 
 }  // extern "C"

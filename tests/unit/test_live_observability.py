@@ -283,6 +283,8 @@ def test_prometheus_golden_ledger_gauges(tmp_path):
         f"dsql_serving_ledger_headroom_bytes {(1 << 20) - t_bytes}\n"
         "# TYPE dsql_serving_ledger_inflight_measured_bytes gauge\n"
         "dsql_serving_ledger_inflight_measured_bytes 0\n"
+        "# TYPE dsql_serving_ledger_materialized_bytes gauge\n"
+        "dsql_serving_ledger_materialized_bytes 0\n"
         "# TYPE dsql_serving_ledger_model_bytes gauge\n"
         "dsql_serving_ledger_model_bytes 0\n"
         "# TYPE dsql_serving_ledger_reserve_drift_bytes gauge\n"

@@ -217,8 +217,15 @@ def test_ddl_frees_cache_bytes():
     q = "SELECT SUM(a) AS s FROM t"
     c.sql(q, return_futures=False)
     assert c._result_cache.stats.entries == 1
-    c.create_table("t2", pd.DataFrame({"z": [1]}))  # any DDL
-    # unreachable entries are reclaimed eagerly, not just unreferenced
+    # table DDL is epoch-scoped now: registering an UNRELATED table leaves
+    # the entry over t valid — and still hittable
+    c.create_table("t2", pd.DataFrame({"z": [1]}))
+    assert c._result_cache.stats.entries == 1
+    c.sql(q, return_futures=False)
+    assert c.metrics.counter("query.cache.hit") == 1
+    # replacing the REFERENCED table reclaims its entries eagerly, not
+    # just unreferenced
+    c.create_table("t", pd.DataFrame({"a": [7, 8]}))
     assert c._result_cache.stats.entries == 0
     assert c._result_cache.stats.bytes == 0
 
