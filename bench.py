@@ -1414,9 +1414,71 @@ def run_reuse_smoke():
         raise SystemExit(1)
 
 
+def run_chaos_smoke():
+    """`bench.py --chaos`: seeded chaos campaigns, exit 1 on any
+    invariant violation (ISSUE 17 acceptance).
+
+    Runs >= 5 seeds, each a deterministic fault storm of >= 40
+    concurrent mixed queries (interactive aggregates, batch scans,
+    streamed partitioned queries, PREDICT inference, exact repeats,
+    mid-flight cancels) with rotating probability-armed subsets of
+    every inject site.  Individual query outcomes are free under
+    chaos; what must hold after every drain are the GLOBAL invariants
+    (resilience/chaos.py): terminal live-table entries, idle
+    reservations and ledger, restorable breakers, no zombie threads,
+    causally consistent flight timelines.
+    """
+    import json as _json
+
+    _ensure_backend()
+    import jax
+
+    from dask_sql_tpu.resilience.chaos import run_campaign
+
+    seeds = [1, 2, 3, 4, 5]
+    per_seed = []
+    total_violations = 0
+    for seed in seeds:
+        t0 = time.perf_counter()
+        report = run_campaign(seed=seed, queries=40, rounds=4, workers=4)
+        elapsed = time.perf_counter() - t0
+        print(report.summary(), flush=True)
+        for v in report.violations:
+            print(f"  VIOLATION: {v}", flush=True)
+        total_violations += len(report.violations)
+        per_seed.append({
+            "seed": seed,
+            "submitted": report.submitted,
+            "completed": report.completed,
+            "failed": report.failed,
+            "cancelled": report.cancelled,
+            "shed": report.shed,
+            "rounds": report.rounds,
+            "sites_armed": len(report.armed),
+            "violations": len(report.violations),
+            "seconds": round(elapsed, 2),
+            "ok": report.ok,
+        })
+    ok = total_violations == 0
+    print(_json.dumps({
+        "metric": "chaos_campaign_smoke",
+        "backend": jax.default_backend(),
+        "ok": bool(ok),
+        "seeds": len(seeds),
+        "queries_per_seed": 40,
+        "invariant_violations": int(total_violations),
+        "campaigns": per_seed,
+    }), flush=True)
+    if not ok:
+        raise SystemExit(1)
+
+
 def main():
     import sys
 
+    if "--chaos" in sys.argv:
+        run_chaos_smoke()
+        return
     if "--live" in sys.argv:
         run_live_smoke()
         return

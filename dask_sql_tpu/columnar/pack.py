@@ -77,8 +77,15 @@ def packed_host_arrays(bufs: List) -> Optional[List[np.ndarray]]:
     if fn is None:
         fn = _build(sig)
         _jit_cache[key] = fn
+    from ..config import config as _config
+    from ..resilience import faults
     from ..utils import count_d2h
 
+    # fault site ``d2h`` (resilience/faults.py): the packed transfer is
+    # the one wire round trip a tunneled accelerator can drop — injected
+    # here as a retryable TransientExecutionError so the serving worker's
+    # backoff retry (never the rung breaker) absorbs it
+    faults.maybe_inject("d2h", _config)
     count_d2h()
     packed = np.asarray(jax.device_get(fn(*bufs)))
     out = []

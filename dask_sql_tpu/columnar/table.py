@@ -212,8 +212,16 @@ class Table:
             bufs.append(c.data)
             if c.validity is not None:
                 bufs.append(c.validity)
+        from ..resilience.errors import QueryError
+
         try:
             host = packed_host_arrays(bufs)
+        except QueryError:
+            # taxonomy failures (a dropped tunneled transfer — fault site
+            # ``d2h``) must keep their retry semantics: the serving
+            # worker's backoff absorbs them; a silent per-column fallback
+            # would hide the drop AND re-pay the transfer N times
+            raise
         except Exception:  # dsql: allow-broad-except — backend pack quirk -> per-column
             host = None
         if host is None:

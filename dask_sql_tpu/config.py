@@ -153,6 +153,10 @@ DEFAULTS: Dict[str, Any] = {
     "serving.batch.max_running": None,  # concurrent batch cap (None = workers-1; 0 pauses batch)
     "serving.deadline_s": None,  # default per-query deadline, seconds (None = unbounded)
     "serving.retry_after_s": 1.0,  # floor of the retry-after hint on load shed
+    # ceiling of EVERY Retry-After hint (queue-full backoff, the drain
+    # predictor, CRITICAL-band pressure sheds): a pathological backlog
+    # estimate must never tell a client to go away for an hour
+    "serving.retry_after.cap_s": 60.0,
     # pre-compile OOM gate: shed queries whose statically PROVABLE peak
     # device bytes (estimator lower bound) exceed this budget, with a
     # non-retryable ESTIMATED_BYTES_EXCEEDED before any compilation.
@@ -178,6 +182,11 @@ DEFAULTS: Dict[str, Any] = {
     # admission cap on the partition count: a plan needing more launches
     # than this to fit is shed (bounded latency beats unbounded streaming)
     "serving.stream.max_partitions": 256,
+    # per-chunk launch deadline, ms (None/0 = off): a wedged mid-stream
+    # launch raises a degradable STREAM_LAUNCH_TIMEOUT between chunks —
+    # the compile-watchdog pattern extended to streamed execution — so a
+    # hung launch can never hold the ticket's byte reservation forever
+    "serving.stream.launch_timeout_ms": None,
     # Zero-cold-start serving (docs/serving.md "Cold starts"): persistent
     # executable cache + profile-driven pre-warm + background recompile.
     "serving.compile_cache.path": None,  # dir for the persistent XLA executable cache (None = off)
@@ -255,6 +264,22 @@ DEFAULTS: Dict[str, Any] = {
     "resilience.breaker.cooldown_s": 30.0,  # seconds before a half-open trial is admitted
     "resilience.breaker.persist_ttl_s": 300.0,  # max age of checkpointed breaker verdicts restored on load_state (0 = never restore)
     "resilience.compile_timeout_ms": None,  # watchdog deadline on any XLA compile (None = off); expiry degrades the rung
+    # Coordinated HBM pressure response (resilience/pressure.py,
+    # docs/resilience.md "Pressure hierarchy"): tiered bands over the
+    # ledger's headroom against serving.scheduler.device_budget_bytes
+    # (STRICTLY that key — no device budget = banding off, GREEN always).
+    # YELLOW suspends speculative work (warm-up, background recompiles,
+    # new stem pins); RED reclaims cross-tier (cold result cache ->
+    # unpinned stems -> idle model params) back to the YELLOW floor;
+    # CRITICAL forces new admissions onto streamed rungs where eligible
+    # and sheds the rest with a drain-predicted Retry-After.  enabled also
+    # gates the ladder's reclaim-before-degrade OOM retry.
+    "resilience.pressure.enabled": True,
+    "resilience.pressure.yellow_frac": 0.25,  # headroom <= frac*budget enters YELLOW
+    "resilience.pressure.red_frac": 0.10,  # headroom <= frac*budget enters RED
+    "resilience.pressure.critical_frac": 0.05,  # headroom <= frac*budget enters CRITICAL
+    "resilience.pressure.model_idle_s": 120.0,  # committed model params idle this long are reclaimable
+
     "resilience.inject": None,  # fault-injection spec, e.g. "compile:0.5,oom:once" (tests only)
     "resilience.inject.seed": 0,  # PRNG seed for probabilistic fault modes
     "resilience.inject.hang_s": 30.0,  # sleep modeled by HANG fault sites (compile_hang)

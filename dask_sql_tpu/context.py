@@ -450,6 +450,13 @@ class Context:
         #: reservations + measured in-flight footprints + result-cache +
         #: at-rest table bytes reconciled against the device budget
         self.ledger = observability.DeviceLedger(self)
+        from .resilience.pressure import PressureController
+
+        #: coordinated HBM pressure response (resilience/pressure.py):
+        #: bands the ledger's headroom against the device budget, suspends
+        #: speculative work at YELLOW, reclaims cross-tier at RED, forces
+        #: streamed admission / sheds at CRITICAL
+        self.pressure = PressureController(self)
         #: per-(schema, table) delta epoch: bumped by append_rows (and any
         #: create/drop of the name) WITHOUT replacing the container — the
         #: result-cache key and the semantic reuse tiers (materialize/)
@@ -993,7 +1000,8 @@ class Context:
                 from .serving.background import BackgroundCompiler
 
                 bg = self._bg_compiler = BackgroundCompiler.from_config(
-                    self.config, metrics=self.metrics)
+                    self.config, metrics=self.metrics,
+                    suspended=self.pressure.suspend_speculative)
             else:
                 return bg
         self._register_background(bg)

@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
@@ -62,6 +63,16 @@ def _commit(program: ModelProgram) -> ModelProgram:
         program, params=tuple(jnp.asarray(p) for p in program.params))
 
 
+def _stamp_use(context, key: Tuple[str, str]) -> None:
+    """Record a fused (committing) use — the idleness signal
+    `reclaim_idle_models` reads.  Plain dict assignment is GIL-atomic; the
+    stamp is advisory, so a torn read only delays one reclaim."""
+    uses = getattr(context, "_model_last_use", None)
+    if uses is None:
+        uses = context._model_last_use = {}
+    uses[key] = time.monotonic()
+
+
 def _still_registered(context, schema_name: str, name: str, model) -> bool:
     """A DROP MODEL (or replacement) racing a lowering must not let the
     lowering re-insert an entry for the gone object — it would pin
@@ -90,6 +101,8 @@ def program_for(context, schema_name: str, name: str, model: Any,
     reg = _registry(context)
     key = (schema_name, name)
     metrics = getattr(context, "metrics", None)
+    if commit:
+        _stamp_use(context, key)
     with _lock:
         entry = reg.get(key)
     if entry is not None and entry[0] is model:
@@ -168,6 +181,51 @@ def invalidate(context, schema_name: str, name: str) -> None:
     from ..physical.compiled_predict import drop_model_pipelines
 
     drop_model_pipelines(context, schema_name, name)
+
+
+def reclaim_idle_models(context, idle_s: float = 120.0,
+                        bytes_needed: Optional[int] = None) -> int:
+    """Pressure reclaim (resilience/pressure.py, tier 3 of the cross-tier
+    walk): de-commit committed model params whose last fused use is at
+    least ``idle_s`` seconds old.  The params move back to host numpy —
+    the next PREDICT re-commits with ZERO recompile (the compiled-predict
+    executable keys on the shape, weights are runtime args) — and the
+    model's pipeline-cache entries are dropped so no executable keeps the
+    device buffers pinned.  Returns device bytes freed; stops early once
+    ``bytes_needed`` is met.  Models with a fresh stamp are hot (actively
+    serving fused PREDICTs) and are never touched."""
+    import dataclasses
+
+    reg = getattr(context, "_model_programs", None)
+    if not reg:
+        return 0
+    uses = getattr(context, "_model_last_use", {}) or {}
+    now = time.monotonic()
+    freed = 0
+    with _lock:
+        entries = list(reg.items())
+    for key, (model, program, reason, committed) in entries:
+        if bytes_needed is not None and freed >= bytes_needed:
+            break
+        if program is None or not committed:
+            continue
+        last = uses.get(key)
+        if last is not None and now - last < idle_s:
+            continue
+        demoted = dataclasses.replace(
+            program, params=tuple(np.asarray(p) for p in program.params))
+        with _lock:
+            cur = reg.get(key)
+            if cur is None or cur[0] is not model or not cur[3]:
+                continue  # raced a swap / drop / concurrent reclaim
+            reg[key] = (model, demoted, reason, False)
+        freed += int(program.param_bytes)
+        from ..physical.compiled_predict import drop_model_pipelines
+
+        drop_model_pipelines(context, key[0], key[1])
+        logger.info("pressure reclaim de-committed idle model %s.%s "
+                    "(%d bytes)", key[0], key[1], program.param_bytes)
+    return freed
 
 
 def context_model_bytes(context) -> int:

@@ -123,6 +123,20 @@ class MaterializationManager:
         with self._lock:
             return sum(e.nbytes for e in self._pinned.values())
 
+    def reclaim_bytes(self, bytes_needed: Optional[int] = None) -> int:
+        """Pressure reclaim (resilience/pressure.py tier 2): evict
+        LRU-coldest pinned stems until at least ``bytes_needed`` are freed
+        (``None`` = drop every pin); returns bytes actually freed.  A
+        dropped stem just re-pins once traffic re-earns its hit count."""
+        freed = 0
+        with self._lock:
+            while self._pinned and (bytes_needed is None
+                                    or freed < bytes_needed):
+                key = next(iter(self._pinned))
+                freed += self._pinned[key].nbytes
+                self._evict_locked(key, "pressure")
+        return freed
+
     # ===================================================== answering tiers
     def try_reuse(self, plan: p.LogicalPlan, family,
                   key: Optional[Tuple]) -> Optional[Tuple[Table, str]]:
@@ -282,6 +296,13 @@ class MaterializationManager:
                 self._stem_hits.popitem(last=False)
             if hits < int(self._cfg("serving.materialize.min_hits", 2)):
                 return
+        pressure = getattr(self.context, "pressure", None)
+        if pressure is not None and pressure.suspend_speculative():
+            # YELLOW band (resilience/pressure.py): a new pin is
+            # speculative HBM growth — skip it.  The earned hit count
+            # stays, so the next observation under GREEN pins immediately.
+            self.context.metrics.inc("resilience.pressure.suspended")
+            return
         self._pin(si, key)
 
     def _pin(self, si, key) -> None:

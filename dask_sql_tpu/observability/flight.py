@@ -75,6 +75,11 @@ EVENT_NAMES = frozenset({
     "materialize.hit",
     "materialize.evict",
     "materialize.refresh",
+    # coordinated HBM pressure response (resilience/pressure.py)
+    "pressure.band",
+    "pressure.reclaim",
+    # chaos campaign harness (resilience/chaos.py)
+    "chaos.arm",
 })
 
 #: prefixes legitimizing dynamic event families (none today; the slot

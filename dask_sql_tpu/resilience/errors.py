@@ -106,6 +106,20 @@ class CompileTimeoutError(CompileError):
     code = "COMPILE_TIMEOUT"
 
 
+class StreamLaunchTimeoutError(QueryError):
+    """A streamed per-chunk launch exceeded
+    ``serving.stream.launch_timeout_ms`` and was abandoned by the watchdog
+    BETWEEN chunks (streaming/runner.py) instead of wedging the ticket's
+    reservation forever.  Degradable — the ladder steps the streamed rung
+    down and charges the breaker — but deliberately NOT a
+    `ResourceExhaustedError`: a wedged launch is not memory pressure, so
+    the reclaim-before-degrade retry does not apply."""
+
+    code = "STREAM_LAUNCH_TIMEOUT"
+    error_type = INSUFFICIENT_RESOURCES
+    degradable = True
+
+
 class ExecutionError(QueryError):
     """A plan node failed while executing device kernels."""
 

@@ -39,6 +39,10 @@ Sites wired through the engine (each raises the matching taxonomy error):
                 chunk floor is reached)
     checkpoint  checkpoint.save_state mid-write, before the atomic CURRENT
                 repoint (ExecutionError — proves crash recoverability)
+    d2h         the packed device-to-host transfer (columnar/pack.py;
+                TransientExecutionError — a dropped tunnel transfer is
+                retryable at the serving worker and must never charge the
+                rung breaker or degrade the query)
 
 The injector is rebuilt whenever the spec string changes, so tests can flip
 faults on and off through plain config scopes.  When the key is unset the
@@ -93,6 +97,7 @@ SITE_ERRORS = {
     "execute": InjectedTransientError,
     "partition": InjectedOomError,
     "checkpoint": InjectedWriteError,
+    "d2h": InjectedTransientError,
 }
 
 #: sites that model a HANG rather than an error: arming one yields a sleep

@@ -53,7 +53,7 @@ import time
 from typing import Callable, Optional, TypeVar
 
 from ..observability import trace_event
-from .errors import QueryError, classify
+from .errors import QueryError, ResourceExhaustedError, classify
 from . import faults
 
 logger = logging.getLogger(__name__)
@@ -199,44 +199,68 @@ def attempt(executor, rung: str, fn: Callable[[], Optional[T]],
                      "skipping", rung)
         return None
     t0 = time.perf_counter()
-    try:
-        if inject_site is not None:
-            faults.maybe_inject(inject_site, executor.config)
-        out = fn()
-    except (KeyboardInterrupt, SystemExit):
-        raise
-    except BaseException as exc:  # dsql: allow-broad-except — degradable
-        # taxonomy errors are MEANT to be absorbed here (that is the ladder);
-        # classify() re-raises everything non-degradable below
-        # classify() maps raw runtime failures (e.g. an XlaRuntimeError whose
-        # message leads with RESOURCE_EXHAUSTED) into the taxonomy; only
-        # *degradable* results step down — everything else re-raises as-is so
-        # non-ladder failure behavior is unchanged
-        err = classify(exc)
-        if not err.degradable:
+    reclaim_tried = False
+    retried = False
+    while True:
+        try:
+            if inject_site is not None:
+                faults.maybe_inject(inject_site, executor.config)
+            out = fn()
+            break
+        except (KeyboardInterrupt, SystemExit):
             raise
-        metrics.inc("resilience.degraded")
-        metrics.inc(f"resilience.degraded.{rung}")
-        trace_event(f"degraded:{rung}", code=err.code)
-        from ..observability import flight
-        from ..serving.runtime import current_ticket
+        except BaseException as exc:  # dsql: allow-broad-except — degradable
+            # taxonomy errors are MEANT to be absorbed here (that is the
+            # ladder); classify() re-raises everything non-degradable below
+            # classify() maps raw runtime failures (e.g. an XlaRuntimeError
+            # whose message leads with RESOURCE_EXHAUSTED) into the taxonomy;
+            # only *degradable* results step down — everything else re-raises
+            # as-is so non-ladder failure behavior is unchanged
+            err = classify(exc)
+            if not err.degradable:
+                raise
+            if not reclaim_tried and isinstance(err, ResourceExhaustedError):
+                # reclaim-before-degrade (resilience/pressure.py): a
+                # RESOURCE_EXHAUSTED mid-execute first reclaims cold bytes
+                # (result cache -> stems -> idle model params) and retries
+                # the SAME rung once — a reclaimable OOM must not charge
+                # the breaker or degrade the query.  Nothing reclaimable
+                # (freed == 0) steps down exactly as before.
+                reclaim_tried = True
+                from .pressure import reclaim_for_oom
 
-        ticket = current_ticket()
-        flight.record("ladder.degrade",
-                      qid=ticket.qid if ticket is not None else None,
-                      rung=rung, code=err.code)
-        if executor.tracer.enabled:
-            executor.tracer.event(f"degraded: {rung} [{err.code}]")
-        if key is not None and breaker.record_failure(key):
-            metrics.inc("resilience.breaker.trip")
-            flight.record("breaker.trip", rung=rung, fingerprint=key[0],
-                          code=err.code)
-            logger.warning(
-                "breaker tripped for rung %s (plan %s): %s",
-                rung, key[0], err)
-        logger.info("rung %s degraded (%s); stepping down", rung, err.code)
-        return None
+                if reclaim_for_oom(executor.context, executor.config) > 0:
+                    metrics.inc("resilience.pressure.rung_retry")
+                    trace_event(f"pressure_retry:{rung}", code=err.code)
+                    retried = True
+                    continue
+            metrics.inc("resilience.degraded")
+            metrics.inc(f"resilience.degraded.{rung}")
+            trace_event(f"degraded:{rung}", code=err.code)
+            from ..observability import flight
+            from ..serving.runtime import current_ticket
+
+            ticket = current_ticket()
+            flight.record("ladder.degrade",
+                          qid=ticket.qid if ticket is not None else None,
+                          rung=rung, code=err.code)
+            if executor.tracer.enabled:
+                executor.tracer.event(f"degraded: {rung} [{err.code}]")
+            if key is not None and breaker.record_failure(key):
+                metrics.inc("resilience.breaker.trip")
+                flight.record("breaker.trip", rung=rung, fingerprint=key[0],
+                              code=err.code)
+                logger.warning(
+                    "breaker tripped for rung %s (plan %s): %s",
+                    rung, key[0], err)
+            logger.info("rung %s degraded (%s); stepping down", rung,
+                        err.code)
+            return None
     if out is not None:
+        if retried:
+            # the post-reclaim retry of the SAME rung answered: the OOM
+            # was reclaimable pressure, not a doomed rung
+            metrics.inc("resilience.pressure.rung_retry_ok")
         metrics.inc(f"resilience.rung.{rung}")
         from ..observability import live
 
@@ -287,8 +311,35 @@ def execute_interpreted(executor, rel):
         # degradable taxonomy errors are absorbed (CPU re-run); the rest
         # re-raises right below
         err = classify(exc)
-        if not err.degradable or not executor.config.get(
-                "resilience.ladder.cpu_fallback", True):
+        if not err.degradable:
+            raise
+        metrics = executor.context.metrics
+        if isinstance(err, ResourceExhaustedError):
+            # reclaim-before-degrade (resilience/pressure.py): before the
+            # CPU rung, free reclaimable cold bytes and retry the
+            # interpreted walk once on device — host DRAM is the LAST
+            # resort, reclaimed HBM the better first answer
+            from .pressure import reclaim_for_oom
+
+            if reclaim_for_oom(executor.context, executor.config) > 0:
+                metrics.inc("resilience.pressure.rung_retry")
+                trace_event("pressure_retry:interpreted", code=err.code)
+                executor._memo.clear()  # drop the failed walk's partials
+                try:
+                    faults.maybe_inject("exec_oom", executor.config)
+                    out = executor.execute(rel)
+                except (KeyboardInterrupt, SystemExit):
+                    raise
+                except BaseException as exc2:  # dsql: allow-broad-except —
+                    # the retried walk failed again: re-classify and fall
+                    # through to the CPU rung (or re-raise non-degradable)
+                    err = classify(exc2)
+                    if not err.degradable:
+                        raise
+                else:
+                    metrics.inc("resilience.pressure.rung_retry_ok")
+                    return out
+        if not executor.config.get("resilience.ladder.cpu_fallback", True):
             raise
         import jax
 
@@ -298,7 +349,6 @@ def execute_interpreted(executor, rel):
             raise  # no CPU backend registered: out of rungs, no step taken
         # only now is the step-down real — count it (degraded == steps
         # actually taken; a failure with no rung left must not inflate it)
-        metrics = executor.context.metrics
         metrics.inc("resilience.degraded")
         metrics.inc("resilience.degraded.interpreted")
         trace_event("degraded:interpreted", code=err.code)

@@ -73,9 +73,14 @@ def timeout_ms(config) -> Optional[float]:
 
 
 def watched_call(label: str, fn: Callable, args=(), kwargs=None, *,
-                 deadline_ms: float, hang_s: float = 0.0, metrics=None):
-    """Run ``fn(*args, **kwargs)`` on a helper thread; raise
-    `CompileTimeoutError` if it has not finished within `deadline_ms`.
+                 deadline_ms: float, hang_s: float = 0.0, metrics=None,
+                 error_cls: type = CompileTimeoutError):
+    """Run ``fn(*args, **kwargs)`` on a helper thread; raise ``error_cls``
+    (default `CompileTimeoutError`) if it has not finished within
+    `deadline_ms`.  ``error_cls`` lets other watched regions — the
+    streamed per-chunk launches (streaming/runner.py) raise
+    `StreamLaunchTimeoutError` — reuse the same abandon-and-degrade
+    pattern with their own taxonomy code.
 
     `hang_s` is the fault-injection seam (resilience/faults.py site
     ``compile_hang``): the armed duration is resolved on the CALLER thread
@@ -110,10 +115,10 @@ def watched_call(label: str, fn: Callable, args=(), kwargs=None, *,
             metrics.inc("resilience.watchdog.timeout")
             metrics.inc("resilience.watchdog.abandoned")
         logger.warning(
-            "compile for %s exceeded %s=%0.0fms; abandoning the compile "
-            "thread and degrading the rung", label, CONFIG_KEY, deadline_ms)
-        raise CompileTimeoutError(
-            f"compile for {label!r} exceeded {CONFIG_KEY}={deadline_ms:g}ms")
+            "watched call %s exceeded %0.0fms; abandoning the helper "
+            "thread and degrading the rung", label, deadline_ms)
+        raise error_cls(
+            f"watched call {label!r} exceeded its {deadline_ms:g}ms deadline")
     ok, value = box[0]
     if ok:
         return value

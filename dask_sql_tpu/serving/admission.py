@@ -31,6 +31,24 @@ from ..resilience.errors import (
 #: scheduling order — lower runs first
 CLASSES = ("interactive", "batch")
 
+RETRY_AFTER_CAP_KEY = "serving.retry_after.cap_s"
+
+
+def retry_after_cap(config=None) -> float:
+    """The ceiling every Retry-After hint is clamped to
+    (``serving.retry_after.cap_s``, default 60s): a pathological backlog
+    estimate must never tell clients to go away for an hour.  ``config``
+    defaults to the process config (thread-local overlays apply)."""
+    if config is None:
+        from ..config import config as process_config
+
+        config = process_config
+    try:
+        cap = float(config.get(RETRY_AFTER_CAP_KEY, 60.0))
+    except (TypeError, ValueError):
+        return 60.0
+    return cap if cap > 0 else 60.0
+
 
 class QueueFullError(QueryError):
     """Load shed: the class queue is at its bound; retry after a delay.
@@ -100,37 +118,78 @@ def check_estimated_bytes(estimate, config, metrics=None, plan=None,
     per-execution state — a concurrent execution of the same cached plan
     under a different budget can never null it mid-flight.  Returns None
     when the query is simply admitted.  ``shed:estimated_bytes`` is the
-    last resort: it fires only when even one chunk provably cannot fit."""
+    last resort: it fires only when even one chunk provably cannot fit.
+
+    CRITICAL-band admission (resilience/pressure.py): when the pressure
+    controller reports CRITICAL, even an under-budget plan is forced onto
+    a streamed rung where eligible — browning out beats 429ing — and shed
+    with a retryable, drain-predicted `PressureShedError` otherwise.
+    This call is also the per-query observe->decide->act step: RED-band
+    reclaim runs inside ``pressure.evaluate()`` before any verdict."""
     from ..config import parse_byte_budget
 
     budget = None if config is None else parse_byte_budget(
         config.get("serving.admission.max_estimated_bytes"))
-    if budget is None or estimate is None:
+    pressure = getattr(context, "pressure", None) if context is not None \
+        else None
+    critical = pressure is not None and pressure.evaluate() == "critical"
+    if (budget is None and not critical) or estimate is None:
         return None
     lo = int(estimate.peak_bytes.lo)
-    if lo <= budget:
+    over = budget is not None and lo > budget
+    if not over and not critical:
         return None
     from ..observability import trace_event
 
-    if plan is not None and context is not None:
+    stream_budget = budget
+    if stream_budget is None and pressure is not None:
+        stream_budget = pressure.budget_bytes()
+    if plan is not None and context is not None \
+            and stream_budget is not None:
         from ..streaming import stream_decision
 
-        routed = stream_decision(plan, estimate, context, config, budget)
+        routed = stream_decision(plan, estimate, context, config,
+                                 stream_budget)
         if routed is not None:
             _, decision = routed
             if metrics is not None:
                 metrics.inc("serving.stream.admitted")
-            trace_event("admit:streamed", bytes_lo=lo, budget=budget,
+                if critical and not over:
+                    metrics.inc("resilience.pressure.critical_streamed")
+            trace_event("admit:streamed", bytes_lo=lo,
+                        budget=stream_budget, critical=critical,
                         partitions=decision.partitions,
                         chunk_bytes_lo=decision.chunk_bytes_lo)
             return routed
-    if metrics is not None:
-        metrics.inc("serving.shed_estimated_bytes")
-    trace_event("shed:estimated_bytes", bytes_lo=lo, budget=budget)
     from ..observability import flight
     from .runtime import current_ticket
 
     ticket = current_ticket()
+    if not over:
+        # CRITICAL with no streamed rung to brown out onto: shed with a
+        # drain-predicted Retry-After so clients back off past the spike
+        from ..resilience.pressure import PressureShedError
+
+        retry = 1.0 if config is None else float(
+            config.get("serving.retry_after_s", 1.0) or 1.0)
+        runtime = getattr(context, "serving", None)
+        drain = runtime._predicted_drain_s() if runtime is not None else None
+        if drain is not None and drain > retry:
+            retry = drain
+        retry = min(retry_after_cap(config), retry)
+        if metrics is not None:
+            metrics.inc("resilience.pressure.critical_shed")
+        trace_event("shed:pressure", bytes_lo=lo, retry_after_s=retry)
+        flight.record("query.shed",
+                      qid=ticket.qid if ticket is not None else None,
+                      reason="pressure", bytes_lo=lo)
+        raise PressureShedError(
+            f"device HBM pressure is CRITICAL and the plan has no "
+            f"streamed rung; retry after {retry:.1f}s",
+            retry_after_s=retry)
+    if metrics is not None:
+        metrics.inc("serving.shed_estimated_bytes")
+    trace_event("shed:estimated_bytes", bytes_lo=lo, budget=budget)
     flight.record("query.shed",
                   qid=ticket.qid if ticket is not None else None,
                   reason="estimated_bytes", bytes_lo=lo, budget=budget)
@@ -281,7 +340,7 @@ class AdmissionController:
         avg = self._latency_sum / self._latency_n if self._latency_n else 0.0
         backlog = sum(self.waiting.values()) + sum(self.running.values())
         est = avg * backlog / self.workers if avg else self.retry_after_s
-        return min(60.0, max(self.retry_after_s, est))
+        return min(retry_after_cap(), max(self.retry_after_s, est))
 
     def snapshot(self) -> Dict[str, object]:
         with self._lock:

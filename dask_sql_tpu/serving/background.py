@@ -54,9 +54,15 @@ def _drain_at_exit() -> None:
 class BackgroundCompiler:
     """Single bounded daemon worker running compile-and-swap tasks."""
 
-    def __init__(self, metrics=None, max_pending: int = 8):
+    def __init__(self, metrics=None, max_pending: int = 8,
+                 suspended: Optional[Callable[[], bool]] = None):
         self.metrics = metrics
         self.max_pending = max(1, int(max_pending))
+        #: pressure gate (resilience/pressure.py): when this returns True
+        #: (YELLOW band or worse) submissions are deferred — the caller
+        #: falls back to the foreground path and re-submits on a later
+        #: miss, so background compiles resume once headroom recovers
+        self.suspended = suspended
         self._cv = threading.Condition()
         self._queue: "deque[Tuple[object, Callable[[], None]]]" = deque()
         self._pending: Set[object] = set()
@@ -64,15 +70,22 @@ class BackgroundCompiler:
         self._thread: Optional[threading.Thread] = None
 
     @classmethod
-    def from_config(cls, config, metrics=None) -> "BackgroundCompiler":
+    def from_config(cls, config, metrics=None,
+                    suspended=None) -> "BackgroundCompiler":
         return cls(metrics=metrics,
                    max_pending=int(config.get(
-                       "serving.bg_compile.max_pending", 8)))
+                       "serving.bg_compile.max_pending", 8)),
+                   suspended=suspended)
 
     # ------------------------------------------------------------- submit
     def submit(self, key, task: Callable[[], None]) -> bool:
-        """Enqueue ``task`` under dedup key; False = dropped (full, dup, or
-        shut down) — the caller should fall back to the foreground path."""
+        """Enqueue ``task`` under dedup key; False = dropped (full, dup,
+        shut down, or deferred under HBM pressure) — the caller should
+        fall back to the foreground path."""
+        if self.suspended is not None and self.suspended():
+            if self.metrics is not None:
+                self.metrics.inc("resilience.pressure.suspended")
+            return False
         with self._cv:
             if self._shutdown or key in self._pending:
                 return False

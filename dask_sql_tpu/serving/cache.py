@@ -138,6 +138,23 @@ class ResultCache:
                 self._mark("query.cache.evicted")
         return True
 
+    def reclaim_bytes(self, bytes_needed: Optional[int] = None) -> int:
+        """Pressure reclaim (resilience/pressure.py tier 1): evict
+        LRU-coldest entries until at least ``bytes_needed`` are freed
+        (``None`` = drain everything); returns bytes actually freed.
+        Every result here is re-computable, so under HBM pressure cold
+        cache is the cheapest memory on the device."""
+        freed = 0
+        with self._lock:
+            while self._entries and (bytes_needed is None
+                                     or freed < bytes_needed):
+                key, entry = next(iter(self._entries.items()))
+                self._drop_locked(key, entry)
+                self.stats.evictions += 1
+                freed += entry.nbytes
+                self._mark("query.cache.evicted")
+        return freed
+
     def invalidate_all(self) -> int:
         with self._lock:
             n = len(self._entries)

@@ -186,6 +186,27 @@ DOCUMENTED_METRICS = frozenset({
     "serving.reuse.incremental.folds",
     "serving.reuse.incremental.declined",
     "serving.reuse.append_rows",
+    # resilience/pressure.py — coordinated HBM pressure response: band
+    # gauge + transitions, YELLOW speculative-work suspensions, RED
+    # cross-tier reclaim, OOM reclaim-then-retry on the SAME rung,
+    # CRITICAL forced-stream/shed outcomes (docs/resilience.md
+    # "Pressure hierarchy")
+    "resilience.pressure.band",
+    "resilience.pressure.transitions",
+    "resilience.pressure.suspended",
+    "resilience.pressure.reclaims",
+    "resilience.pressure.reclaimed_bytes",
+    "resilience.pressure.rung_retry",
+    "resilience.pressure.rung_retry_ok",
+    "resilience.pressure.critical_streamed",
+    "resilience.pressure.critical_shed",
+    # resilience/chaos.py — seeded randomized fault campaigns under
+    # concurrent mixed load (bench.py --chaos, docs/resilience.md
+    # "Chaos harness")
+    "chaos.campaigns",
+    "chaos.rounds",
+    "chaos.queries",
+    "chaos.violations",
 })
 
 #: Prefixes legitimizing *dynamic* metric families (f-string names keyed by

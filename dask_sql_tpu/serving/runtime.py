@@ -237,8 +237,10 @@ class ServingRuntime:
                 # the scheduler predicts the drain from running queries'
                 # remaining predicted exec + the queued backlog — a better
                 # hint than the admission controller's latency average
+                from .admission import retry_after_cap
+
                 raise QueueFullError(e.priority_class, e.bound,
-                                     min(60.0, drain)) from None
+                                     min(retry_after_cap(), drain)) from None
             raise
         ticket.cost = cost
         flight.record("query.admit", qid=qid, cls=priority_class,

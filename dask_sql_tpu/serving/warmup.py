@@ -139,6 +139,19 @@ class WarmupManager:
         from . import runtime as _runtime
 
         for fp, sql in entries:
+            # YELLOW-band pressure gate (resilience/pressure.py): warm-up
+            # replays are speculative device work — pause BETWEEN entries
+            # while headroom is tight and resume when the band recovers
+            # (cancel still takes effect immediately)
+            paused = False
+            pressure = getattr(ctx, "pressure", None)
+            while (pressure is not None and not self._cancel.is_set()
+                    and pressure.suspend_speculative()):
+                if not paused:
+                    paused = True
+                    ctx.metrics.inc("resilience.pressure.suspended")
+                    logger.info("warm-up paused under HBM pressure")
+                self._cancel.wait(0.05)
             if self._cancel.is_set():
                 ctx.metrics.inc("serving.warmup.cancelled")
                 logger.info("warm-up cancelled after %d/%d fingerprints",

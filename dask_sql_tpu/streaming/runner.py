@@ -34,8 +34,13 @@ from typing import Callable
 
 from ..observability import detail, flight, live, trace_event
 from ..resilience import faults
-from ..resilience.errors import ResourceExhaustedError, classify
+from ..resilience.errors import (
+    ResourceExhaustedError,
+    StreamLaunchTimeoutError,
+    classify,
+)
 from ..resilience.retry import BackoffPolicy, retry_call
+from ..resilience.watchdog import watched_call
 
 logger = logging.getLogger(__name__)
 
@@ -56,6 +61,20 @@ def drive_partitions(executor, decision, launch: Callable[[int, int], None],
 
     ticket = current_ticket()
     policy = BackoffPolicy.from_config(config)
+    # per-chunk launch deadline (the compile-watchdog pattern extended to
+    # streamed launches): a wedged mid-stream launch raises a degradable
+    # StreamLaunchTimeoutError BETWEEN chunks instead of holding the
+    # ticket's byte reservation forever.  None/non-positive = off.
+    launch_timeout_ms = None
+    raw_timeout = config.get("serving.stream.launch_timeout_ms")
+    if raw_timeout is not None:
+        try:
+            launch_timeout_ms = float(raw_timeout)
+        except (TypeError, ValueError):
+            logger.warning("unparseable serving.stream.launch_timeout_ms=%r;"
+                           " launch watchdog disabled", raw_timeout)
+        if launch_timeout_ms is not None and launch_timeout_ms <= 0:
+            launch_timeout_ms = None
     total = int(decision.total_rows)
     chunk_rows = min(int(decision.chunk_rows), total)
     min_rows = min(
@@ -91,7 +110,16 @@ def drive_partitions(executor, decision, launch: Callable[[int, int], None],
 
                 def attempt():
                     faults.maybe_inject("partition", config)
-                    launch(lo, chunk_rows)
+                    if launch_timeout_ms is not None:
+                        watched_call(
+                            f"{rung}[{part_idx}]", launch, (lo, chunk_rows),
+                            deadline_ms=launch_timeout_ms,
+                            hang_s=faults.hang_duration(
+                                "compile_hang", config),
+                            metrics=metrics,
+                            error_cls=StreamLaunchTimeoutError)
+                    else:
+                        launch(lo, chunk_rows)
 
                 retry_call(attempt, policy, ticket=ticket, metrics=metrics)
         except (KeyboardInterrupt, SystemExit):

@@ -1,0 +1,461 @@
+"""Coordinated HBM pressure response (resilience/pressure.py, ISSUE 17).
+
+The acceptance surface: headroom bands classify strictly against the
+scheduler device budget (never the admission fallback); YELLOW provably
+suspends speculative work (warm-up replays, background recompiles, new
+stem materialization) and resumes on recovery; RED reclaims cross-tier in
+priority order (cold result cache -> pinned stems -> idle model params)
+verified against the ledger's per-component gauges; an in-flight
+RESOURCE_EXHAUSTED with reclaimable cold bytes retries the SAME rung once
+(zero degradations, breaker uncharged) while an unreclaimable one degrades
+exactly as before; CRITICAL forces admissions onto streamed rungs where
+eligible and sheds the rest with a capped, drain-predicted Retry-After.
+Satellites: the 60s Retry-After cap, the retryable ``d2h`` fault site, the
+per-chunk stream-launch watchdog, and CANCEL racing a mid-stream OOM.
+"""
+import time
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from dask_sql_tpu import Context
+from dask_sql_tpu import config as config_module
+from dask_sql_tpu.observability import flight
+from dask_sql_tpu.resilience import faults
+from dask_sql_tpu.serving.cache import table_nbytes
+
+N_ROWS = 40_000
+
+
+@pytest.fixture(autouse=True)
+def _fresh_state():
+    """Fault budgets, morsel-executable caches and the global config are
+    process-wide; every test starts clean and leaves nothing behind."""
+    from dask_sql_tpu.streaming import aggregate as stream_agg
+    from dask_sql_tpu.streaming import select as stream_sel
+
+    saved = dict(config_module.config._values)
+    faults.reset()
+    stream_agg.reset_cache()
+    stream_sel.reset_cache()
+    yield
+    config_module.config._values = saved
+    faults.reset()
+    stream_agg.reset_cache()
+    stream_sel.reset_cache()
+
+
+def _df(n=2000, seed=0):
+    rng = np.random.default_rng(seed)
+    return pd.DataFrame({
+        "a": rng.integers(0, 100, n).astype(np.int64),
+        "b": rng.random(n) * 100.0,
+        "k": rng.integers(0, 5, n).astype(np.int64),
+    })
+
+
+def _used_bytes(c):
+    snap = c.ledger.snapshot()
+    return (snap["reservedBytes"] + snap["resultCacheBytes"]
+            + snap["tableBytes"] + snap["modelBytes"]
+            + snap["materializedBytes"])
+
+
+def _stream_ctx(n=N_ROWS):
+    c = Context()
+    c.config.update({"serving.cache.enabled": False})
+    rng = np.random.RandomState(7)
+    df = pd.DataFrame({
+        "k": rng.randint(0, 5, n).astype(np.int64),
+        "v": rng.randint(0, 1000, n).astype(np.int64),
+        "f": rng.rand(n),
+    })
+    c.create_table("t", df)
+    return c, df
+
+
+def _stream_budget(c, frac=3):
+    return table_nbytes(c.schema["root"].tables["t"].table) // frac
+
+
+AGG_Q = ("SELECT k, SUM(v) AS s, COUNT(*) AS n, AVG(v) AS a, "
+         "MIN(v) AS mn, MAX(f) AS mx FROM t GROUP BY k ORDER BY k")
+
+
+# ------------------------------------------------------------------ bands
+def test_bands_classify_against_device_budget():
+    c = Context()
+    c.create_table("t", _df())
+    # no device budget configured: banding is off, everything is GREEN
+    assert c.pressure.band() == "green"
+    used = _used_bytes(c)
+    assert used > 0
+    flight.RECORDER.clear()
+    # headroom fraction 0.15 -> YELLOW (<= 0.25, > 0.10)
+    c.config.update({"serving.scheduler.device_budget_bytes":
+                     int(used / 0.85)})
+    assert c.pressure.band() == "yellow"
+    # 0.087 -> RED (<= 0.10, > 0.05)
+    c.config.update({"serving.scheduler.device_budget_bytes":
+                     int(used / 0.92)})
+    assert c.pressure.band() == "red"
+    # negative headroom -> CRITICAL
+    c.config.update({"serving.scheduler.device_budget_bytes": used // 2})
+    assert c.pressure.band() == "critical"
+    assert c.metrics.snapshot()["gauges"]["resilience.pressure.band"] == 3
+    # recovery -> GREEN again
+    c.config.update({"serving.scheduler.device_budget_bytes": used * 10})
+    assert c.pressure.band() == "green"
+    assert c.metrics.snapshot()["gauges"]["resilience.pressure.band"] == 0
+    assert c.metrics.counter("resilience.pressure.transitions") == 4
+    bands = [e["band"] for e in flight.RECORDER.events(name="pressure.band")]
+    assert bands == ["yellow", "red", "critical", "green"]
+    snap = c.pressure.snapshot()
+    assert snap["band"] == "green" and snap["enabled"]
+    assert snap["budgetBytes"] == used * 10
+
+
+def test_band_ignores_admission_fallback_budget():
+    """The admission byte gate bounds ONE query's estimate, not the
+    device: banding on it would mark every deployment whose tables exceed
+    the per-query gate CRITICAL.  Only the scheduler device budget bands."""
+    from dask_sql_tpu.serving.admission import EstimatedBytesExceededError
+
+    c = Context()
+    c.create_table("t", _df())
+    c.config.update({"serving.admission.max_estimated_bytes": 10})
+    assert c.pressure.budget_bytes() is None
+    assert c.pressure.band() == "green"
+    # the per-query gate still sheds with its own (non-pressure) proof
+    with pytest.raises(EstimatedBytesExceededError):
+        c.sql("SELECT SUM(a) AS s FROM t", return_futures=False)
+    assert c.metrics.counter("resilience.pressure.critical_shed") == 0
+
+
+def test_pressure_disabled_is_inert():
+    c = Context()
+    c.create_table("t", _df())
+    c.config.update({"resilience.pressure.enabled": False,
+                     "serving.scheduler.device_budget_bytes": 1})
+    assert c.pressure.band() == "green"
+    assert c.pressure.reclaim(None, reason="oom") == 0
+    out = c.sql("SELECT SUM(a) AS s FROM t", return_futures=False)
+    assert len(out) == 1
+
+
+# -------------------------------------------- YELLOW suspends speculation
+def test_yellow_suspends_then_resumes_materialization():
+    c = Context()
+    c.create_table("t", _df(4000, seed=1))
+    # the result cache stays ON (stem observation rides the cache's
+    # family/key machinery); the highly selective filter keeps cached
+    # results tiny so the band cannot drift out of YELLOW mid-test
+    c.config.update({"serving.materialize.min_bytes": 1})
+    used = _used_bytes(c)
+    c.config.update({"serving.scheduler.device_budget_bytes":
+                     int(used / 0.82)})
+    assert c.pressure.band() == "yellow"
+    # two siblings over one scan->filter stem would normally pin it
+    c.sql("SELECT a FROM t WHERE a > 96").compute()
+    c.sql("SELECT b FROM t WHERE a > 96").compute()
+    assert c.metrics.counter("serving.materialize.stored") == 0
+    assert c.metrics.counter("resilience.pressure.suspended") >= 1
+    # recovery: the earned hit count was retained, the next sibling pins
+    c.config.update({"serving.scheduler.device_budget_bytes": used * 20})
+    assert c.pressure.band() == "green"
+    c.sql("SELECT k FROM t WHERE a > 96").compute()
+    assert c.metrics.counter("serving.materialize.stored") == 1
+
+
+def test_yellow_defers_background_recompiles():
+    from dask_sql_tpu.serving.background import BackgroundCompiler
+
+    c = Context()
+    bg = BackgroundCompiler(metrics=c.metrics, suspended=lambda: True)
+    assert bg.submit("family", lambda: None) is False
+    assert c.metrics.counter("resilience.pressure.suspended") == 1
+    assert c.metrics.counter("serving.bg_compile.submitted") == 0
+    ok = BackgroundCompiler(metrics=c.metrics, suspended=lambda: False)
+    try:
+        assert ok.submit("family", lambda: None) is True
+        assert ok.wait_idle(10.0)
+    finally:
+        ok.cancel()
+
+
+def test_yellow_pauses_warmup_and_resumes():
+    c = Context()
+    c.create_table("t", _df(500, seed=2))
+    c.sql("SELECT SUM(a) AS s FROM t", return_futures=False)  # profile it
+    used = _used_bytes(c)
+    # the warm thread reads the PROCESS config: set the tight budget
+    # globally before starting the pass
+    config_module.config.update({
+        "serving.warmup.enabled": True,
+        "serving.warmup.top_n": 4,
+        "serving.scheduler.device_budget_bytes": int(used / 0.85)})
+    mgr = c.maybe_start_warmup()
+    assert mgr is not None
+    try:
+        deadline = time.monotonic() + 5.0
+        while (time.monotonic() < deadline and
+               c.metrics.counter("resilience.pressure.suspended") == 0):
+            time.sleep(0.01)
+        assert c.metrics.counter("resilience.pressure.suspended") >= 1
+        assert mgr.warmed == 0 and not mgr.ready  # provably paused
+        # recovery: the pass resumes and finishes
+        config_module.config.update(
+            {"serving.scheduler.device_budget_bytes": None})
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline and not mgr.ready:
+            time.sleep(0.02)
+        assert mgr.ready
+        assert mgr.warmed >= 1
+    finally:
+        mgr.cancel()
+        mgr.join(10.0)
+
+
+# ------------------------------------------------------- RED-band reclaim
+def test_red_reclaim_walks_tiers_in_priority_order():
+    c = Context()
+    c.create_table("t", _df(4000, seed=3))
+    c.config.update({"serving.materialize.min_bytes": 1})
+    c.sql("SELECT a FROM t WHERE a > 3").compute()
+    c.sql("SELECT b FROM t WHERE a > 3").compute()
+    snap = c.ledger.snapshot()
+    assert snap["resultCacheBytes"] > 0 and snap["materializedBytes"] > 0
+    flight.RECORDER.clear()
+    # a small target is satisfied ENTIRELY from tier 1 (cold cache);
+    # pinned stems are untouched
+    freed = c.pressure.reclaim(1, reason="band")
+    assert freed > 0
+    after = c.ledger.snapshot()
+    assert after["materializedBytes"] == snap["materializedBytes"]
+    assert after["resultCacheBytes"] < snap["resultCacheBytes"]
+    ev = flight.RECORDER.events(name="pressure.reclaim")[-1]
+    assert ev["cache_bytes"] == freed
+    assert ev["stem_bytes"] == 0 and ev["model_bytes"] == 0
+    # an OOM reclaim is unbounded: every reclaimable tier drains
+    c.pressure.reclaim(None, reason="oom")
+    drained = c.ledger.snapshot()
+    assert drained["resultCacheBytes"] == 0
+    assert drained["materializedBytes"] == 0
+    ev2 = flight.RECORDER.events(name="pressure.reclaim")[-1]
+    assert ev2["reason"] == "oom" and ev2["stem_bytes"] > 0
+    assert c.metrics.counter("resilience.pressure.reclaims") == 2
+    assert c.metrics.counter("resilience.pressure.reclaimed_bytes") >= freed
+
+
+# ------------------------------------------- reclaim-before-degrade (OOM)
+@pytest.mark.faults
+def test_reclaimable_oom_retries_same_rung_without_degrading():
+    """A forced device OOM with reclaimable cold cache serves on the SAME
+    rung after one reclaim: zero degradations, breaker never charged."""
+    clean_ctx = Context()
+    clean_ctx.create_table("t", _df(500, seed=4))
+    clean = clean_ctx.sql("SELECT SUM(b) AS s FROM t", return_futures=False)
+    c = Context()
+    c.create_table("t", _df(500, seed=4))
+    c.sql("SELECT SUM(a) AS s FROM t", return_futures=False)  # warm cache
+    assert c.ledger.snapshot()["resultCacheBytes"] > 0
+    flight.RECORDER.clear()
+    with config_module.set({"resilience.inject": "oom:once"}):
+        hurt = c.sql("SELECT SUM(b) AS s FROM t", return_futures=False)
+    pd.testing.assert_frame_equal(hurt, clean)
+    assert c.metrics.counter("resilience.degraded") == 0
+    assert c.metrics.counter("resilience.pressure.rung_retry") == 1
+    assert c.metrics.counter("resilience.pressure.rung_retry_ok") == 1
+    assert c.breaker.snapshot()["keys"] == 0  # never charged
+    ev = flight.RECORDER.events(name="pressure.reclaim")[-1]
+    assert ev["reason"] == "oom" and ev["freed"] > 0
+
+
+@pytest.mark.faults
+def test_unreclaimable_oom_degrades_exactly_as_before():
+    c = Context()
+    c.create_table("t", _df(500, seed=5))
+    with config_module.set({"resilience.inject": "oom:once",
+                            "serving.cache.enabled": False}):
+        out = c.sql("SELECT SUM(a) AS s FROM t", return_futures=False)
+    assert int(out["s"][0]) == int(_df(500, seed=5)["a"].sum())
+    assert c.metrics.counter("resilience.degraded") == 1
+    assert c.metrics.counter("resilience.pressure.rung_retry") == 0
+    assert c.metrics.counter("resilience.pressure.rung_retry_ok") == 0
+
+
+# ------------------------------------------------- Retry-After cap (60s)
+def test_retry_after_cap_config_and_default():
+    from dask_sql_tpu.serving.admission import retry_after_cap
+
+    assert retry_after_cap() == 60.0
+    with config_module.set({"serving.retry_after.cap_s": 5.0}):
+        assert retry_after_cap() == 5.0
+    with config_module.set({"serving.retry_after.cap_s": "bogus"}):
+        assert retry_after_cap() == 60.0
+    with config_module.set({"serving.retry_after.cap_s": -3}):
+        assert retry_after_cap() == 60.0
+
+
+def test_queue_full_retry_after_is_capped():
+    from dask_sql_tpu.serving.admission import (
+        AdmissionController,
+        QueueFullError,
+    )
+
+    ac = AdmissionController({"interactive": 1, "batch": 1}, workers=1,
+                             retry_after_s=100.0)
+    with config_module.set({"serving.retry_after.cap_s": 2.0}):
+        ac.admit("q1")
+        with pytest.raises(QueueFullError) as ei:
+            ac.admit("q2")
+    assert ei.value.retry_after_s == 2.0
+
+
+# --------------------------------------------------- d2h fault satellite
+@pytest.mark.faults
+def test_d2h_fault_retried_at_worker_never_charges_breaker():
+    """The packed device-to-host transfer is retryable-transient: the
+    serving worker's backoff absorbs a dropped transfer; the rung breaker
+    is never charged and the ladder never steps down.  (The CPU backend's
+    result path keeps columns host-resident, so the transfer is driven
+    directly with device buffers — the same code the accelerator path
+    calls from ``Table.to_pandas``.)"""
+    import jax.numpy as jnp
+
+    from dask_sql_tpu.columnar.pack import packed_host_arrays
+    from dask_sql_tpu.resilience.errors import TransientExecutionError
+    from dask_sql_tpu.resilience.faults import SITE_ERRORS
+    from dask_sql_tpu.resilience.retry import BackoffPolicy
+    from dask_sql_tpu.serving import ServingRuntime
+
+    err = SITE_ERRORS["d2h"]("x")
+    assert err.retryable and not err.degradable
+    assert isinstance(err, TransientExecutionError)
+    c = Context()
+    config_module.config.update({"resilience.inject": "d2h:once"})
+    bufs = [jnp.asarray([1.0, 2.0, 3.0]), jnp.asarray([4.0, 5.0, 6.0])]
+    rt = ServingRuntime(workers=1, retry_policy=BackoffPolicy(
+        max_attempts=3, base_s=0.01, jitter=0.0))
+    try:
+        _, fut, _ = rt.submit(lambda t: packed_host_arrays(bufs),
+                              deadline_s=30.0)
+        host = fut.result(30)
+        assert [h.tolist() for h in host] == [[1.0, 2.0, 3.0],
+                                              [4.0, 5.0, 6.0]]
+        assert rt.metrics.counter("resilience.retry.recovered") == 1
+        assert c.metrics.counter("resilience.degraded") == 0
+        assert c.breaker.snapshot()["keys"] == 0
+    finally:
+        rt.shutdown(wait=True)
+
+
+# --------------------------------- streamed per-chunk launch watchdog
+@pytest.mark.faults
+@pytest.mark.streaming
+def test_wedged_midstream_launch_degrades_between_chunks():
+    """compile-watchdog pattern extended to streamed launches: a launch
+    wedged mid-stream (``compile_hang`` armed on chunk 2) raises a
+    degradable deadline error between chunks; the ladder steps the rung
+    down and the query still answers byte-identically."""
+    c, _ = _stream_ctx()
+    clean = c.sql(AGG_Q, return_futures=False)
+    c2, _ = _stream_ctx()
+    opts = {"serving.admission.max_estimated_bytes": _stream_budget(c2),
+            "serving.stream.min_chunk_rows": 512}
+    # warm the morsel executable so chunk launches are compile-free and
+    # the injected hang is the ONLY thing that can trip the deadline
+    warm = c2.sql(AGG_Q, return_futures=False, config_options=dict(opts))
+    pd.testing.assert_frame_equal(warm, clean)
+    assert c2.metrics.counter("resilience.rung.streamed_aggregate") == 1
+    hurt = c2.sql(AGG_Q, return_futures=False, config_options={
+        **opts,
+        "serving.stream.launch_timeout_ms": 100.0,
+        "resilience.inject": "compile_hang:at2",
+        "resilience.inject.hang_s": 0.5})
+    pd.testing.assert_frame_equal(hurt, clean)
+    assert c2.metrics.counter("resilience.watchdog.timeout") >= 1
+    assert c2.metrics.counter(
+        "resilience.degraded.streamed_aggregate") == 1
+    # the wedged run never completed the streamed rung
+    assert c2.metrics.counter("resilience.rung.streamed_aggregate") == 1
+
+
+# ------------------------------------ CANCEL racing a mid-stream OOM
+@pytest.mark.faults
+@pytest.mark.streaming
+def test_cancel_racing_midstream_oom_releases_reservation_once():
+    """CANCEL QUERY arriving while a streamed query is absorbing an OOM
+    repartition: the cancellation lands at the next between-chunk
+    checkpoint and the scheduler reservation is released exactly once —
+    the ledger returns to idle."""
+    from dask_sql_tpu.serving import ServingRuntime
+    from dask_sql_tpu.serving.admission import QueryCancelledError
+    from dask_sql_tpu.serving.scheduler import QueryCost
+
+    c, _ = _stream_ctx()
+    budget = _stream_budget(c)
+    # the worker thread reads the PROCESS config; compile_hang:always +
+    # a generous launch deadline slow every chunk (~100ms) WITHOUT
+    # tripping the watchdog, so the cancel has a wide window to land
+    config_module.config.update({
+        "serving.admission.max_estimated_bytes": budget,
+        "serving.stream.min_chunk_rows": 512,
+        "serving.stream.launch_timeout_ms": 10_000.0,
+        "resilience.inject": "partition:at2,compile_hang:always",
+        "resilience.inject.hang_s": 0.1,
+        "serving.cache.enabled": False})
+    rt = ServingRuntime(workers=1, metrics=c.metrics,
+                        scheduler_budget_bytes=budget * 10)
+    c.serving = rt
+    try:
+        _, fut, ticket = rt.submit(
+            lambda t: c.sql(AGG_Q, return_futures=False),
+            cost=QueryCost(bytes_lo=4096))
+        deadline = time.monotonic() + 30.0
+        while (time.monotonic() < deadline and
+               c.metrics.counter("serving.stream.repartitions") == 0):
+            time.sleep(0.005)
+        assert c.metrics.counter("serving.stream.repartitions") >= 1
+        ticket.cancel()
+        with pytest.raises(QueryCancelledError):
+            fut.result(30)
+    finally:
+        rt.shutdown(wait=True)
+        c.serving = None
+    snap = c.ledger.snapshot()
+    assert snap["reservedBytes"] == 0
+    assert snap["inflightMeasuredBytes"] == 0
+
+
+# --------------------------------------------------- CRITICAL admission
+def test_critical_forces_new_admissions_onto_streamed_rung():
+    c, _ = _stream_ctx()
+    clean = c.sql(AGG_Q, return_futures=False)
+    c2, _ = _stream_ctx()
+    # device budget far below the resident table: CRITICAL at admission,
+    # but the plan has a streamed rung sized to the device budget
+    got = c2.sql(AGG_Q, return_futures=False, config_options={
+        "serving.scheduler.device_budget_bytes": _stream_budget(c2)})
+    pd.testing.assert_frame_equal(got, clean)
+    assert c2.metrics.counter("resilience.pressure.critical_streamed") == 1
+    assert c2.metrics.counter("serving.stream.admitted") == 1
+    assert c2.metrics.counter("serving.stream.partitions") > 1
+    assert c2.metrics.counter("resilience.pressure.critical_shed") == 0
+
+
+def test_critical_sheds_unstreamable_with_capped_retry_after():
+    from dask_sql_tpu.resilience.pressure import PressureShedError
+
+    c, _ = _stream_ctx()
+    with pytest.raises(PressureShedError) as ei:
+        c.sql(AGG_Q, return_futures=False, config_options={
+            "serving.scheduler.device_budget_bytes": _stream_budget(c),
+            "serving.stream.enabled": False})
+    assert ei.value.retryable
+    assert ei.value.payload()["code"] == "PRESSURE_SHED"
+    assert 0.0 < ei.value.retry_after_s <= 60.0
+    assert c.metrics.counter("resilience.pressure.critical_shed") == 1
+    shed = flight.RECORDER.events(name="query.shed")[-1]
+    assert shed["reason"] == "pressure"
