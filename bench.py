@@ -1473,9 +1473,131 @@ def run_chaos_smoke():
         raise SystemExit(1)
 
 
+def run_fleet_smoke():
+    """`bench.py --fleet`: fault-tolerant replica fleet smoke (ISSUE 18).
+
+    Part 1 — failover + warm-standby promotion: a router over 3
+    in-process replicas plus a warm standby serves a concurrent workload;
+    one replica is killed (kill -9 semantics) mid-workload.  Asserts:
+
+    - every routed query completes despite the kill (failover re-dispatch
+      to survivors, dedupe through the result-cache idempotency key);
+    - the standby is promoted into the serving set;
+    - after the surviving original replicas drain, the PROMOTED standby
+      serves its first routed query of the hot family with ZERO
+      foreground ``compile:<rung>`` spans (the replication transport —
+      checkpoint snapshot + profile store + shared compile cache — paid
+      every compile off the serving path);
+    - the promoted replica's result matches the pre-kill result.
+
+    Part 2 — replica-kill chaos: `run_fleet_campaign` over 5 seeds
+    (3 replicas, mixed concurrent workload, one kill per round): zero
+    lost queries, INSERT INTO applied exactly once per survivor under
+    failover (epoch fencing), ledgers idle after drain.
+
+    Exit 1 on any violation.
+    """
+    import json as _json
+    from concurrent.futures import ThreadPoolExecutor
+
+    _ensure_backend()
+    import jax
+
+    from dask_sql_tpu import Context
+    from dask_sql_tpu.fleet import READY, build_fleet
+    from dask_sql_tpu.resilience.chaos import run_fleet_campaign
+
+    df = gen_lineitem(50_000, seed=0)
+
+    def factory():
+        c = Context()
+        c.create_table("lineitem", df)
+        return c
+
+    router, members, replicator = build_fleet(factory, replicas=3,
+                                              standby=True)
+    baseline = router.execute(QUERY, qid="fleet-cold")
+    router.execute(QUERY, qid="fleet-hot")  # the family is clearly hot
+    replicator.sync()  # standby: snapshot + profiles + warm-up, off-path
+
+    with ThreadPoolExecutor(max_workers=4,
+                            thread_name_prefix="fleet-smoke") as pool:
+        futs = [pool.submit(router.execute, QUERY, f"fleet-w{i}")
+                for i in range(8)]
+        time.sleep(0.05)
+        router.kill(members[1].name)  # kill -9 one replica mid-workload
+        results = [f.result(300.0) for f in futs]
+    all_complete = all(r is not None for r in results)
+
+    promoted = router.find("standby")
+    was_promoted = bool(promoted is not None and promoted.state == READY
+                        and promoted in router.replicas)
+    # drain the surviving originals so the next routed query can only
+    # land on the promoted standby — ITS first serve of this family
+    router.drain(members[0].name)
+    router.drain(members[2].name)
+    out = router.execute(QUERY, qid="fleet-promoted")
+    tr = promoted.context.last_trace if promoted is not None else None
+    fg_compiles = [] if tr is None else \
+        [s.name for s in tr.spans if s.name.startswith("compile:")]
+    match = out is not None and len(out) == len(baseline) and np.allclose(
+        out["sum_qty"].to_numpy(np.float64),
+        baseline["sum_qty"].to_numpy(np.float64), rtol=1e-9)
+    router.shutdown()
+    part1_ok = bool(all_complete and was_promoted and not fg_compiles
+                    and match)
+
+    seeds = [1, 2, 3, 4, 5]
+    per_seed = []
+    total_violations = 0
+    for seed in seeds:
+        t0 = time.perf_counter()
+        report = run_fleet_campaign(seed=seed, queries=21, rounds=3,
+                                    replicas=3, clients=4)
+        elapsed = time.perf_counter() - t0
+        print(report.summary(), flush=True)
+        for v in report.violations:
+            print(f"  VIOLATION: {v}", flush=True)
+        total_violations += len(report.violations)
+        per_seed.append({
+            "seed": seed,
+            "submitted": report.submitted,
+            "completed": report.completed,
+            "retried": report.retried,
+            "failed": report.failed,
+            "shed": report.shed,
+            "kills": report.kills,
+            "promoted": report.promoted,
+            "inserts": report.inserts,
+            "violations": len(report.violations),
+            "seconds": round(elapsed, 2),
+            "ok": report.ok,
+        })
+
+    ok = bool(part1_ok and total_violations == 0)
+    print(_json.dumps({
+        "metric": "fleet_smoke",
+        "backend": jax.default_backend(),
+        "ok": ok,
+        "workload_completed": int(sum(1 for r in results if r is not None)),
+        "workload_submitted": len(results),
+        "standby_promoted": was_promoted,
+        "promoted_foreground_compile_spans": fg_compiles,
+        "results_match": bool(match),
+        "chaos_seeds": len(seeds),
+        "chaos_violations": int(total_violations),
+        "campaigns": per_seed,
+    }), flush=True)
+    if not ok:
+        raise SystemExit(1)
+
+
 def main():
     import sys
 
+    if "--fleet" in sys.argv:
+        run_fleet_smoke()
+        return
     if "--chaos" in sys.argv:
         run_chaos_smoke()
         return

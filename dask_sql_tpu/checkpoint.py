@@ -178,6 +178,18 @@ def save_state(context: "Context", location: str) -> dict:
                 "carry (%s) — re-issue their DDL after load_state",
                 schema_name, ", ".join(sorted(dropped)))
 
+    epochs: dict = {}
+    for (s, t), e in getattr(context, "_table_epochs", {}).items():
+        if e:  # raw names, nested (a "." can legally appear inside either)
+            epochs.setdefault(s, {})[t] = e
+    if epochs:
+        # table delta epochs ride the manifest so a standby restored from
+        # this snapshot knows exactly which appends it has seen: the fleet
+        # router fences writes on these (fleet/replica.py apply_write) and
+        # replays the tail at promotion — a snapshot taken BEFORE an append
+        # can therefore never surface a pre-append cached result
+        manifest["table_epochs"] = epochs
+
     profiles = getattr(context, "profiles", None)
     if profiles is not None and len(profiles):
         # per-fingerprint query profiles (observability/profiles.py) ride
@@ -251,6 +263,15 @@ def load_state(context: "Context", location: str) -> dict:
         for tname, rows in entry.get("statistics", {}).items():
             context.schema[schema_name].statistics[tname] = Statistics(rows)
     context.schema_name = manifest.get("current_schema", context.schema_name)
+    for schema_name, tables in manifest.get("table_epochs", {}).items():
+        for tname, epoch in tables.items():
+            key = (schema_name, tname)
+            # max(): a context that already advanced past the snapshot
+            # (live appends during restore) must not rewind — the fleet
+            # write fence (fleet/replica.py) relies on epochs being
+            # monotone to detect duplicates vs missed writes
+            context._table_epochs[key] = max(
+                context._table_epochs.get(key, 0), int(epoch))
     profiles_rel = manifest.get("profiles")
     if profiles_rel and getattr(context, "profiles", None) is not None:
         path = os.path.join(snap_dir, profiles_rel)

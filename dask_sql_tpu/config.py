@@ -217,6 +217,20 @@ DEFAULTS: Dict[str, Any] = {
     # (work-conserving — quotas reorder, they never fail queries).
     "serving.tenant.rate_qps": None,
     "serving.tenant.burst": 4.0,  # token-bucket capacity (burst allowance) per tenant
+    # Graceful drain (ServingRuntime.shutdown(wait=True), docs/fleet.md
+    # "Drain protocol"): the drain is BOUNDED — in-flight queries that
+    # have not finished within this many seconds have their tickets
+    # cancelled and their futures failed with a retryable ShutdownError
+    # (another replica or a restart can take them) instead of the drain
+    # hanging forever on a stuck query.
+    "serving.shutdown.drain_timeout_s": 30.0,
+    # Fleet tier (fleet/, docs/fleet.md): a Router fronting N replicas
+    # with health-gated cost-aware routing, mid-query failover and
+    # warm-standby promotion.
+    "fleet.failover.max_attempts": 3,  # total dispatch attempts per routed query across replicas
+    "fleet.failover.base_s": 0.02,  # first failover backoff delay, seconds (doubles per attempt)
+    "fleet.result_timeout_s": 60.0,  # per-dispatch wait before the router declares the replica failed
+    "fleet.standby.auto_promote": True,  # promote a ready warm standby when a replica dies
     "serving.cache.enabled": True,  # result cache for repeated identical queries
     "serving.cache.max_bytes": 256 << 20,  # total resident bytes before LRU eviction
     "serving.cache.max_entry_bytes": 64 << 20,  # per-entry cap (huge results bypass the cache)

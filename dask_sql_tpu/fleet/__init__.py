@@ -1,0 +1,50 @@
+"""Fault-tolerant replica fleet: router, replicas, warm-standby promotion.
+
+Layer map (docs/fleet.md has the full protocol write-up):
+
+- `fleet.replica.Replica`   — one Context + ServingRuntime with a
+  standby/ready/draining/dead lifecycle and epoch-fenced write apply;
+- `fleet.router.Router`     — health-gated cost-aware routing, mid-query
+  failover with idempotent re-dispatch, write fan-out, standby
+  promotion, graceful drain;
+- `fleet.replication.StandbyReplicator` — checkpoint snapshots + the
+  persistent compile cache + the profile store as the replication
+  transport (the PR 6 cold-start machinery, reused).
+
+`build_fleet` wires the common test/chaos topology: N replicas over
+identically-built contexts plus an optional warm standby.
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Tuple
+
+from .replica import DEAD, DRAINING, READY, STANDBY, Replica
+from .replication import StandbyReplicator
+from .router import Router
+
+__all__ = [
+    "Replica", "Router", "StandbyReplicator", "build_fleet",
+    "STANDBY", "READY", "DRAINING", "DEAD",
+]
+
+
+def build_fleet(context_factory: Callable[[], object], replicas: int = 3,
+                standby: bool = False,
+                sync_dir: Optional[str] = None,
+                ) -> Tuple[Router, List[Replica],
+                           Optional[StandbyReplicator]]:
+    """Build an in-process fleet: ``replicas`` serving members (named
+    ``replica-0..N-1``) over contexts minted by ``context_factory``, plus
+    an optional warm standby wired to a `StandbyReplicator` fed by
+    ``replica-0``.  Returns ``(router, members, replicator)``."""
+    members = [Replica(f"replica-{i}", context_factory())
+               for i in range(max(1, int(replicas)))]
+    spare = Replica("standby", context_factory(), standby=True) \
+        if standby else None
+    router = Router(members, standby=spare)
+    replicator = None
+    if spare is not None:
+        replicator = StandbyReplicator(members[0], spare,
+                                       directory=sync_dir,
+                                       metrics=router.metrics)
+    return router, members, replicator

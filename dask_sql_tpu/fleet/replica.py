@@ -1,0 +1,240 @@
+"""One fleet replica: a Context + ServingRuntime with a lifecycle.
+
+On real deployments a replica is a separate server process (server/app.py
+behind the `/v1/*` endpoints); for CPU tests and the chaos harness a
+replica is this in-process runtime wrapping its own Context — same
+catalog, same admission/scheduling/pressure machinery, same health
+surface — so the router (fleet/router.py) exercises the exact decision
+loop it would run against remote processes, minus the HTTP hop.
+
+Lifecycle states:
+
+- ``standby``  warm spare: ingests checkpoint snapshots + the persistent
+               compile cache + profile store (fleet/replication.py) but
+               takes no routed traffic until promoted;
+- ``ready``    routable (health-gated: the warm-up pass must also be
+               ready before the router picks it);
+- ``draining`` SIGTERM / ``POST /v1/drain`` landed: health reports 503,
+               in-flight queries finish (bounded by
+               ``serving.shutdown.drain_timeout_s``), queued work is
+               handed back to the router as retryable `ShutdownError`;
+- ``dead``     killed (kill -9 semantics): nothing resolves; in-flight
+               routed futures fail IMMEDIATELY with retryable
+               `ReplicaFailedError` so the router re-dispatches instead
+               of waiting out a timeout.
+
+Write fencing: fleet-managed tables mutate ONLY through the router's
+write fan-out, which stamps every write with the table delta epoch it
+expects to find (`apply_write`).  A retried/replayed write whose epoch
+already advanced is a detected duplicate and no-ops — the exactly-once
+INSERT INTO guarantee under failover.
+"""
+from __future__ import annotations
+
+import itertools
+import logging
+import threading
+from concurrent.futures import TimeoutError as FutureTimeoutError
+from typing import Any, Dict, Optional, Tuple
+
+from ..resilience.errors import ReplicaFailedError
+
+logger = logging.getLogger(__name__)
+
+#: replica lifecycle states (surfaced by health() and SHOW REPLICAS)
+STANDBY, READY, DRAINING, DEAD = "standby", "ready", "draining", "dead"
+
+
+class Replica:
+    """An in-process replica runtime around one Context."""
+
+    def __init__(self, name: str, context, standby: bool = False):
+        from ..serving.runtime import ServingRuntime
+
+        self.name = name
+        self.context = context
+        self.runtime = ServingRuntime.from_config(
+            context.config, metrics=context.metrics)
+        context.serving = self.runtime
+        self._lock = threading.Lock()
+        self._state = STANDBY if standby else READY
+        #: serializes write application so fence-check + apply is atomic
+        #: per replica (concurrent routed reads are unaffected)
+        self._write_lock = threading.Lock()
+        #: per-replica dispatch suffix: the router re-dispatches the SAME
+        #: client qid across replicas/attempts, but each runtime submit
+        #: needs its own scheduler identity
+        self._attempts = itertools.count()
+
+    # ---------------------------------------------------------------- state
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    @property
+    def routable(self) -> bool:
+        """Health-gated routing eligibility: READY *and* past warm-up."""
+        if self.state != READY:
+            return False
+        warm = getattr(self.context, "warmup", None)
+        return warm is None or warm.ready
+
+    def health(self) -> Dict[str, Any]:
+        """The replica's one-probe health payload — warming status plus
+        the pressure band and ledger headroom (the same shape the HTTP
+        ``/v1/health`` endpoint serves), so the router's routing loop and
+        a load balancer read identical facts."""
+        state = self.state
+        warm = getattr(self.context, "warmup", None)
+        if warm is None:
+            out: Dict[str, Any] = {"status": "ready", "warmed": 0,
+                                   "total": 0}
+        else:
+            out = dict(warm.status())
+        if state != READY:
+            out["status"] = state
+        try:
+            psnap = self.context.pressure.snapshot()
+            out["band"] = psnap["band"]
+            out["headroomBytes"] = psnap["headroomBytes"]
+        except Exception:  # dsql: allow-broad-except — advisory readout
+            logger.debug("replica %s pressure read failed", self.name,
+                         exc_info=True)
+        return out
+
+    def headroom_bytes(self) -> Optional[int]:
+        """Ledger headroom (None when no device budget is configured —
+        the router then treats every query as fitting)."""
+        try:
+            return self.context.ledger.snapshot().get("headroomBytes")
+        except Exception:  # dsql: allow-broad-except — advisory readout
+            return None
+
+    def predicted_drain_s(self) -> Optional[float]:
+        """The packing scheduler's backlog drain prediction — the router's
+        tiebreak between replicas with comparable headroom."""
+        try:
+            return self.runtime._predicted_drain_s()
+        except Exception:  # dsql: allow-broad-except — advisory readout
+            return None
+
+    # ---------------------------------------------------------------- reads
+    def run(self, sql: str, qid: str, priority_class: str = "interactive",
+            config_options: Optional[Dict[str, Any]] = None,
+            cost=None, timeout: Optional[float] = None):
+        """Execute one routed query through this replica's serving
+        runtime; blocks for the result.  Raises `ReplicaFailedError` when
+        the replica is not READY or the dispatch times out (the router
+        re-dispatches), `QueueFullError` when this replica's admission
+        queue is at bound (the router spills to a peer)."""
+        with self._lock:
+            if self._state != READY:
+                raise ReplicaFailedError(
+                    f"replica {self.name} is {self._state}", query_id=qid)
+        if timeout is None:
+            timeout = float(self.context.config.get(
+                "fleet.result_timeout_s", 60.0) or 60.0)
+        opts = dict(config_options or {})
+
+        def job(ticket):
+            return self.context.sql(sql, config_options=opts).compute()
+
+        _, fut, ticket = self.runtime.submit(
+            job, qid=f"{qid}@{self.name}.{next(self._attempts)}",
+            priority_class=priority_class, cost=cost)
+        try:
+            return fut.result(timeout)
+        except FutureTimeoutError:
+            # the replica may be wedged: cancel cooperatively and hand the
+            # query back to the router as a replica failure
+            ticket.cancel()
+            raise ReplicaFailedError(
+                f"replica {self.name} did not answer {qid} within "
+                f"{timeout:g}s", query_id=qid) from None
+
+    # --------------------------------------------------------------- writes
+    def apply_write(self, sql: str, table_key: Tuple[str, str],
+                    expected_epoch: int, qid: Optional[str] = None):
+        """Apply one fanned-out write iff the table's delta epoch equals
+        ``expected_epoch`` (the router's global write sequence for this
+        table).  Returns the write's result frame, or None when the fence
+        detects the write already applied here (a failover retry /
+        promotion replay racing the original) — the exactly-once no-op.
+        Raises `ReplicaFailedError` when the replica is not live or its
+        epoch is BEHIND the fence (missed writes: the router must replay
+        them in order first)."""
+        state = self.state
+        if state not in (READY, STANDBY):
+            raise ReplicaFailedError(
+                f"replica {self.name} is {state}", query_id=qid)
+        with self._write_lock:
+            current = self.context.table_epoch(*table_key)
+            if current > expected_epoch:
+                self.context.metrics.inc("fleet.write.fenced")
+                logger.info(
+                    "replica %s fenced duplicate write on %s.%s "
+                    "(epoch %d > expected %d)", self.name,
+                    table_key[0], table_key[1], current, expected_epoch)
+                return None
+            if current < expected_epoch:
+                raise ReplicaFailedError(
+                    f"replica {self.name} is behind on {table_key[0]}."
+                    f"{table_key[1]} (epoch {current} < fence "
+                    f"{expected_epoch}); replay required", query_id=qid)
+            result = self.context.sql(sql, return_futures=False)
+            self.context.metrics.inc("fleet.write.applied")
+            return result
+
+    # ------------------------------------------------------------ lifecycle
+    def promote(self) -> None:
+        """STANDBY -> READY (router-driven; write replay happens first)."""
+        with self._lock:
+            if self._state == STANDBY:
+                self._state = READY
+
+    def kill(self) -> int:
+        """Simulated ``kill -9``: the replica resolves nothing from here
+        on.  Queued work fails with retryable `ShutdownError` (the
+        shutdown drain), in-flight routed futures fail immediately with
+        retryable `ReplicaFailedError` — the router re-dispatches both to
+        survivors.  Worker threads unwind on their own (a real SIGKILL
+        would take them with the process; in-process their late results
+        no-op against the already-failed futures).  Returns how many
+        in-flight futures were failed."""
+        from ..observability import flight
+
+        with self._lock:
+            if self._state == DEAD:
+                return 0
+            self._state = DEAD
+        flight.record("replica.kill", replica=self.name)
+        self.context.metrics.inc("fleet.kill")
+        self.runtime.shutdown(wait=False)
+        return self.runtime.fail_inflight(
+            lambda ticket: ReplicaFailedError(
+                f"replica {self.name} killed mid-query",
+                query_id=ticket.qid))
+
+    def drain(self, wait: bool = True) -> None:
+        """Graceful drain (SIGTERM / ``POST /v1/drain``): stop taking
+        routed traffic, finish in-flight work (bounded by
+        ``serving.shutdown.drain_timeout_s``), hand queued work back to
+        the router as retryable `ShutdownError`."""
+        from ..observability import flight
+
+        with self._lock:
+            if self._state in (DEAD, DRAINING):
+                return
+            self._state = DRAINING
+        flight.record("fleet.drain", replica=self.name)
+        self.context.metrics.inc("fleet.drain")
+        self.runtime.shutdown(wait=wait)
+
+    def shutdown(self) -> None:
+        """Test/teardown convenience: drain quietly and mark dead."""
+        state = self.state
+        if state != DEAD:
+            self.runtime.shutdown(wait=True)
+            with self._lock:
+                self._state = DEAD

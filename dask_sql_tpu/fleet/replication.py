@@ -1,0 +1,75 @@
+"""Warm-standby replication: the cold-start machinery as a transport.
+
+A standby replica is useful exactly insofar as promoting it costs
+nothing: zero foreground compiles on its first routed family, catalog
+already loaded, profiles already sharpening predictions.  This module
+gets there by reusing the PR 6 cold-start pipeline verbatim as a
+replication transport:
+
+1. the primary's `Context.save_state` writes an atomic checkpoint
+   snapshot (tables + models + statistics + profiles + breaker state +
+   table delta epochs) into the replication directory;
+2. the standby's `Context.load_state` rehydrates it and kicks the
+   warm-up pass (`serving/warmup.py`), which replays the profile
+   store's hot families through the compile cache in the background;
+3. the persistent compile cache (``compile.cache.persist_path``) is the
+   third leg: primaries and standbys pointed at one cache directory
+   share lowered executables, so the standby's warm-up pass is
+   cache-hits, not compiles.  (In-process fleets share the process
+   compile cache and get this for free.)
+
+Promotion then needs no data motion at all — the router replays any
+writes sequenced after the last sync (epoch-fenced, fleet/router.py)
+and flips the standby READY.  The epoch fencing is what makes syncing
+and writing safely concurrent: the snapshot manifest carries the table
+epochs it captured, so a standby restored from a snapshot taken BEFORE
+an append can never serve a pre-append cached result — its epochs say
+it is behind, and the router replays the tail before routing to it.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import tempfile
+import time
+from typing import Optional
+
+from .replica import Replica
+
+logger = logging.getLogger(__name__)
+
+
+class StandbyReplicator:
+    """Ships checkpoint snapshots from a primary to a warm standby."""
+
+    def __init__(self, primary: Replica, standby: Replica,
+                 directory: Optional[str] = None, metrics=None):
+        self.primary = primary
+        self.standby = standby
+        self.directory = directory or tempfile.mkdtemp(prefix="dsql-fleet-")
+        self.metrics = metrics if metrics is not None \
+            else primary.context.metrics
+        self.last_sync_ts: Optional[float] = None
+        self.syncs = 0
+
+    def sync(self, wait_warm: bool = True,
+             warm_timeout_s: float = 60.0) -> str:
+        """One replication round: snapshot the primary, restore the
+        standby, and (by default) block until the standby's warm-up pass
+        finishes — after which a promotion pays zero foreground compiles.
+        Returns the snapshot directory used."""
+        t0 = time.monotonic()
+        os.makedirs(self.directory, exist_ok=True)
+        self.primary.context.save_state(self.directory)
+        self.standby.context.load_state(self.directory)
+        if wait_warm:
+            warm = getattr(self.standby.context, "warmup", None)
+            if warm is not None:
+                warm.join(timeout=warm_timeout_s)
+        self.last_sync_ts = time.time()
+        self.syncs += 1
+        self.metrics.inc("fleet.sync")
+        logger.info("standby %s synced from %s in %.0f ms (sync #%d)",
+                    self.standby.name, self.primary.name,
+                    (time.monotonic() - t0) * 1000.0, self.syncs)
+        return self.directory

@@ -1,0 +1,375 @@
+"""The fleet router: health-gated cost-aware routing with failover.
+
+The router fronts N `Replica`s and makes replica death and saturation
+both non-events:
+
+- **Routing** (`execute`): every dispatch picks the replica whose packing
+  budget fits the query's cost hint — candidates are the health-gated
+  routable replicas ordered by (fits-the-hint, ledger headroom,
+  predicted drain); a replica whose admission queue is at bound makes
+  the router SPILL to the next peer instead of surfacing the 429 (the
+  queue-full error only reaches the client when every live replica is
+  saturated, with the largest Retry-After of the set).
+- **Failover**: every routed query carries an idempotency key — the
+  client qid plus the engine's own result-cache key ingredients (family
+  fingerprint + parameter values + table epochs) on the replica side —
+  so when a replica dies or times out mid-query the router re-dispatches
+  to a survivor with bounded retry/backoff
+  (``fleet.failover.max_attempts`` / ``fleet.failover.base_s``) and the
+  survivor's result cache dedupes re-execution of anything it already
+  answered.  Only retryable taxonomy codes re-dispatch; user errors and
+  non-retryable failures propagate on first throw.
+- **Warm-standby promotion**: on replica death the router promotes the
+  standby (fleet/replication.py keeps it ingesting snapshots + the
+  persistent compile cache + profiles), replaying any writes the standby
+  missed — epoch-fenced, so a replay can never double-apply.
+- **Write fan-out** (INSERT INTO): writes apply on EVERY live replica,
+  each stamped with the router's per-table write sequence as the
+  expected delta epoch (`Replica.apply_write`): exactly-once no matter
+  how many times failover retries the statement.
+"""
+from __future__ import annotations
+
+import logging
+import re
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..resilience.errors import ReplicaFailedError, ShutdownError
+from ..serving.admission import QueueFullError
+from .replica import DEAD, READY, Replica
+
+logger = logging.getLogger(__name__)
+
+_WRITE_RE = re.compile(r"^\s*insert\s+into\s+([A-Za-z_][\w]*(?:\.[\w]+)?)",
+                       re.IGNORECASE)
+
+
+class Router:
+    """Routes per-tenant traffic across a replica fleet."""
+
+    def __init__(self, replicas: List[Replica],
+                 standby: Optional[Replica] = None,
+                 metrics=None, config=None):
+        from ..serving.metrics import MetricsRegistry
+
+        if not replicas:
+            raise ValueError("a fleet needs at least one replica")
+        self.replicas: List[Replica] = list(replicas)
+        self.standby = standby
+        self.metrics = metrics if metrics is not None else MetricsRegistry()
+        from .. import config as config_module
+
+        self.config = config if config is not None else config_module.config
+        self._lock = threading.Lock()
+        #: serializes write APPLICATION (fan-out and promotion replay):
+        #: sequencing happens under `_lock`, but applies must land in
+        #: sequence order or concurrent writers would trip each other's
+        #: epoch fences ("behind, replay required") on every replica
+        self._apply_lock = threading.Lock()
+        #: global per-table write sequence: the fence every fanned-out
+        #: write carries, and the replay source for promoted standbys
+        self._write_log: Dict[Tuple[str, str], List[str]] = {}
+        #: the table's delta epoch when the router first saw it — fences
+        #: are base + position in the log, so a fleet built over tables
+        #: with prior epochs keeps counting from where they were
+        self._epoch_base: Dict[Tuple[str, str], int] = {}
+        #: per-replica routed-query tally (SHOW REPLICAS)
+        self._routed: Dict[str, int] = {}
+        for r in self.replicas + ([standby] if standby is not None else []):
+            r.context.fleet_router = self
+        self.metrics.gauge("fleet.replicas", len(self._live()))
+
+    # -------------------------------------------------------------- picking
+    def _live(self) -> List[Replica]:
+        return [r for r in self.replicas if r.state == READY]
+
+    def _candidates(self, cost_bytes: int) -> List[Replica]:
+        """Routable replicas, best first: replicas whose headroom fits the
+        query's provable cost hint before ones that would overcommit, then
+        by descending headroom, then by the scheduler's predicted drain
+        (spill lands on the replica that frees up soonest)."""
+        cands = [r for r in self.replicas if r.routable]
+
+        def key(r: Replica):
+            headroom = r.headroom_bytes()
+            fits = headroom is None or headroom >= cost_bytes
+            drain = r.predicted_drain_s()
+            return (not fits,
+                    -(headroom if headroom is not None else float("inf")),
+                    drain if drain is not None else 0.0)
+
+        return sorted(cands, key=key)
+
+    def _cost_hint(self, sql: str, config_options):
+        for r in self._live():
+            try:
+                return r.context.cost_hint(sql, config_options)
+            except Exception:  # dsql: allow-broad-except — advisory hint
+                continue
+        return None
+
+    # ------------------------------------------------------------- failures
+    def _note_failure(self, replica: Replica) -> None:
+        """A dispatch to ``replica`` failed with a replica-level error:
+        refresh the live gauge and promote the standby if the replica is
+        actually dead (vs merely draining/slow)."""
+        self.metrics.gauge("fleet.replicas", len(self._live()))
+        if replica.state == DEAD:
+            self.maybe_promote()
+
+    def maybe_promote(self) -> Optional[Replica]:
+        """Promote a ready warm standby into the serving set (idempotent;
+        no-op when there is no standby, it is not warm yet, or
+        ``fleet.standby.auto_promote`` is off).  Missed writes replay
+        BEFORE the standby takes traffic — epoch-fenced, exactly-once."""
+        from ..observability import flight
+
+        with self._lock:
+            standby = self.standby
+            if standby is None or not bool(self.config.get(
+                    "fleet.standby.auto_promote", True)):
+                return None
+            warm = getattr(standby.context, "warmup", None)
+            if warm is not None and not warm.ready:
+                return None
+            self.standby = None
+        self._replay_writes(standby)
+        standby.promote()
+        with self._lock:
+            self.replicas.append(standby)
+        flight.record("fleet.promote", replica=standby.name)
+        self.metrics.inc("fleet.promote")
+        self.metrics.gauge("fleet.replicas", len(self._live()))
+        logger.info("promoted standby replica %s into the serving set",
+                    standby.name)
+        return standby
+
+    def _replay_writes(self, replica: Replica) -> None:
+        with self._apply_lock:
+            with self._lock:
+                log_snapshot = {k: list(v)
+                                for k, v in self._write_log.items()}
+                bases = dict(self._epoch_base)
+            for table_key, log in log_snapshot.items():
+                base = bases.get(table_key, 0)
+                # the snapshot a standby restored from carries the table
+                # epochs it captured (checkpoint.py), so `have` is exactly
+                # how many sequenced writes it has seen — replay the tail
+                have = replica.context.table_epoch(*table_key) - base
+                for i in range(max(0, have), len(log)):
+                    replica.apply_write(log[i], table_key, base + i)
+                    self.metrics.inc("fleet.write.replayed")
+
+    # ------------------------------------------------------------ execution
+    def execute(self, sql: str, qid: Optional[str] = None,
+                priority_class: str = "interactive",
+                config_options: Optional[Dict[str, Any]] = None,
+                tenant: Optional[str] = None):
+        """Route one statement; blocks for the result.  Reads re-dispatch
+        across replicas on retryable replica failures; writes fan out to
+        every live replica with epoch fencing."""
+        qid = qid or str(uuid.uuid4())
+        m = _WRITE_RE.match(sql)
+        if m:
+            return self._write(sql, m.group(1), qid)
+        return self._read(sql, qid, priority_class, config_options, tenant)
+
+    def _read(self, sql: str, qid: str, priority_class: str,
+              config_options, tenant):
+        from ..observability import flight
+
+        cost = self._cost_hint(sql, config_options)
+        cost_bytes = int(getattr(cost, "bytes_lo", 0) or 0)
+        if cost is not None and tenant:
+            cost.tenant = tenant
+        attempts = max(1, int(self.config.get(
+            "fleet.failover.max_attempts", 3) or 1))
+        base_s = float(self.config.get("fleet.failover.base_s", 0.02) or 0.0)
+        last_exc: Optional[BaseException] = None
+        for attempt in range(attempts):
+            order = self._candidates(cost_bytes)
+            if not order:
+                # nothing routable: a promotion may mint a candidate
+                promoted = self.maybe_promote()
+                if promoted is not None:
+                    order = self._candidates(cost_bytes)
+            if not order:
+                raise last_exc if last_exc is not None else \
+                    ReplicaFailedError("no routable replica in the fleet",
+                                       query_id=qid)
+            queue_full: List[QueueFullError] = []
+            failed_over = False
+            for replica in order:
+                flight.record("fleet.route", qid=qid, replica=replica.name,
+                              attempt=attempt)
+                self.metrics.inc("fleet.route")
+                self.metrics.inc(f"fleet.routed.{replica.name}")
+                with self._lock:
+                    self._routed[replica.name] = \
+                        self._routed.get(replica.name, 0) + 1
+                try:
+                    return replica.run(sql, qid=qid,
+                                       priority_class=priority_class,
+                                       config_options=config_options,
+                                       cost=cost)
+                except QueueFullError as e:
+                    # saturation is a ROUTING event, not a client error:
+                    # spill to the next peer (never a failover attempt)
+                    self.metrics.inc("fleet.route.spill")
+                    queue_full.append(e)
+                    continue
+                except (ReplicaFailedError, ShutdownError) as e:
+                    # replica died / drained / timed out mid-query:
+                    # bounded failover to a survivor; the survivor's
+                    # result cache dedupes re-execution
+                    last_exc = e
+                    failed_over = True
+                    flight.record("fleet.failover", qid=qid,
+                                  replica=replica.name,
+                                  code=getattr(e, "code", None))
+                    self.metrics.inc("fleet.failover")
+                    self._note_failure(replica)
+                    break
+            else:
+                if queue_full:
+                    # EVERY live replica is saturated: now — and only
+                    # now — the shed surfaces, with the most pessimistic
+                    # Retry-After of the fleet
+                    worst = max(queue_full, key=lambda e: e.retry_after_s)
+                    raise worst
+            if failed_over and attempt + 1 < attempts and base_s > 0:
+                time.sleep(base_s * (2 ** attempt))
+        assert last_exc is not None
+        raise last_exc
+
+    # --------------------------------------------------------------- writes
+    def _table_key(self, name: str) -> Tuple[str, str]:
+        if "." in name:
+            schema, _, table = name.partition(".")
+            return (schema, table)
+        schema = self._live()[0].context.schema_name if self._live() \
+            else "root"
+        return (schema, name)
+
+    def _write(self, sql: str, target: str, qid: str):
+        """Fan a write out to every live replica under one epoch fence.
+        The statement lands exactly once per replica no matter how many
+        times a client or the failover loop retries it: the fence is the
+        router's global per-table write sequence, and `apply_write`
+        no-ops when a replica's epoch already advanced past it."""
+        table_key = self._table_key(target)
+        with self._lock:
+            log = self._write_log.setdefault(table_key, [])
+            if table_key not in self._epoch_base:
+                live = self._live()
+                self._epoch_base[table_key] = \
+                    live[0].context.table_epoch(*table_key) if live else 0
+            base = self._epoch_base[table_key]
+            if sql in log:
+                # idempotent client retry of an already-sequenced write:
+                # catch-up below re-applies on stragglers only — an
+                # identical statement never gets a second sequence slot
+                idx = log.index(sql)
+            else:
+                idx = len(log)
+                log.append(sql)
+        result = None
+        applied = 0
+        failed: List[Replica] = []
+        with self._apply_lock:
+            with self._lock:
+                pending = list(self._write_log[table_key])
+            for replica in list(self.replicas):
+                if replica.state != READY:
+                    continue
+                try:
+                    # bring this replica fully up to date in sequence
+                    # order: a concurrent writer may have sequenced ahead
+                    # of us, and its statements must land first or the
+                    # epoch fence would (correctly) reject ours as early
+                    have = replica.context.table_epoch(*table_key) - base
+                    for i in range(max(0, have), len(pending)):
+                        out = replica.apply_write(pending[i], table_key,
+                                                  base + i, qid=qid)
+                        if i == idx and out is not None and result is None:
+                            result = out
+                    applied += 1
+                except ReplicaFailedError:
+                    failed.append(replica)
+                    continue
+        for replica in failed:
+            # outside the apply lock: a promotion triggered here replays
+            # the write log, which re-takes it
+            self._note_failure(replica)
+        if applied == 0:
+            raise ReplicaFailedError(
+                f"write {qid} applied on no replica", query_id=qid)
+        return result
+
+    # -------------------------------------------------------------- control
+    def find(self, name: str) -> Optional[Replica]:
+        for r in self.replicas:
+            if r.name == name:
+                return r
+        if self.standby is not None and self.standby.name == name:
+            return self.standby
+        return None
+
+    def drain(self, name: str, wait: bool = True) -> bool:
+        """Gracefully drain one replica out of the serving set."""
+        replica = self.find(name)
+        if replica is None:
+            return False
+        replica.drain(wait=wait)
+        self.metrics.gauge("fleet.replicas", len(self._live()))
+        self.maybe_promote()
+        return True
+
+    def kill(self, name: str) -> bool:
+        """Chaos entry point: kill -9 one replica."""
+        replica = self.find(name)
+        if replica is None:
+            return False
+        replica.kill()
+        self._note_failure(replica)
+        return True
+
+    def shutdown(self) -> None:
+        """Drain every member (tests/chaos teardown)."""
+        for r in list(self.replicas) + \
+                ([self.standby] if self.standby is not None else []):
+            r.shutdown()
+
+    # ------------------------------------------------------------- readouts
+    def rows(self) -> List[Tuple[str, str, str, str, str]]:
+        """(Replica, State, Band, Headroom, Routed) rows — SHOW REPLICAS."""
+        out = []
+        members = list(self.replicas)
+        if self.standby is not None:
+            members.append(self.standby)
+        with self._lock:
+            routed = dict(self._routed)
+        for r in members:
+            health = r.health()
+            headroom = health.get("headroomBytes")
+            out.append((r.name, health.get("status", r.state),
+                        str(health.get("band", "-")),
+                        "-" if headroom is None else str(int(headroom)),
+                        str(routed.get(r.name, 0))))
+        return out
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {
+            "replicas": [
+                {"name": r.name, "state": r.state, "health": r.health()}
+                for r in self.replicas],
+            "standby": None if self.standby is None else {
+                "name": self.standby.name,
+                "state": self.standby.state,
+                "health": self.standby.health()},
+            "writeLog": {f"{s}.{t}": len(log) for (s, t), log
+                         in self._write_log.items()},
+        }

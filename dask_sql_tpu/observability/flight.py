@@ -80,6 +80,12 @@ EVENT_NAMES = frozenset({
     "pressure.reclaim",
     # chaos campaign harness (resilience/chaos.py)
     "chaos.arm",
+    # fleet tier (fleet/): routing, failover, promotion, drain, kill
+    "fleet.route",
+    "fleet.failover",
+    "fleet.promote",
+    "fleet.drain",
+    "replica.kill",
 })
 
 #: prefixes legitimizing dynamic event families (none today; the slot
@@ -170,6 +176,28 @@ def record(event: str, qid: Optional[str] = None,
 #: interleave JSONL lines mid-record
 _dump_lock = threading.Lock()
 
+#: characters allowed verbatim in a {qid} path substitution; anything
+#: else (slashes, spaces, NULs from a hostile client qid) becomes "_"
+_QID_SAFE = frozenset(
+    "abcdefghijklmnopqrstuvwxyzABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789.-")
+
+
+def expand_dump_path(path: str, qid: Optional[str] = None) -> str:
+    """Expand ``{pid}`` / ``{qid}`` placeholders in the configured dump
+    path.  Multiple replicas sharing one dump directory each write their
+    own file (``flight-{pid}.jsonl``) instead of interleaving appends to
+    a single JSONL — the ``_dump_lock`` below serializes writers within a
+    process, but nothing serializes processes."""
+    import os
+
+    if "{pid}" in path:
+        path = path.replace("{pid}", str(os.getpid()))
+    if "{qid}" in path:
+        safe = "".join(ch if ch in _QID_SAFE else "_"
+                       for ch in (qid or "unknown"))
+        path = path.replace("{qid}", safe or "unknown")
+    return path
+
 
 def flush_on_failure(qid: Optional[str], error_code: Optional[str],
                      config, metrics=None) -> bool:
@@ -182,6 +210,7 @@ def flush_on_failure(qid: Optional[str], error_code: Optional[str],
         "observability.flight.dump_path")
     if not path:
         return False
+    path = expand_dump_path(path, qid=qid)
     rec = {
         "ts": time.time(),
         "qid": qid,

@@ -349,6 +349,31 @@ class ShowMaterializedPlugin(BaseRelPlugin):
 
 
 @Executor.add_plugin_class
+class ShowReplicasPlugin(BaseRelPlugin):
+    """SHOW REPLICAS [LIKE 'pat'] — the fleet router's member table
+    (fleet/router.py): one (Replica, State, Band, Headroom, Routed) row
+    per serving replica plus the warm standby.  A context not fronted by
+    a router answers with zero rows (the statement stays valid on a
+    single-node deployment).  LIKE filters on the replica name or
+    state."""
+
+    class_name = "ShowReplicasNode"
+
+    def convert(self, rel: p.ShowReplicasNode, executor) -> Table:
+        router = getattr(executor.context, "fleet_router", None)
+        rows = router.rows() if router is not None else []
+        if rel.like:
+            rows = [r for r in rows
+                    if _like_match(rel.like, r[0])
+                    or _like_match(rel.like, r[1])]
+        return _string_table({"Replica": [r[0] for r in rows],
+                              "State": [r[1] for r in rows],
+                              "Band": [r[2] for r in rows],
+                              "Headroom": [r[3] for r in rows],
+                              "Routed": [r[4] for r in rows]})
+
+
+@Executor.add_plugin_class
 class InsertIntoPlugin(BaseRelPlugin):
     """INSERT INTO t VALUES (...) / INSERT INTO t SELECT ... — the append
     path.  The body executes like any query, its columns bind to the

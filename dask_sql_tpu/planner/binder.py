@@ -195,6 +195,14 @@ class Binder:
                  Field("Hits", SqlType.VARCHAR),
                  Field("Epoch", SqlType.VARCHAR)],
                 stmt.like)
+        if isinstance(stmt, a.ShowReplicas):
+            return p.ShowReplicasNode(
+                [Field("Replica", SqlType.VARCHAR),
+                 Field("State", SqlType.VARCHAR),
+                 Field("Band", SqlType.VARCHAR),
+                 Field("Headroom", SqlType.VARCHAR),
+                 Field("Routed", SqlType.VARCHAR)],
+                stmt.like)
         if isinstance(stmt, a.InsertInto):
             inner, _ = self.bind_query(stmt.query)
             return p.InsertIntoNode([Field("Inserted", SqlType.VARCHAR)],

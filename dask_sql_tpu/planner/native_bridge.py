@@ -131,6 +131,7 @@ _K_CREATE_EXPERIMENT = 97; _K_KWARGS = 98; _K_KV = 99; _K_KWLIST = 100
 _K_SHOW_METRICS = 101; _K_SHOW_PROFILES = 102
 _K_SHOW_QUERIES = 103; _K_CANCEL_QUERY = 104
 _K_SHOW_MATERIALIZED = 105; _K_INSERT_INTO = 106
+_K_SHOW_REPLICAS = 107
 
 _FRAME_KINDS = ["UNBOUNDED_PRECEDING", "PRECEDING", "CURRENT_ROW",
                 "FOLLOWING", "UNBOUNDED_FOLLOWING"]
@@ -152,10 +153,10 @@ def _get_parser_lib():
             ]
             lib.dsql_buf_free.argtypes = [ctypes.POINTER(ctypes.c_uint8)]
             lib.dsql_parser_abi_version.restype = ctypes.c_int32
-            # grammar version 6 = SHOW MATERIALIZED + INSERT INTO; a
+            # grammar version 7 = SHOW REPLICAS (the fleet surface); a
             # stale .so predating it is rejected here so the Python parser
             # handles the syntax
-            _parser_ok = lib.dsql_parser_abi_version() == 6
+            _parser_ok = lib.dsql_parser_abi_version() == 7
         except AttributeError:
             _parser_ok = False
     return lib if _parser_ok else None
@@ -571,6 +572,8 @@ def _decode_statement(f: "_FlatAst", sid: int):
         return a.CancelQuery(f.s(s0) or "")
     if kind == _K_SHOW_MATERIALIZED:
         return a.ShowMaterialized(f.s(s0))
+    if kind == _K_SHOW_REPLICAS:
+        return a.ShowReplicas(f.s(s0))
     if kind == _K_INSERT_INTO:
         return a.InsertInto(_decode_qname(f, kids[0]),
                             _decode_select(f, kids[1]))

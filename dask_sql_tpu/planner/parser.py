@@ -315,9 +315,14 @@ class Parser:
             if self.accept_keyword("LIKE"):
                 like = self.next().value
             return a.ShowMaterialized(like)
+        if self.accept_keyword("REPLICAS"):
+            like = None
+            if self.accept_keyword("LIKE"):
+                like = self.next().value
+            return a.ShowReplicas(like)
         raise self.error(
             "Expected SCHEMAS, TABLES, COLUMNS, MODELS, METRICS, PROFILES, "
-            "QUERIES or MATERIALIZED after SHOW")
+            "QUERIES, MATERIALIZED or REPLICAS after SHOW")
 
     def parse_alter(self) -> a.Statement:
         self.expect_keyword("ALTER")

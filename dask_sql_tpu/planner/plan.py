@@ -470,6 +470,14 @@ class ShowMaterializedNode(CustomNode):
 
 
 @dataclass(eq=False)
+class ShowReplicasNode(CustomNode):
+    """SHOW REPLICAS — the fleet router's member table (fleet/router.py):
+    state, pressure band, headroom, routed tally per replica."""
+
+    like: Optional[str] = None
+
+
+@dataclass(eq=False)
 class InsertIntoNode(CustomNode):
     """INSERT INTO — the append path (Context.append_rows): delta-epoch
     bump + incremental maintenance instead of wholesale invalidation."""

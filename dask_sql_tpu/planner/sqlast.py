@@ -436,6 +436,15 @@ class ShowMaterialized(Statement):
 
 
 @dataclass
+class ShowReplicas(Statement):
+    """SHOW REPLICAS: the fleet router's member table (fleet/router.py) —
+    one row per replica (plus the warm standby): lifecycle state, pressure
+    band, ledger headroom, routed-query tally."""
+
+    like: Optional[str] = None
+
+
+@dataclass
 class InsertInto(Statement):
     """INSERT INTO t VALUES ... / INSERT INTO t SELECT ...: the append
     path (Context.append_rows) — rows concat onto the existing container,

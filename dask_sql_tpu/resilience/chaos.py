@@ -399,3 +399,207 @@ def _check_invariants(report: ChaosReport, context, runtime,
             violate(f"{qid}: query.finish with no query.admit")
         if finishes and admits and admits[0]["ts"] > finishes[0]["ts"]:
             violate(f"{qid}: query.admit after query.finish")
+
+
+# ===================================================================== fleet
+@dataclass
+class FleetChaosReport(ChaosReport):
+    """Outcome of one replica-kill campaign across a fleet (ISSUE 18)."""
+
+    kills: int = 0
+    promoted: int = 0
+    inserts: int = 0
+    retried: int = 0
+
+    def summary(self) -> str:
+        return (f"fleet chaos seed={self.seed}: {self.submitted} queries "
+                f"over {self.rounds} rounds ({self.completed} ok, "
+                f"{self.retried} client retries, {self.failed} failed, "
+                f"{self.shed} shed), {self.kills} replicas killed, "
+                f"{self.promoted} standby promoted, {self.inserts} inserts; "
+                f"{len(self.violations)} invariant violation(s)")
+
+
+def run_fleet_campaign(seed: int, queries: int = 30, rounds: int = 3,
+                       replicas: int = 3, clients: int = 4,
+                       sync_dir: Optional[str] = None) -> FleetChaosReport:
+    """Replica-kill chaos across a router-fronted fleet (ISSUE 18): drive
+    the concurrent mixed workload THROUGH the fleet router, kill -9 one
+    replica per round mid-workload (round 0 stays clean to warm profiles
+    and sync the standby), and assert the fleet-level invariants:
+
+    - ZERO lost queries: every routed statement reaches a terminal state
+      with success or a structured retryable outcome (a non-retryable
+      failure under pure replica-kill chaos is a violation);
+    - INSERT INTO applies exactly once per surviving replica no matter
+      how many times failover retried it (epoch fencing): every
+      survivor's row count equals base rows + successful inserts, and
+      all survivors agree;
+    - the promoted standby serves reads (it was promoted, it is READY,
+      and it converged to the same row count);
+    - router + survivor ledgers reconcile to idle after drain.
+
+    Deterministic per (seed, queries, rounds, replicas) in what is
+    submitted and which replica dies when; interleavings race — that is
+    the point — but the invariants are order-independent."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    from .. import config as config_module
+    from ..fleet import READY, build_fleet
+    from ..serving.cache import table_nbytes
+    from . import faults
+
+    rng = random.Random(seed)
+    report = FleetChaosReport(seed=seed)
+    saved = list(config_module.config.effective_items())
+    faults.reset()
+    nonce = next(_campaign_nonce)
+    try:
+        config_module.config.update({
+            **_BASE_CONFIG,
+            "fleet.failover.max_attempts": 4,
+            "fleet.failover.base_s": 0.01,
+            "fleet.result_timeout_s": 30.0,
+        })
+
+        def factory():
+            c = _build_context(random.Random(seed))
+            c.sql("CREATE TABLE t_small_pred AS "
+                  "(SELECT a / 200.0 AS x, b / 7.0 AS y FROM t_small)")
+            return c
+
+        router, members, replicator = build_fleet(
+            factory, replicas=replicas, standby=True, sync_dir=sync_dir)
+        base_rows = 200  # t_small rows in the fixture
+        big_bytes = table_nbytes(
+            members[0].context.schema["root"].tables["t_big"].table)
+        templates = _query_mix(max(4096, big_bytes // 3))
+        per_round = max(2, queries // max(1, rounds))
+        ok_inserts = 0
+        lock = threading.Lock()
+
+        def client(sql, cls, qopts, qid, is_insert):
+            nonlocal ok_inserts
+            delay = 0.02
+            for attempt in range(6):
+                try:
+                    router.execute(sql, qid=qid, priority_class=cls,
+                                   config_options=qopts)
+                    if is_insert:
+                        with lock:
+                            ok_inserts += 1
+                    return "ok"
+                except Exception as exc:  # dsql: allow-broad-except —
+                    # outcome taxonomy IS what the campaign classifies
+                    if getattr(exc, "retryable", False):
+                        if attempt < 5:
+                            with lock:
+                                report.retried += 1
+                            time.sleep(delay)
+                            delay *= 2
+                            continue
+                        return "retryable"
+                    return (f"fatal:{getattr(exc, 'code', None) or type(exc).__name__}"
+                            f" {exc}")
+            return "retryable"
+
+        try:
+            with ThreadPoolExecutor(max_workers=clients,
+                                    thread_name_prefix="fleet-client") as pool:
+                for rnd in range(rounds):
+                    tasks = []
+                    for i in range(per_round):
+                        sql, cls, qopts = templates[
+                            (rnd * per_round + i) % len(templates)]
+                        qid = f"fleet-{seed}.{nonce}-{rnd}-{i}"
+                        tasks.append((sql, cls, qopts, qid, False))
+                    for j in range(2):
+                        # textually unique per (round, slot): the router's
+                        # write log dedupes identical statements as client
+                        # retries of ONE write
+                        tag = 10000 + rnd * 100 + j
+                        tasks.append((
+                            f"INSERT INTO t_small SELECT a + {tag}, b "
+                            f"FROM t_small WHERE a < 1",
+                            "interactive", {},
+                            f"fleet-ins-{seed}.{nonce}-{rnd}-{j}", True))
+                    rng.shuffle(tasks)
+                    futures = [pool.submit(client, *t) for t in tasks]
+                    report.submitted += len(tasks)
+                    if rnd > 0 and rnd < len(members):
+                        # kill -9 one replica mid-workload; the standby
+                        # absorbs the first death via promotion
+                        time.sleep(0.05)
+                        victim = members[rnd]
+                        if victim.state == READY:
+                            logger.info("fleet chaos round %d killing %s",
+                                        rnd, victim.name)
+                            router.kill(victim.name)
+                            report.kills += 1
+                    for f in futures:
+                        status = f.result(180.0)
+                        if status == "ok":
+                            report.completed += 1
+                        elif status == "retryable":
+                            report.shed += 1
+                        else:
+                            report.failed += 1
+                            report.violations.append(
+                                f"round {rnd}: non-retryable outcome under "
+                                f"replica-kill chaos: {status}")
+                    if rnd == 0 and replicator is not None:
+                        # quiet window: warm the standby off round-0 state
+                        # (snapshot carries table epochs + profiles; the
+                        # process compile cache is shared in-process)
+                        replicator.sync()
+                    report.rounds += 1
+
+            report.inserts = ok_inserts
+            promoted = [r for r in router.replicas
+                        if r.name == "standby" and r.state == READY]
+            report.promoted = len(promoted)
+            if report.kills and not promoted:
+                report.violations.append(
+                    "standby was never promoted despite replica kills")
+
+            # exactly-once INSERT: every surviving replica agrees on
+            # base + successful-inserts rows, no more (a double apply
+            # would overshoot), no fewer (a lost write would undershoot)
+            survivors = [r for r in router.replicas if r.state == READY]
+            if not survivors:
+                report.violations.append("no surviving replica after chaos")
+            expect = base_rows + ok_inserts
+            for r in survivors:
+                out = r.context.sql("SELECT COUNT(*) AS n FROM t_small",
+                                    return_futures=False)
+                n = int(out["n"][0])
+                if n != expect:
+                    report.violations.append(
+                        f"{r.name}: t_small has {n} rows, expected "
+                        f"{expect} (base {base_rows} + {ok_inserts} "
+                        f"inserts applied exactly once)")
+
+            # drain the fleet, then every ledger must reconcile to idle
+            for r in survivors:
+                r.drain(wait=True)
+            checked = list(dict.fromkeys(
+                members + list(router.replicas)
+                + ([router.standby] if router.standby else [])))
+            for r in checked:
+                deadline = time.monotonic() + 5.0
+                reserved = r.context.ledger.reserved_bytes()
+                while reserved and time.monotonic() < deadline:
+                    time.sleep(0.01)
+                    reserved = r.context.ledger.reserved_bytes()
+                if reserved:
+                    report.violations.append(
+                        f"{r.name}: ledger still holds {reserved} reserved "
+                        f"bytes after fleet drain")
+        finally:
+            router.shutdown()
+    finally:
+        config_module.config.update(dict(saved))
+        faults.reset()
+    for v in report.violations:
+        logger.error("fleet chaos invariant violation: %s", v)
+    return report

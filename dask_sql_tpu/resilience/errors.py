@@ -166,6 +166,21 @@ class ShutdownError(QueryError):
     retryable = True  # another replica (or a restart) can take the query
 
 
+class ReplicaFailedError(QueryError):
+    """The replica a query was routed to died (or was draining / timed
+    out) before the query reached a terminal state.  Retryable: a
+    re-dispatch to a SURVIVING replica — deduped by the idempotency key,
+    which is the result-cache key's ingredients — can succeed; the fleet
+    router (fleet/router.py) does exactly that with bounded backoff.  The
+    serving worker's in-replica retry loop never sees this error (it is
+    set on routed futures by the kill/drain paths, above the worker), so
+    the flag cannot make a dead replica retry onto itself."""
+
+    code = "REPLICA_FAILED"
+    error_type = INSUFFICIENT_RESOURCES
+    retryable = True
+
+
 class ModelError(QueryError, ValueError):
     """CREATE MODEL / PREDICT / EXPORT MODEL failed on the model layer
     (unresolvable model_class, fit/predict raising, bad WITH options).

@@ -38,6 +38,7 @@ from ..serving.admission import (
     QueryTicket,
     QueueFullError,
 )
+from ..resilience.errors import ShutdownError
 from ..serving.runtime import ServingRuntime
 from . import responses
 
@@ -339,7 +340,8 @@ class _QueryRegistry:
         self.runtime.shutdown()
 
 
-def _make_handler(context, registry: _QueryRegistry, jdbc_meta: bool):
+def _make_handler(context, registry: _QueryRegistry, jdbc_meta: bool,
+                  server: Optional["PrestoServer"] = None):
     class Handler(BaseHTTPRequestHandler):
         server_version = "dask-sql-tpu-presto"
 
@@ -376,6 +378,21 @@ def _make_handler(context, registry: _QueryRegistry, jdbc_meta: bool):
                 ok = registry.cancel(qid)
                 ok = context.cancel_query(qid) or ok
                 self._send({"cancelled": bool(ok)}, 200 if ok else 404)
+                return
+            if path.rstrip("/") == "/v1/drain" and server is not None:
+                # graceful drain (same protocol as SIGTERM): health flips
+                # to 503-draining immediately, in-flight queries finish
+                # (bounded by serving.shutdown.drain_timeout_s), queued
+                # work fails with retryable ShutdownError — the fleet
+                # router re-dispatches it to a peer (docs/fleet.md).  The
+                # response goes out before the drain starts so the caller
+                # is never cut off by its own request.
+                already = server.draining.is_set()
+                if not already:
+                    threading.Thread(target=server.drain,
+                                     name="dsql-drain",
+                                     daemon=True).start()
+                self._send({"status": "draining", "already": already})
                 return
             if path.rstrip("/") != "/v1/statement":
                 self._send({"error": "unknown endpoint"}, 404)
@@ -417,6 +434,12 @@ def _make_handler(context, registry: _QueryRegistry, jdbc_meta: bool):
                 self._send(
                     responses.queue_full_results(str(uuid.uuid4()), e),
                     429, headers={"Retry-After": str(retry_after)})
+                return
+            except ShutdownError as e:
+                # draining/shut down: structured 503 with the retryable
+                # taxonomy error — a fleet router retries on a peer
+                self._send(
+                    responses.error_results(str(uuid.uuid4()), None, e), 503)
                 return
             self._send({
                 "id": qid,
@@ -501,16 +524,33 @@ def _make_handler(context, registry: _QueryRegistry, jdbc_meta: bool):
                 self._send(self._empty_results())
                 return
             if path.rstrip("/") == "/v1/health":
-                # readiness for load balancers: 503 while the profile-
-                # driven warm-up is compiling hot query families, 200 once
-                # the process serves them warm (serving/warmup.py); a
-                # context with nothing to warm is ready immediately
+                # readiness for load balancers AND the fleet router: 503
+                # while the profile-driven warm-up is compiling hot query
+                # families (serving/warmup.py) or while draining, 200 once
+                # the process serves them warm; a context with nothing to
+                # warm is ready immediately.  The payload also carries the
+                # pressure band and ledger headroom so one health probe is
+                # everything the router's cost-aware routing loop needs
+                # (fleet/router.py reads the same facts in-process).
                 warm = getattr(context, "warmup", None)
                 if warm is None:
-                    self._send({"status": "ready", "warmed": 0, "total": 0})
+                    payload = {"status": "ready", "warmed": 0, "total": 0}
+                    ready = True
+                else:
+                    payload = dict(warm.status())
+                    ready = warm.ready
+                try:
+                    psnap = context.pressure.snapshot()
+                    payload["band"] = psnap["band"]
+                    payload["headroomBytes"] = psnap["headroomBytes"]
+                except Exception:  # dsql: allow-broad-except — advisory
+                    logger.debug("health: pressure read failed",
+                                 exc_info=True)
+                if server is not None and server.draining.is_set():
+                    payload["status"] = "draining"
+                    self._send(payload, 503)
                     return
-                status = warm.status()
-                self._send(status, 200 if warm.ready else 503)
+                self._send(payload, 200 if ready else 503)
                 return
             if path.rstrip("/") == "/v1/metrics":
                 fmt = (parse_qs(query).get("format") or ["json"])[0].lower()
@@ -616,7 +656,11 @@ class PrestoServer:
 
             create_meta_data(self.context)
         self.registry = _QueryRegistry(context=self.context)
-        handler = _make_handler(self.context, self.registry, jdbc_metadata)
+        #: set when SIGTERM / POST /v1/drain landed: health answers 503
+        #: "draining" and new statements shed with retryable ShutdownError
+        self.draining = threading.Event()
+        handler = _make_handler(self.context, self.registry, jdbc_metadata,
+                                server=self)
         self.httpd = ThreadingHTTPServer((host, port), handler)
         self._thread: Optional[threading.Thread] = None
 
@@ -633,6 +677,22 @@ class PrestoServer:
         self._thread.start()
         return self
 
+    def drain(self, wait: bool = True) -> None:
+        """Graceful drain (SIGTERM / ``POST /v1/drain``): flip health to
+        503-draining, then let the serving runtime finish in-flight work —
+        bounded by ``serving.shutdown.drain_timeout_s``, after which
+        stragglers fail with retryable `ShutdownError` instead of the
+        drain hanging.  The HTTP listener keeps serving so clients can
+        poll out results of queries that finished; a follow-up
+        `shutdown()` closes it."""
+        if self.draining.is_set():
+            return
+        self.draining.set()
+        observability.flight.record("fleet.drain",
+                                    replica=f"server:{self.port}")
+        self.context.metrics.inc("fleet.drain")
+        self.registry.runtime.shutdown(wait=wait)
+
     def shutdown(self):
         self.httpd.shutdown()
         self.httpd.server_close()
@@ -645,9 +705,28 @@ def run_server(context=None, host: str = "0.0.0.0", port: int = 8080,
     """Parity: reference run_server (server/app.py:210 entrypoint)."""
     server = PrestoServer(context, host=host, port=port, jdbc_metadata=jdbc_metadata)
     if blocking:  # pragma: no cover - blocking entrypoint
+        import signal
+
+        def _on_sigterm(signum, frame):
+            # drain off the signal handler's thread: finish in-flight
+            # work (bounded), then stop the listener so serve_forever
+            # returns and the process exits cleanly
+            def _drain_and_exit():
+                server.drain(wait=True)
+                server.httpd.shutdown()
+
+            threading.Thread(target=_drain_and_exit, name="dsql-drain",
+                             daemon=True).start()
+
+        try:
+            signal.signal(signal.SIGTERM, _on_sigterm)
+        except ValueError:
+            pass  # not the main thread: embedder owns signal wiring
         try:
             server.serve_forever()
         except KeyboardInterrupt:
+            pass
+        finally:
             server.shutdown()
         return None
     return server.start_background()

@@ -207,6 +207,20 @@ DOCUMENTED_METRICS = frozenset({
     "chaos.rounds",
     "chaos.queries",
     "chaos.violations",
+    # fleet/ — router fronting N replicas: health-gated cost-aware
+    # routing, mid-query failover, warm-standby promotion, graceful
+    # drain, epoch-fenced write fan-out (docs/fleet.md)
+    "fleet.replicas",
+    "fleet.route",
+    "fleet.route.spill",
+    "fleet.failover",
+    "fleet.promote",
+    "fleet.drain",
+    "fleet.kill",
+    "fleet.write.applied",
+    "fleet.write.fenced",
+    "fleet.write.replayed",
+    "fleet.sync",
 })
 
 #: Prefixes legitimizing *dynamic* metric families (f-string names keyed by
@@ -224,6 +238,7 @@ DOCUMENTED_METRIC_PREFIXES = (
     "serving.scheduler.queue_depth.",    # per admission class (gauge)
     "serving.scheduler.cost_rung_skip.",  # per cost-skipped ladder rung
     "executor.node.",           # per plan-node type (Tracer aggregation)
+    "fleet.routed.",            # per-replica routed-query counter (fleet/router.py)
 )
 
 

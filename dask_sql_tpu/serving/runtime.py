@@ -25,7 +25,7 @@ import threading
 import time
 import uuid
 from collections import deque
-from concurrent.futures import Future
+from concurrent.futures import Future, InvalidStateError
 from typing import Callable, Dict, Optional, Tuple
 
 from ..resilience.errors import ShutdownError
@@ -43,6 +43,24 @@ from .scheduler import PackingScheduler, QueryCost
 logger = logging.getLogger(__name__)
 
 _tls = threading.local()
+
+
+def _resolve(fut: Future, result=None, exc: Optional[BaseException] = None,
+             ) -> bool:
+    """Set a future's outcome, tolerating a future someone else already
+    resolved — the bounded-drain deadline (shutdown) and a replica kill
+    (fleet/replica.py) both fail in-flight futures from OUTSIDE the worker
+    thread, and the worker's own completion must then be a no-op instead
+    of an InvalidStateError crash.  Returns False when the future was
+    already resolved."""
+    try:
+        if exc is not None:
+            fut.set_exception(exc)
+        else:
+            fut.set_result(result)
+        return True
+    except InvalidStateError:
+        return False
 
 
 def current_ticket() -> Optional[QueryTicket]:
@@ -80,7 +98,8 @@ class ServingRuntime:
                  scheduler_budget_bytes: Optional[int] = None,
                  tenant_rate: Optional[float] = None,
                  tenant_burst: float = 4.0,
-                 fair_horizon_s: float = 30.0):
+                 fair_horizon_s: float = 30.0,
+                 drain_timeout_s: float = 30.0):
         self.workers = max(1, int(workers))
         self.metrics = metrics if metrics is not None else MetricsRegistry()
         #: backoff policy for taxonomy-retryable failures (resilience/retry.py)
@@ -119,6 +138,14 @@ class ServingRuntime:
         #: running counter is updated later under its own lock, so checking
         #: it from _pop_locked would let a burst overshoot the cap)
         self._batch_in_flight = 0
+        #: bound on shutdown(wait=True)'s drain: past it, still-running
+        #: queries are cancelled and their futures failed with a retryable
+        #: ShutdownError instead of the drain hanging forever
+        self.drain_timeout_s = max(0.0, float(drain_timeout_s))
+        #: in-flight (popped, running) work: qid -> (ticket, future),
+        #: owned by _cv — what the bounded drain fails at its deadline and
+        #: a replica kill (fleet/replica.py) fails immediately
+        self._inflight: Dict[str, Tuple[QueryTicket, Future]] = {}
         self._shutdown = False
         #: auxiliary background workers (warm-up pass, background
         #: recompiler) that shutdown() must cancel and join — worker queues
@@ -168,6 +195,8 @@ class ServingRuntime:
             tenant_burst=float(config.get("serving.tenant.burst", 4.0)),
             fair_horizon_s=float(
                 config.get("serving.scheduler.fair_horizon_s", 30.0)),
+            drain_timeout_s=float(
+                config.get("serving.shutdown.drain_timeout_s", 30.0)),
         )
 
     def _others_in_flight(self) -> bool:
@@ -303,11 +332,11 @@ class ServingRuntime:
                 self.admission.on_finish(ticket, started=False)
                 if ticket.cancelled:
                     self.metrics.inc("serving.cancelled")
-                    fut.set_exception(
-                        QueryCancelledError(f"query {ticket.qid} cancelled"))
+                    _resolve(fut, exc=QueryCancelledError(
+                        f"query {ticket.qid} cancelled"))
                 else:
                     self.metrics.inc("serving.timeouts")
-                    fut.set_exception(DeadlineExceededError(
+                    _resolve(fut, exc=DeadlineExceededError(
                         f"query {ticket.qid} expired while queued"))
                 self._release(ticket)
                 continue
@@ -316,6 +345,8 @@ class ServingRuntime:
                 # dispatch; anything else waited only for a free worker
                 ticket.queue_reason = "workers_busy"
             self.admission.on_start(ticket)
+            with self._cv:
+                self._inflight[ticket.qid] = (ticket, fut)
             _tls.ticket = ticket
             try:
                 # taxonomy-retryable failures (transient device/runtime
@@ -325,18 +356,20 @@ class ServingRuntime:
                                     ticket=ticket, metrics=self.metrics)
             except QueryCancelledError as e:
                 self.metrics.inc("serving.cancelled")
-                fut.set_exception(e)
+                _resolve(fut, exc=e)
             except DeadlineExceededError as e:
                 self.metrics.inc("serving.timeouts")
-                fut.set_exception(e)
+                _resolve(fut, exc=e)
             except BaseException as e:  # dsql: allow-broad-except — surfaced via Future
                 self.metrics.inc("serving.failed")
-                fut.set_exception(e)
+                _resolve(fut, exc=e)
             else:
                 self.metrics.inc("serving.completed")
-                fut.set_result(result)
+                _resolve(fut, result=result)
             finally:
                 _tls.ticket = None
+                with self._cv:
+                    self._inflight.pop(ticket.qid, None)
                 self.admission.on_finish(ticket)
                 if ticket.started_at is not None:
                     self.metrics.observe(
@@ -375,6 +408,23 @@ class ServingRuntime:
             # shutdown drain: a worker's teardown bug must not propagate
             logger.warning("background worker cancel failed", exc_info=True)
 
+    def fail_inflight(self, exc_factory) -> int:
+        """Fail every in-flight (popped, running) query's future NOW with
+        ``exc_factory(ticket)`` and cancel its ticket — the replica-kill
+        path (fleet/replica.py): a killed process resolves nothing, so the
+        router must see its dispatched futures fail immediately instead of
+        waiting out a result timeout.  The worker threads still unwind
+        their (now-orphaned) executions; their own completion attempts
+        no-op through `_resolve`.  Returns how many futures were failed."""
+        with self._cv:
+            inflight = list(self._inflight.values())
+        failed = 0
+        for ticket, fut in inflight:
+            ticket.cancel()
+            if _resolve(fut, exc=exc_factory(ticket)):
+                failed += 1
+        return failed
+
     def shutdown(self, wait: bool = False, timeout: float = 5.0) -> None:
         """Stop accepting work and drain deterministically.
 
@@ -383,8 +433,16 @@ class ServingRuntime:
         them — instead of hanging on futures no worker will ever pop.
         Registered background workers (the warm-up pass, the background
         recompiler) are cancelled too; ``wait=True`` joins the worker
-        threads AND the background threads (bounded by `timeout` each), so
-        a drained runtime leaves no thread still compiling."""
+        threads AND the background threads, so a drained runtime leaves no
+        thread still compiling.
+
+        The ``wait=True`` drain is BOUNDED by ``drain_timeout_s``
+        (``serving.shutdown.drain_timeout_s``): an in-flight query that
+        has not finished by the deadline — a stuck row-UDF, a wedged
+        device call — has its ticket cancelled and its future failed with
+        a retryable `ShutdownError`, so the drain (and every client
+        blocked on a drained future) terminates instead of hanging
+        forever on work that will never yield."""
         drained = []
         with self._cv:
             self._shutdown = True
@@ -410,8 +468,24 @@ class ServingRuntime:
                 logger.warning("background worker cancel failed",
                                exc_info=True)
         if wait:
+            deadline = time.monotonic() + self.drain_timeout_s
             for t in self._threads:
-                t.join(timeout)
+                t.join(max(0.0, deadline - time.monotonic()))
+            expired = [t for t in self._threads if t.is_alive()]
+            if expired:
+                # deadline: cancel the stuck queries cooperatively AND
+                # fail their futures — the cancel reaches well-behaved
+                # work at its next checkpoint, the future resolution
+                # unblocks clients from work that never checkpoints
+                n = self.fail_inflight(lambda ticket: ShutdownError(
+                    f"query {ticket.qid} shed: drain timeout "
+                    f"({self.drain_timeout_s:g}s) expired at shutdown"))
+                if n:
+                    self.metrics.inc("serving.shutdown_shed", n)
+                    logger.warning(
+                        "shutdown drain timed out after %gs; failed %d "
+                        "in-flight futures with retryable ShutdownError",
+                        self.drain_timeout_s, n)
             for worker in background:
                 worker.join(timeout)
 

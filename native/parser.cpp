@@ -74,6 +74,7 @@ enum Kind : int32_t {
   K_SHOW_METRICS = 101, K_SHOW_PROFILES = 102,
   K_SHOW_QUERIES = 103, K_CANCEL_QUERY = 104,
   K_SHOW_MATERIALIZED = 105, K_INSERT_INTO = 106,
+  K_SHOW_REPLICAS = 107,
 };
 
 // statement flag bits
@@ -604,9 +605,14 @@ class Parser {
       if (accept_keyword("LIKE")) like = b_.intern(next().value);
       return b_.add(K_SHOW_MATERIALIZED, {}, 0, 0, 0.0, like);
     }
+    if (accept_keyword("REPLICAS")) {
+      int32_t like = -1;
+      if (accept_keyword("LIKE")) like = b_.intern(next().value);
+      return b_.add(K_SHOW_REPLICAS, {}, 0, 0, 0.0, like);
+    }
     throw ParseErr{peek().pos,
                    "Expected SCHEMAS, TABLES, COLUMNS, MODELS, METRICS, "
-                   "PROFILES, QUERIES or MATERIALIZED after SHOW"};
+                   "PROFILES, QUERIES, MATERIALIZED or REPLICAS after SHOW"};
   }
 
   int32_t parse_alter() {
@@ -1720,6 +1726,7 @@ void dsql_buf_free(uint8_t* p) { std::free(p); }
 // version 5: SHOW QUERIES (K_SHOW_QUERIES) + CANCEL QUERY (K_CANCEL_QUERY)
 // version 6: SHOW MATERIALIZED (K_SHOW_MATERIALIZED) + INSERT INTO
 // (K_INSERT_INTO) — the semantic-reuse surface
-int32_t dsql_parser_abi_version() { return 6; }
+// version 7: SHOW REPLICAS (K_SHOW_REPLICAS) — the fleet surface
+int32_t dsql_parser_abi_version() { return 7; }
 
 }  // extern "C"

@@ -51,3 +51,26 @@ def test_campaign_is_seed_deterministic_in_armed_plan():
     b = run_campaign(seed=11, queries=8, rounds=2, workers=2)
     assert a.ok and b.ok
     assert a.armed == b.armed
+
+
+@pytest.mark.fleet
+@pytest.mark.parametrize("seed", [1, 2])
+def test_fleet_campaign_replica_kill_invariants(seed, tmp_path):
+    """Replica-kill chaos: a 3-replica fleet with a warm standby survives
+    rounds that kill a live replica mid-storm.  Every query reaches a
+    terminal success-or-structured-retryable outcome, INSERT INTO lands
+    exactly once on every surviving replica (epoch fencing), the standby
+    is promoted, and all ledgers drain back to idle.  The full 5-seed
+    sweep lives in ``bench.py --fleet``; tier-1 keeps two seeds."""
+    from dask_sql_tpu.resilience.chaos import run_fleet_campaign
+
+    report = run_fleet_campaign(seed=seed, queries=12, rounds=3,
+                                replicas=3, clients=4,
+                                sync_dir=str(tmp_path / "sync"))
+    assert report.kills >= 1
+    assert report.promoted >= 1
+    assert report.ok, "invariant violations:\n" + "\n".join(
+        report.violations)
+    assert (report.completed + report.failed
+            + report.shed) == report.submitted
+    assert report.failed == 0

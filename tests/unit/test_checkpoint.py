@@ -101,3 +101,37 @@ def test_mid_write_fault_leaves_previous_snapshot_live(tmp_path):
     c3.load_state(loc)
     out = c3.sql("SELECT SUM(x) AS s FROM t", return_futures=False)
     assert int(out["s"][0]) == 99
+
+
+def test_manifest_carries_table_epochs_and_restore_is_monotone(tmp_path):
+    """Fleet fencing (ISSUE 18 satellite): the snapshot manifest records
+    per-table delta epochs so a promoted standby knows exactly which tail
+    of the router's write log it missed.  load_state restores the epochs,
+    and never rewinds an epoch a live context already advanced past."""
+    c = _ctx()
+    c.sql("INSERT INTO t SELECT i + 100, f, s, b FROM t WHERE i = 1",
+          return_futures=False)
+    c.sql("INSERT INTO t SELECT i + 200, f, s, b FROM t WHERE i = 1",
+          return_futures=False)
+    # create_table bumps the epoch to 1; each INSERT advances it
+    assert c.table_epoch("root", "t") == 3
+
+    loc = str(tmp_path / "snaps")
+    manifest = c.save_state(loc)
+    assert manifest["table_epochs"]["root"]["t"] == 3
+
+    c2 = Context()
+    c2.load_state(loc)
+    assert c2.table_epoch("root", "t") == 3
+    out = c2.sql("SELECT COUNT(*) AS n FROM t", return_futures=False)
+    assert int(out["n"][0]) == 6
+
+    # monotone: a context already ahead of the snapshot keeps its epoch
+    c3 = Context()
+    c3.create_table("t", _frame())
+    for k in range(5):
+        c3.sql("INSERT INTO t SELECT i + %d, f, s, b FROM t WHERE i = 2"
+               % (300 + k), return_futures=False)
+    assert c3.table_epoch("root", "t") == 6
+    c3.load_state(loc)
+    assert c3.table_epoch("root", "t") == 6

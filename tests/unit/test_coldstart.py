@@ -317,6 +317,10 @@ def test_health_endpoint_warming_to_ready(tmp_path, config_keys):
             time.sleep(0.05)
         assert code == 200 and body["status"] == "ready", body
         assert body["warmed"] >= 1
+        # ISSUE 18: the one health probe also carries the routing facts
+        # the fleet router (and a cost-aware LB) needs
+        assert body["band"] in ("green", "yellow", "red", "critical"), body
+        assert "headroomBytes" in body, body
     finally:
         srv.shutdown()
 
@@ -329,6 +333,8 @@ def test_health_ready_with_nothing_to_warm():
     try:
         code, body = _health(srv.port)
         assert code == 200 and body["status"] == "ready"
+        assert body["band"] in ("green", "yellow", "red", "critical"), body
+        assert "headroomBytes" in body, body
     finally:
         srv.shutdown()
 

@@ -1,0 +1,480 @@
+"""Fault-tolerant replica fleet (ISSUE 18): router with health-gated
+cost-aware routing and failover, epoch-fenced write fan-out, warm-standby
+promotion over the checkpoint transport, graceful drain (replica and HTTP
+server), the bounded shutdown drain, per-process flight-dump paths, and
+SHOW REPLICAS.
+
+The chaos-level composition proof (replica-kill campaign) lives in
+tests/unit/test_chaos.py::test_fleet_campaign_* and `bench.py --fleet`;
+this module covers the mechanisms one at a time.
+"""
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from dask_sql_tpu import Context
+from dask_sql_tpu import config as config_module
+from dask_sql_tpu.fleet import (
+    DEAD,
+    DRAINING,
+    READY,
+    STANDBY,
+    Replica,
+    build_fleet,
+)
+from dask_sql_tpu.observability import flight
+from dask_sql_tpu.resilience.errors import ReplicaFailedError, ShutdownError
+
+pytestmark = pytest.mark.fleet
+
+
+@pytest.fixture
+def config_keys():
+    """Update GLOBAL config keys for the test, restoring originals after
+    (worker/warm-up threads read base config, not this thread's overlay)."""
+    cfg = config_module.config
+    saved = {}
+
+    def apply(options):
+        for k, v in options.items():
+            saved.setdefault(k, cfg.get(k))
+        cfg.update(options)
+
+    yield apply
+    cfg.update(saved)
+
+
+def _ctx():
+    c = Context()
+    c.create_table("t", pd.DataFrame({
+        "x": np.arange(8, dtype=np.float64),
+        "g": np.arange(8, dtype=np.int64) % 2,
+    }))
+    return c
+
+
+def _slow_ctx(sleep_s=0.05, rows=4):
+    c = Context()
+    c.create_table("sleepy", pd.DataFrame({
+        "a": np.arange(rows, dtype=np.int64)}))
+
+    def slowid(row):
+        time.sleep(sleep_s)
+        return int(row["a"])
+
+    c.register_function(slowid, "slowid", [("a", np.int64)], np.int64,
+                        row_udf=True)
+    return c
+
+
+# ---------------------------------------------------------------------------
+# routing
+# ---------------------------------------------------------------------------
+def test_router_routes_and_answers():
+    router, members, _ = build_fleet(_ctx, replicas=2)
+    try:
+        out = router.execute("SELECT SUM(x) AS s FROM t", qid="r1")
+        assert int(out["s"][0]) == 28
+        rows = router.rows()
+        assert [r[0] for r in rows] == ["replica-0", "replica-1"]
+        assert sum(int(r[4]) for r in rows) == 1  # routed exactly once
+    finally:
+        router.shutdown()
+
+
+def test_router_health_gates_and_orders_by_headroom():
+    router, members, _ = build_fleet(_ctx, replicas=2)
+    try:
+        # health payload carries the routing facts (satellite 1's shape)
+        h = members[0].health()
+        assert h["status"] == "ready"
+        assert h["band"] in ("green", "yellow", "red", "critical")
+        assert "headroomBytes" in h
+        # a non-READY replica is not routable and never picked
+        members[0].drain(wait=True)
+        assert not members[0].routable
+        out = router.execute("SELECT COUNT(*) AS n FROM t", qid="r2")
+        assert int(out["n"][0]) == 8
+        assert int(dict((r[0], r[4]) for r in router.rows())["replica-1"]) == 1
+    finally:
+        router.shutdown()
+
+
+def test_router_spills_to_peer_on_queue_full(config_keys):
+    # replica queues bounded to 1 with a single worker: a burst must spill
+    # to the peer instead of surfacing 429s while a peer has room
+    config_keys({"serving.workers": 1,
+                 "serving.queue.interactive": 1,
+                 "serving.queue.batch": 1})
+    router, members, _ = build_fleet(_slow_ctx, replicas=2)
+    try:
+        results, errors = [], []
+
+        def client(i):
+            try:
+                results.append(router.execute(
+                    "SELECT SUM(slowid(a)) AS s FROM sleepy",
+                    qid=f"spill-{i}"))
+            except Exception as e:  # noqa: BLE001 — tallied below
+                errors.append(e)
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60)
+        assert not errors, errors
+        assert all(int(r["s"][0]) == 6 for r in results)
+        routed = {r[0]: int(r[4]) for r in router.rows()}
+        assert sum(routed.values()) >= 3
+    finally:
+        router.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# failover
+# ---------------------------------------------------------------------------
+def test_failover_reroutes_killed_replica_midquery():
+    router, members, _ = build_fleet(lambda: _slow_ctx(sleep_s=0.1),
+                                     replicas=2)
+    try:
+        box = {}
+
+        def client():
+            box["out"] = router.execute(
+                "SELECT SUM(slowid(a)) AS s FROM sleepy", qid="kill-mid")
+
+        th = threading.Thread(target=client)
+        th.start()
+        time.sleep(0.15)  # the query is mid-flight on replica-0
+        router.kill("replica-0")
+        th.join(60)
+        assert int(box["out"]["s"][0]) == 6  # answered by the survivor
+        evs = flight.RECORDER.events(name="fleet.failover", qid="kill-mid")
+        assert evs, "failover must be recorded in the flight ring"
+        assert members[0].state == DEAD
+    finally:
+        router.shutdown()
+
+
+def test_replica_failed_error_is_retryable_taxonomy():
+    e = ReplicaFailedError("replica died", query_id="q1")
+    assert e.retryable
+    assert e.code == "REPLICA_FAILED"
+
+
+def test_failover_exhaustion_surfaces_last_error():
+    router, members, _ = build_fleet(_ctx, replicas=2)
+    try:
+        for m in members:
+            m.kill()
+        with pytest.raises(ReplicaFailedError):
+            router.execute("SELECT 1 AS one", qid="dead-fleet")
+    finally:
+        router.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# write fan-out: epoch-fenced exactly-once
+# ---------------------------------------------------------------------------
+def test_write_fans_out_and_fences_duplicates():
+    router, members, _ = build_fleet(_ctx, replicas=2)
+    try:
+        ins = "INSERT INTO t SELECT x + 100, g FROM t WHERE x < 1"
+        router.execute(ins, qid="w1")
+        for m in members:
+            out = m.context.sql("SELECT COUNT(*) AS n FROM t",
+                                return_futures=False)
+            assert int(out["n"][0]) == 9
+            assert m.context.table_epoch("root", "t") == 2
+        # an identical retry is the SAME sequenced write: fenced, no-op
+        router.execute(ins, qid="w1-retry")
+        for m in members:
+            out = m.context.sql("SELECT COUNT(*) AS n FROM t",
+                                return_futures=False)
+            assert int(out["n"][0]) == 9
+        # a textually distinct write is a new sequence slot
+        router.execute("INSERT INTO t SELECT x + 200, g FROM t WHERE x < 1",
+                       qid="w2")
+        for m in members:
+            out = m.context.sql("SELECT COUNT(*) AS n FROM t",
+                                return_futures=False)
+            assert int(out["n"][0]) == 10
+            assert m.context.table_epoch("root", "t") == 3
+    finally:
+        router.shutdown()
+
+
+def test_write_catches_up_replica_behind_the_fence():
+    router, members, _ = build_fleet(_ctx, replicas=2)
+    try:
+        # replica-1 misses a write (killed), then a new member at the same
+        # epoch would be behind; the fan-out's catch-up applies pending
+        # writes in sequence order rather than tripping the fence
+        router.execute("INSERT INTO t SELECT x + 100, g FROM t WHERE x < 1",
+                       qid="wa")
+        late = Replica("late", _ctx())
+        router.replicas.append(late)
+        late.context.fleet_router = router
+        router.execute("INSERT INTO t SELECT x + 200, g FROM t WHERE x < 1",
+                       qid="wb")
+        out = late.context.sql("SELECT COUNT(*) AS n FROM t",
+                               return_futures=False)
+        # late replica caught up: both writes applied exactly once
+        assert int(out["n"][0]) == 10
+        assert late.context.table_epoch("root", "t") == 3
+    finally:
+        router.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# warm-standby promotion over the checkpoint transport
+# ---------------------------------------------------------------------------
+def test_standby_promotion_replays_missed_writes(tmp_path):
+    router, members, repl = build_fleet(
+        _ctx, replicas=2, standby=True, sync_dir=str(tmp_path / "sync"))
+    try:
+        router.execute("SELECT SUM(x) AS s FROM t", qid="warm-1")
+        router.execute("INSERT INTO t SELECT x + 100, g FROM t WHERE x < 1",
+                       qid="pre-sync")
+        repl.sync()
+        # satellite 4: the snapshot manifest carried the table epoch, so
+        # the standby KNOWS it has seen exactly one sequenced write
+        assert router.standby.context.table_epoch("root", "t") == 2
+        router.execute("INSERT INTO t SELECT x + 200, g FROM t WHERE x < 1",
+                       qid="post-sync")
+        router.kill("replica-0")
+        sb = router.find("standby")
+        assert sb.state == READY and sb in router.replicas
+        assert router.standby is None
+        # epoch fencing regression: the promoted standby must serve the
+        # POST-append state — the missed write was replayed at promotion,
+        # and its epoch advanced past the snapshot's
+        out = sb.context.sql("SELECT COUNT(*) AS n FROM t",
+                             return_futures=False)
+        assert int(out["n"][0]) == 10
+        assert sb.context.table_epoch("root", "t") == 3
+        # and the fleet answer agrees with the surviving original
+        via_router = router.execute("SELECT COUNT(*) AS n FROM t",
+                                    qid="after-promote")
+        assert int(via_router["n"][0]) == 10
+        assert flight.RECORDER.events(name="fleet.promote")
+    finally:
+        router.shutdown()
+
+
+def test_standby_not_promoted_when_disabled(config_keys):
+    config_keys({"fleet.standby.auto_promote": False})
+    router, members, _ = build_fleet(_ctx, replicas=2, standby=True)
+    try:
+        router.kill("replica-0")
+        assert router.standby is not None
+        assert router.standby.state == STANDBY
+    finally:
+        router.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# graceful drain + bounded shutdown (satellite 3)
+# ---------------------------------------------------------------------------
+def test_drain_hands_queued_work_back_as_retryable(config_keys):
+    config_keys({"serving.workers": 1})
+    router, members, _ = build_fleet(lambda: _slow_ctx(sleep_s=0.1),
+                                     replicas=2)
+    try:
+        outs = []
+
+        def client(i):
+            outs.append(router.execute(
+                "SELECT SUM(slowid(a)) AS s FROM sleepy", qid=f"dr-{i}"))
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(3)]
+        for t in threads:
+            t.start()
+        time.sleep(0.1)
+        router.drain("replica-0", wait=False)
+        assert members[0].state == DRAINING
+        for t in threads:
+            t.join(60)
+        # every query completed: in-flight finished or was re-dispatched,
+        # queued work came back as retryable ShutdownError and re-routed
+        assert len(outs) == 3
+        assert all(int(o["s"][0]) == 6 for o in outs)
+    finally:
+        router.shutdown()
+
+
+def test_shutdown_drain_timeout_fails_stuck_row_udf(config_keys):
+    from dask_sql_tpu.serving.runtime import ServingRuntime
+
+    config_keys({"serving.shutdown.drain_timeout_s": 0.3})
+    c = _slow_ctx(sleep_s=0.4, rows=6)  # ~2.4s of row-UDF: stuck vs drain
+    rt = ServingRuntime.from_config(c.config, metrics=c.metrics)
+    c.serving = rt
+
+    def job(ticket):
+        return c.sql("SELECT SUM(slowid(a)) AS s FROM sleepy").compute()
+
+    _, fut, ticket = rt.submit(job, qid="stuck-1")
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        with rt._cv:
+            if rt._inflight:
+                break
+        time.sleep(0.01)
+    t0 = time.monotonic()
+    rt.shutdown(wait=True)
+    elapsed = time.monotonic() - t0
+    assert elapsed < 2.0, f"drain must be bounded, took {elapsed:.2f}s"
+    with pytest.raises(ShutdownError) as ei:
+        fut.result(1.0)
+    assert ei.value.retryable
+    assert "drain timeout" in str(ei.value)
+
+
+def test_server_drain_endpoint_and_sigterm_protocol():
+    import urllib.error
+    import urllib.request
+
+    from dask_sql_tpu.server.app import run_server
+
+    srv = run_server(context=_ctx(), host="127.0.0.1", port=0,
+                     blocking=False)
+    try:
+        def health():
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{srv.port}/v1/health") as r:
+                    return r.status, json.loads(r.read())
+            except urllib.error.HTTPError as e:
+                return e.code, json.loads(e.read())
+
+        code, body = health()
+        assert code == 200 and body["status"] == "ready"
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/v1/drain", data=b"", method="POST")
+        with urllib.request.urlopen(req) as r:
+            assert json.loads(r.read())["status"] == "draining"
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            code, body = health()
+            if code == 503 and body["status"] == "draining":
+                break
+            time.sleep(0.02)
+        assert code == 503 and body["status"] == "draining", body
+        # a new statement sheds with a structured 503, not a hang
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/v1/statement",
+            data=b"SELECT 1 AS one", method="POST")
+        deadline = time.monotonic() + 5.0
+        status = None
+        while time.monotonic() < deadline:
+            try:
+                with urllib.request.urlopen(req) as r:
+                    status = r.status
+            except urllib.error.HTTPError as e:
+                status = e.code
+                if status == 503:
+                    payload = json.loads(e.read())
+                    break
+            time.sleep(0.02)
+        assert status == 503
+        assert payload["error"]["errorName"] == "SERVER_SHUTTING_DOWN"
+        assert payload["error"]["retryable"] is True
+    finally:
+        srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# flight-recorder dump-path templating (satellite 2)
+# ---------------------------------------------------------------------------
+def test_expand_dump_path_pid_and_qid():
+    p = flight.expand_dump_path("/tmp/flight-{pid}.jsonl")
+    assert f"flight-{os.getpid()}.jsonl" in p
+    p = flight.expand_dump_path("/tmp/f-{qid}.jsonl", qid="q-1.2")
+    assert p.endswith("f-q-1.2.jsonl")
+    # hostile qids cannot traverse: separators become underscores
+    p = flight.expand_dump_path("/tmp/f-{qid}.jsonl", qid="../../etc/x")
+    assert "/etc/" not in p.replace("/tmp/", "")
+    assert flight.expand_dump_path("/tmp/f-{qid}.jsonl", qid=None) \
+        .endswith("f-unknown.jsonl")
+
+
+def test_two_writers_get_distinct_dump_files(tmp_path, config_keys):
+    # two "replicas" (writers) sharing one dump dir: the {qid} (and {pid})
+    # templating gives each failure its own JSONL file — never interleaved
+    # appends into one file
+    path = str(tmp_path / "flight-{qid}.jsonl")
+    config_keys({"observability.flight.dump_path": path})
+    assert flight.flush_on_failure("writer-a", "OOM",
+                                   config_module.config)
+    assert flight.flush_on_failure("writer-b", "TIMEOUT",
+                                   config_module.config)
+    fa = tmp_path / "flight-writer-a.jsonl"
+    fb = tmp_path / "flight-writer-b.jsonl"
+    assert fa.exists() and fb.exists()
+    for f, qid in ((fa, "writer-a"), (fb, "writer-b")):
+        lines = f.read_text().strip().splitlines()
+        assert len(lines) == 1
+        rec = json.loads(lines[0])  # one intact record per writer
+        assert rec["qid"] == qid
+
+
+# ---------------------------------------------------------------------------
+# SHOW REPLICAS
+# ---------------------------------------------------------------------------
+def test_show_replicas_through_sql():
+    router, members, _ = build_fleet(_ctx, replicas=2, standby=True)
+    try:
+        out = members[0].context.sql("SHOW REPLICAS", return_futures=False)
+        names = list(out["Replica"])
+        assert names == ["replica-0", "replica-1", "standby"]
+        states = dict(zip(out["Replica"], out["State"]))
+        assert states["standby"] == "standby"
+        liked = members[0].context.sql("SHOW REPLICAS LIKE 'replica-%'",
+                                       return_futures=False)
+        assert list(liked["Replica"]) == ["replica-0", "replica-1"]
+    finally:
+        router.shutdown()
+
+
+def test_show_replicas_empty_without_fleet():
+    c = _ctx()
+    out = c.sql("SHOW REPLICAS", return_futures=False)
+    assert len(out) == 0
+    assert list(out.columns) == ["Replica", "State", "Band", "Headroom",
+                                 "Routed"]
+
+
+# ---------------------------------------------------------------------------
+# replica kill semantics
+# ---------------------------------------------------------------------------
+def test_kill_fails_inflight_immediately_with_retryable():
+    r = Replica("solo", _slow_ctx(sleep_s=0.2))
+    box = {}
+
+    def client():
+        try:
+            r.run("SELECT SUM(slowid(a)) AS s FROM sleepy", qid="k1")
+        except Exception as e:  # noqa: BLE001 — the outcome under test
+            box["exc"] = e
+
+    th = threading.Thread(target=client)
+    th.start()
+    time.sleep(0.25)  # mid-query
+    t0 = time.monotonic()
+    n = r.kill()
+    th.join(30)
+    assert n == 1  # the in-flight future was failed by the kill
+    assert isinstance(box.get("exc"), ReplicaFailedError)
+    assert box["exc"].retryable
+    assert time.monotonic() - t0 < 5.0  # kill is immediate, no drain wait
+    assert r.state == DEAD
+    assert flight.RECORDER.events(name="replica.kill")
