@@ -230,6 +230,7 @@ DEFAULTS: Dict[str, Any] = {
     "fleet.failover.max_attempts": 3,  # total dispatch attempts per routed query across replicas
     "fleet.failover.base_s": 0.02,  # first failover backoff delay, seconds (doubles per attempt)
     "fleet.result_timeout_s": 60.0,  # per-dispatch wait before the router declares the replica failed
+    "fleet.failover.suspect_cooldown_s": 5.0,  # a just-failed replica sorts last in candidate order this long
     "fleet.standby.auto_promote": True,  # promote a ready warm standby when a replica dies
     "serving.cache.enabled": True,  # result cache for repeated identical queries
     "serving.cache.max_bytes": 256 << 20,  # total resident bytes before LRU eviction

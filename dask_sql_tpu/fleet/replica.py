@@ -154,6 +154,44 @@ class Replica:
                 f"{timeout:g}s", query_id=qid) from None
 
     # --------------------------------------------------------------- writes
+    def validate_write(self, sql: str, stmt, table_key: Tuple[str, str],
+                       qid: Optional[str] = None) -> None:
+        """Bind a fanned-out write against this replica's catalog WITHOUT
+        executing it: an unknown target table, unknown columns or type
+        errors in the SELECT/VALUES body surface to the client here,
+        BEFORE the router sequences the statement into the write log — a
+        statement that cannot bind must never occupy a fence slot."""
+        from ..resilience.errors import BindingError
+
+        schema_name, table_name = table_key
+        container = self.context.schema.get(schema_name)
+        tables = container.tables if container is not None else {}
+        if table_name not in tables:
+            raise BindingError(
+                f"Table {schema_name}.{table_name} not found", query_id=qid)
+        self.context._get_ral(stmt, sql_text=sql)
+
+    def apply_noop(self, table_key: Tuple[str, str], expected_epoch: int,
+                   qid: Optional[str] = None) -> None:
+        """Advance the table epoch past a TOMBSTONED write-log slot
+        without executing anything, under the same fence semantics as
+        `apply_write` — keeps this replica's epoch aligned with the
+        router's sequence when a poisoned entry is skipped."""
+        state = self.state
+        if state not in (READY, STANDBY):
+            raise ReplicaFailedError(
+                f"replica {self.name} is {state}", query_id=qid)
+        with self._write_lock:
+            current = self.context.table_epoch(*table_key)
+            if current > expected_epoch:
+                return
+            if current < expected_epoch:
+                raise ReplicaFailedError(
+                    f"replica {self.name} is behind on {table_key[0]}."
+                    f"{table_key[1]} (epoch {current} < fence "
+                    f"{expected_epoch}); replay required", query_id=qid)
+            self.context._bump_table_epoch(*table_key)
+
     def apply_write(self, sql: str, table_key: Tuple[str, str],
                     expected_epoch: int, qid: Optional[str] = None):
         """Apply one fanned-out write iff the table's delta epoch equals

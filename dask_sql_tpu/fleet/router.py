@@ -26,7 +26,14 @@ both non-events:
 - **Write fan-out** (INSERT INTO): writes apply on EVERY live replica,
   each stamped with the router's per-table write sequence as the
   expected delta epoch (`Replica.apply_write`): exactly-once no matter
-  how many times failover retries the statement.
+  how many times failover retries the statement.  Statement
+  classification is PARSER-backed (never a regex decision): a single
+  ``InsertInto`` fans out, any other mutating statement is rejected with
+  a structured `UnroutableStatementError` instead of silently executing
+  on one replica and diverging the fleet.  Writes are bound on a live
+  replica BEFORE they are sequenced, and an entry whose apply fails
+  non-retryably is tombstoned — a bad statement can never wedge the
+  per-table write log for every later write.
 """
 from __future__ import annotations
 
@@ -35,16 +42,39 @@ import re
 import threading
 import time
 import uuid
+from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Tuple
 
-from ..resilience.errors import ReplicaFailedError, ShutdownError
+from ..resilience.errors import (
+    ReplicaFailedError,
+    ShutdownError,
+    UnroutableStatementError,
+    classify,
+)
 from ..serving.admission import QueueFullError
 from .replica import DEAD, READY, Replica
 
 logger = logging.getLogger(__name__)
 
-_WRITE_RE = re.compile(r"^\s*insert\s+into\s+([A-Za-z_][\w]*(?:\.[\w]+)?)",
-                       re.IGNORECASE)
+#: cheap trigger deciding which texts pay the router-side parse: only a
+#: statement that MIGHT mutate is parsed for classification.  The regex is
+#: never the decider — quoted names, leading comments etc. all reach the
+#: parser, whose AST says what the statement actually is.
+_MUTATION_TRIGGER_RE = re.compile(r"\b(insert|create|drop|alter|use)\b",
+                                  re.IGNORECASE)
+
+
+@dataclass
+class _WriteEntry:
+    """One sequenced slot in a table's write log.  ``tombstone`` marks an
+    entry whose apply failed non-retryably (user error that slipped past
+    pre-validation): catch-up replays advance the epoch past the slot
+    without re-executing — the poison-pill guard."""
+
+    sql: str
+    qid: str
+    tombstone: bool = False
+    error: Optional[str] = None
 
 
 class Router:
@@ -71,7 +101,17 @@ class Router:
         self._apply_lock = threading.Lock()
         #: global per-table write sequence: the fence every fanned-out
         #: write carries, and the replay source for promoted standbys
-        self._write_log: Dict[Tuple[str, str], List[str]] = {}
+        self._write_log: Dict[Tuple[str, str], List[_WriteEntry]] = {}
+        #: write idempotency index: (table_key, client qid) -> sequence
+        #: slot.  Keyed on the QID, never the SQL text — two intentional
+        #: executions of an identical INSERT under distinct qids are two
+        #: writes; a retry under the original qid dedupes to its slot
+        self._seq_by_qid: Dict[Tuple[Tuple[str, str], str], int] = {}
+        #: per-replica suspect deadlines (monotonic): a replica that just
+        #: failed a dispatch sorts LAST in candidate order until the
+        #: cooldown expires, so failover lands on a different member
+        #: instead of burning every attempt on one wedged replica
+        self._suspect: Dict[str, float] = {}
         #: the table's delta epoch when the router first saw it — fences
         #: are base + position in the log, so a fleet built over tables
         #: with prior epochs keeps counting from where they were
@@ -86,18 +126,27 @@ class Router:
     def _live(self) -> List[Replica]:
         return [r for r in self.replicas if r.state == READY]
 
-    def _candidates(self, cost_bytes: int) -> List[Replica]:
+    def _candidates(self, cost_bytes: int,
+                    avoid: Tuple[str, ...] = ()) -> List[Replica]:
         """Routable replicas, best first: replicas whose headroom fits the
         query's provable cost hint before ones that would overcommit, then
         by descending headroom, then by the scheduler's predicted drain
-        (spill lands on the replica that frees up soonest)."""
+        (spill lands on the replica that frees up soonest).  Members in
+        ``avoid`` (already failed THIS query) or inside their suspect
+        cooldown sort last — still eligible when nothing else is live, but
+        never re-picked first over an untried peer."""
         cands = [r for r in self.replicas if r.routable]
+        now = time.monotonic()
+        with self._lock:
+            suspects = {n for n, until in self._suspect.items()
+                        if until > now}
 
         def key(r: Replica):
             headroom = r.headroom_bytes()
             fits = headroom is None or headroom >= cost_bytes
             drain = r.predicted_drain_s()
-            return (not fits,
+            return (r.name in avoid or r.name in suspects,
+                    not fits,
                     -(headroom if headroom is not None else float("inf")),
                     drain if drain is not None else 0.0)
 
@@ -114,8 +163,15 @@ class Router:
     # ------------------------------------------------------------- failures
     def _note_failure(self, replica: Replica) -> None:
         """A dispatch to ``replica`` failed with a replica-level error:
-        refresh the live gauge and promote the standby if the replica is
-        actually dead (vs merely draining/slow)."""
+        mark it suspect (a timed-out replica stays READY but must not be
+        the next failover's first pick), refresh the live gauge and
+        promote the standby if the replica is actually dead (vs merely
+        draining/slow)."""
+        cooldown = float(self.config.get(
+            "fleet.failover.suspect_cooldown_s", 5.0) or 0.0)
+        if cooldown > 0:
+            with self._lock:
+                self._suspect[replica.name] = time.monotonic() + cooldown
         self.metrics.gauge("fleet.replicas", len(self._live()))
         if replica.state == DEAD:
             self.maybe_promote()
@@ -136,10 +192,21 @@ class Router:
             if warm is not None and not warm.ready:
                 return None
             self.standby = None
-        self._replay_writes(standby)
-        standby.promote()
-        with self._lock:
-            self.replicas.append(standby)
+        # replay + promote + join all under the APPLY lock: no write can
+        # be sequenced-and-applied between the replay and the append, so
+        # a freshly promoted member can never have missed a write and
+        # serve stale reads until the next catch-up
+        with self._apply_lock:
+            try:
+                self._replay_writes(standby)
+            except ReplicaFailedError:
+                logger.warning("standby %s failed during promotion replay;"
+                               " dropping it from the fleet", standby.name,
+                               exc_info=True)
+                return None
+            standby.promote()
+            with self._lock:
+                self.replicas.append(standby)
         flight.record("fleet.promote", replica=standby.name)
         self.metrics.inc("fleet.promote")
         self.metrics.gauge("fleet.replicas", len(self._live()))
@@ -148,20 +215,49 @@ class Router:
         return standby
 
     def _replay_writes(self, replica: Replica) -> None:
-        with self._apply_lock:
-            with self._lock:
-                log_snapshot = {k: list(v)
-                                for k, v in self._write_log.items()}
-                bases = dict(self._epoch_base)
-            for table_key, log in log_snapshot.items():
-                base = bases.get(table_key, 0)
-                # the snapshot a standby restored from carries the table
-                # epochs it captured (checkpoint.py), so `have` is exactly
-                # how many sequenced writes it has seen — replay the tail
-                have = replica.context.table_epoch(*table_key) - base
-                for i in range(max(0, have), len(log)):
-                    replica.apply_write(log[i], table_key, base + i)
-                    self.metrics.inc("fleet.write.replayed")
+        """Replay the write-log tail ``replica`` missed.  Caller holds
+        ``_apply_lock`` (lock order: _apply_lock, then _lock)."""
+        with self._lock:
+            log_snapshot = {k: list(v) for k, v in self._write_log.items()}
+            bases = dict(self._epoch_base)
+        for table_key, log in log_snapshot.items():
+            base = bases.get(table_key, 0)
+            # the snapshot a standby restored from carries the table
+            # epochs it captured (checkpoint.py), so `have` is exactly
+            # how many sequenced writes it has seen — replay the tail
+            have = replica.context.table_epoch(*table_key) - base
+            for i in range(max(0, have), len(log)):
+                self._apply_entry(replica, table_key, base, i, log[i])
+                self.metrics.inc("fleet.write.replayed")
+
+    # ------------------------------------------------------- classification
+    def _classify(self, sql: str):
+        """Parser-backed statement classification (never a regex decision):
+        returns ``("write", InsertInto)`` for a single-statement INSERT
+        INTO, ``("mutation", Statement)`` for any other mutating statement
+        (or a multi-statement script containing one) — the router rejects
+        those — and ``("read", None)`` otherwise.  A text that fails to
+        parse routes as a read: the replica surfaces the real parse error
+        to the client, and an unparseable text cannot be a mutation."""
+        if not _MUTATION_TRIGGER_RE.search(sql):
+            return ("read", None)
+        from ..planner import sqlast as a
+        from ..planner.parser import parse_sql
+
+        try:
+            stmts = parse_sql(sql)
+        except Exception:  # dsql: allow-broad-except — replica reports it
+            return ("read", None)
+        mutation_types = (
+            a.InsertInto, a.CreateTableWith, a.CreateTableAs, a.DropTable,
+            a.CreateSchema, a.DropSchema, a.AlterSchema, a.AlterTable,
+            a.UseSchema, a.CreateModel, a.DropModel, a.CreateExperiment)
+        if len(stmts) == 1 and isinstance(stmts[0], a.InsertInto):
+            return ("write", stmts[0])
+        for stmt in stmts:
+            if isinstance(stmt, mutation_types):
+                return ("mutation", stmt)
+        return ("read", None)
 
     # ------------------------------------------------------------ execution
     def execute(self, sql: str, qid: Optional[str] = None,
@@ -169,12 +265,21 @@ class Router:
                 config_options: Optional[Dict[str, Any]] = None,
                 tenant: Optional[str] = None):
         """Route one statement; blocks for the result.  Reads re-dispatch
-        across replicas on retryable replica failures; writes fan out to
-        every live replica with epoch fencing."""
+        across replicas on retryable replica failures; single-statement
+        INSERT INTO fans out to every live replica with epoch fencing;
+        any other mutation is rejected with a structured user error
+        rather than silently diverging the fleet."""
         qid = qid or str(uuid.uuid4())
-        m = _WRITE_RE.match(sql)
-        if m:
-            return self._write(sql, m.group(1), qid)
+        kind, stmt = self._classify(sql)
+        if kind == "write":
+            return self._write(sql, stmt, qid)
+        if kind == "mutation":
+            self.metrics.inc("fleet.write.unroutable")
+            raise UnroutableStatementError(
+                f"fleet router cannot fan out {type(stmt).__name__}: only "
+                f"single-statement INSERT INTO mutates through the router;"
+                f" apply DDL to every replica at fleet build time",
+                query_id=qid)
         return self._read(sql, qid, priority_class, config_options, tenant)
 
     def _read(self, sql: str, qid: str, priority_class: str,
@@ -189,13 +294,14 @@ class Router:
             "fleet.failover.max_attempts", 3) or 1))
         base_s = float(self.config.get("fleet.failover.base_s", 0.02) or 0.0)
         last_exc: Optional[BaseException] = None
+        avoid: set = set()  # members that already failed THIS query
         for attempt in range(attempts):
-            order = self._candidates(cost_bytes)
+            order = self._candidates(cost_bytes, avoid=tuple(avoid))
             if not order:
                 # nothing routable: a promotion may mint a candidate
                 promoted = self.maybe_promote()
                 if promoted is not None:
-                    order = self._candidates(cost_bytes)
+                    order = self._candidates(cost_bytes, avoid=tuple(avoid))
             if not order:
                 raise last_exc if last_exc is not None else \
                     ReplicaFailedError("no routable replica in the fleet",
@@ -211,10 +317,13 @@ class Router:
                     self._routed[replica.name] = \
                         self._routed.get(replica.name, 0) + 1
                 try:
-                    return replica.run(sql, qid=qid,
-                                       priority_class=priority_class,
-                                       config_options=config_options,
-                                       cost=cost)
+                    out = replica.run(sql, qid=qid,
+                                      priority_class=priority_class,
+                                      config_options=config_options,
+                                      cost=cost)
+                    with self._lock:
+                        self._suspect.pop(replica.name, None)
+                    return out
                 except QueueFullError as e:
                     # saturation is a ROUTING event, not a client error:
                     # spill to the next peer (never a failover attempt)
@@ -227,6 +336,7 @@ class Router:
                     # result cache dedupes re-execution
                     last_exc = e
                     failed_over = True
+                    avoid.add(replica.name)
                     flight.record("fleet.failover", qid=qid,
                                   replica=replica.name,
                                   code=getattr(e, "code", None))
@@ -246,21 +356,71 @@ class Router:
         raise last_exc
 
     # --------------------------------------------------------------- writes
-    def _table_key(self, name: str) -> Tuple[str, str]:
-        if "." in name:
-            schema, _, table = name.partition(".")
-            return (schema, table)
+    def _table_key(self, name_parts: List[str]) -> Tuple[str, str]:
+        parts = [p for p in (name_parts or []) if p]
+        if len(parts) >= 2:
+            return (parts[-2], parts[-1])
+        table = parts[0] if parts else ""
         schema = self._live()[0].context.schema_name if self._live() \
             else "root"
-        return (schema, name)
+        return (schema, table)
 
-    def _write(self, sql: str, target: str, qid: str):
+    def _apply_entry(self, replica: Replica, table_key: Tuple[str, str],
+                     base: int, i: int, entry: _WriteEntry):
+        """Apply write-log slot ``i`` on one replica (caller holds
+        ``_apply_lock``).  Returns ``(result, poison_error)``.  A
+        tombstoned entry advances the replica's epoch past the slot
+        without executing.  An apply that fails NON-retryably (a user
+        error that slipped past pre-validation, e.g. an incompatible
+        column set) POISONS the slot: the entry becomes a tombstone so
+        every later catch-up replay skips it instead of re-raising the
+        same error forever, and the structured error comes back for the
+        sequencing client.  Retryable failures (replica died, transient
+        resource exhaustion) re-raise as `ReplicaFailedError` and leave
+        the entry live — this replica catches up on the next write."""
+        if entry.tombstone:
+            replica.apply_noop(table_key, base + i, qid=entry.qid)
+            return None, None
+        try:
+            return replica.apply_write(entry.sql, table_key, base + i,
+                                       qid=entry.qid), None
+        except ReplicaFailedError:
+            raise
+        except Exception as exc:  # dsql: allow-broad-except — split below
+            err = classify(exc, query_id=entry.qid)
+            if err.retryable:
+                raise ReplicaFailedError(
+                    f"replica {replica.name} failed write {entry.qid} "
+                    f"({err.code}); will catch up",
+                    query_id=entry.qid) from exc
+            entry.tombstone = True
+            entry.error = f"{err.code}: {exc}"
+            self.metrics.inc("fleet.write.poisoned")
+            logger.warning(
+                "write %s poisoned the %s.%s log at slot %d (%s); "
+                "tombstoned so later writes are not wedged",
+                entry.qid, table_key[0], table_key[1], i, err.code)
+            replica.apply_noop(table_key, base + i, qid=entry.qid)
+            return None, err
+
+    def _write(self, sql: str, stmt, qid: str):
         """Fan a write out to every live replica under one epoch fence.
         The statement lands exactly once per replica no matter how many
-        times a client or the failover loop retries it: the fence is the
-        router's global per-table write sequence, and `apply_write`
-        no-ops when a replica's epoch already advanced past it."""
-        table_key = self._table_key(target)
+        times a client or the failover loop retries it under the same
+        qid: the fence is the router's global per-table write sequence,
+        and `apply_write` no-ops when a replica's epoch already advanced
+        past it.  An identical statement under a DISTINCT qid is a new
+        write with its own sequence slot."""
+        table_key = self._table_key(stmt.table)
+        with self._lock:
+            sequenced = (table_key, qid) in self._seq_by_qid
+        if not sequenced:
+            # bind on a live member BEFORE sequencing: a statement that
+            # cannot bind (unknown table/column, type error) must never
+            # occupy a fence slot — the poison-pill guard's front door
+            live = self._live()
+            if live:
+                live[0].validate_write(sql, stmt, table_key, qid=qid)
         with self._lock:
             log = self._write_log.setdefault(table_key, [])
             if table_key not in self._epoch_base:
@@ -268,16 +428,14 @@ class Router:
                 self._epoch_base[table_key] = \
                     live[0].context.table_epoch(*table_key) if live else 0
             base = self._epoch_base[table_key]
-            if sql in log:
-                # idempotent client retry of an already-sequenced write:
-                # catch-up below re-applies on stragglers only — an
-                # identical statement never gets a second sequence slot
-                idx = log.index(sql)
-            else:
+            idx = self._seq_by_qid.get((table_key, qid))
+            if idx is None:
                 idx = len(log)
-                log.append(sql)
+                log.append(_WriteEntry(sql=sql, qid=qid))
+                self._seq_by_qid[(table_key, qid)] = idx
         result = None
         applied = 0
+        poison = None
         failed: List[Replica] = []
         with self._apply_lock:
             with self._lock:
@@ -292,8 +450,10 @@ class Router:
                     # epoch fence would (correctly) reject ours as early
                     have = replica.context.table_epoch(*table_key) - base
                     for i in range(max(0, have), len(pending)):
-                        out = replica.apply_write(pending[i], table_key,
-                                                  base + i, qid=qid)
+                        out, err = self._apply_entry(replica, table_key,
+                                                     base, i, pending[i])
+                        if err is not None and i == idx:
+                            poison = err
                         if i == idx and out is not None and result is None:
                             result = out
                     applied += 1
@@ -304,6 +464,10 @@ class Router:
             # outside the apply lock: a promotion triggered here replays
             # the write log, which re-takes it
             self._note_failure(replica)
+        if poison is not None:
+            # OUR statement was the poison: the structured user error
+            # reaches this client; the log stays healthy for later writes
+            raise poison
         if applied == 0:
             raise ReplicaFailedError(
                 f"write {qid} applied on no replica", query_id=qid)

@@ -514,9 +514,9 @@ def run_fleet_campaign(seed: int, queries: int = 30, rounds: int = 3,
                         qid = f"fleet-{seed}.{nonce}-{rnd}-{i}"
                         tasks.append((sql, cls, qopts, qid, False))
                     for j in range(2):
-                        # textually unique per (round, slot): the router's
-                        # write log dedupes identical statements as client
-                        # retries of ONE write
+                        # the router's write log dedupes on the client qid
+                        # (retries below re-use it); the per-(round, slot)
+                        # tag keeps inserted rows distinguishable
                         tag = 10000 + rnd * 100 + j
                         tasks.append((
                             f"INSERT INTO t_small SELECT a + {tag}, b "

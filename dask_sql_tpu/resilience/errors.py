@@ -181,6 +181,20 @@ class ReplicaFailedError(QueryError):
     retryable = True
 
 
+class UnroutableStatementError(QueryError, ValueError):
+    """A catalog- or session-mutating statement the fleet router cannot
+    safely fan out (CREATE/DROP/ALTER, model statements, USE SCHEMA,
+    multi-statement scripts containing a mutation).  Only single-statement
+    ``INSERT INTO`` mutates through the router's epoch-fenced write
+    fan-out; executing any other mutation on a single routed replica would
+    silently diverge the members' catalogs and poison the per-table epoch
+    fences, so the router rejects it up front — apply such DDL to every
+    replica at fleet build time instead."""
+
+    code = "FLEET_UNROUTABLE"
+    error_type = USER_ERROR
+
+
 class ModelError(QueryError, ValueError):
     """CREATE MODEL / PREDICT / EXPORT MODEL failed on the model layer
     (unresolvable model_class, fit/predict raising, bad WITH options).

@@ -220,6 +220,8 @@ DOCUMENTED_METRICS = frozenset({
     "fleet.write.applied",
     "fleet.write.fenced",
     "fleet.write.replayed",
+    "fleet.write.poisoned",
+    "fleet.write.unroutable",
     "fleet.sync",
 })
 

@@ -193,20 +193,145 @@ def test_write_fans_out_and_fences_duplicates():
                                 return_futures=False)
             assert int(out["n"][0]) == 9
             assert m.context.table_epoch("root", "t") == 2
-        # an identical retry is the SAME sequenced write: fenced, no-op
-        router.execute(ins, qid="w1-retry")
+        # a retry under the SAME qid is the same sequenced write: fenced
+        router.execute(ins, qid="w1")
         for m in members:
             out = m.context.sql("SELECT COUNT(*) AS n FROM t",
                                 return_futures=False)
             assert int(out["n"][0]) == 9
-        # a textually distinct write is a new sequence slot
-        router.execute("INSERT INTO t SELECT x + 200, g FROM t WHERE x < 1",
-                       qid="w2")
+        # an IDENTICAL statement under a distinct qid is an intentional
+        # second write — its own sequence slot, applied again everywhere
+        router.execute(ins, qid="w1-again")
         for m in members:
             out = m.context.sql("SELECT COUNT(*) AS n FROM t",
                                 return_futures=False)
             assert int(out["n"][0]) == 10
             assert m.context.table_epoch("root", "t") == 3
+        # a textually distinct write is a new sequence slot too
+        router.execute("INSERT INTO t SELECT x + 200, g FROM t WHERE x < 1",
+                       qid="w2")
+        for m in members:
+            out = m.context.sql("SELECT COUNT(*) AS n FROM t",
+                                return_futures=False)
+            assert int(out["n"][0]) == 11
+            assert m.context.table_epoch("root", "t") == 4
+    finally:
+        router.shutdown()
+
+
+def test_write_bind_error_never_enters_the_log():
+    # poison-pill guard, front door: a statement that cannot bind is
+    # rejected BEFORE sequencing — the log stays empty and later writes
+    # are not wedged behind a permanently failing entry
+    from dask_sql_tpu.resilience.errors import QueryError
+
+    router, members, _ = build_fleet(_ctx, replicas=2)
+    try:
+        with pytest.raises(Exception) as ei:
+            router.execute("INSERT INTO t SELECT nosuch FROM t", qid="bad1")
+        assert isinstance(ei.value, QueryError)
+        assert not ei.value.retryable
+        with pytest.raises(Exception):
+            router.execute("INSERT INTO nosuchtable SELECT x FROM t",
+                           qid="bad2")
+        assert router.snapshot()["writeLog"] in ({}, {"root.t": 0})
+        # the log was never poisoned: a valid write still lands everywhere
+        router.execute("INSERT INTO t SELECT x + 100, g FROM t WHERE x < 1",
+                       qid="good1")
+        for m in members:
+            out = m.context.sql("SELECT COUNT(*) AS n FROM t",
+                                return_futures=False)
+            assert int(out["n"][0]) == 9
+            assert m.context.table_epoch("root", "t") == 2
+    finally:
+        router.shutdown()
+
+
+def test_write_apply_failure_tombstones_instead_of_wedging():
+    # poison-pill guard, back door: a statement that binds but fails at
+    # apply (incompatible column set surfaces only at the append) is
+    # tombstoned — the client gets the structured error once, and every
+    # subsequent write proceeds past the slot on all replicas
+    from dask_sql_tpu.resilience.errors import QueryError
+
+    router, members, _ = build_fleet(_ctx, replicas=2)
+    try:
+        with pytest.raises(Exception) as ei:
+            router.execute("INSERT INTO t SELECT x FROM t WHERE x < 1",
+                           qid="poison")
+        assert isinstance(ei.value, QueryError)
+        assert not ei.value.retryable
+        # the poisoned slot advanced the fence on every replica (noop)
+        for m in members:
+            assert m.context.table_epoch("root", "t") == 2
+        # later writes are NOT wedged behind the poisoned entry
+        router.execute("INSERT INTO t SELECT x + 100, g FROM t WHERE x < 1",
+                       qid="after-poison")
+        for m in members:
+            out = m.context.sql("SELECT COUNT(*) AS n FROM t",
+                                return_futures=False)
+            assert int(out["n"][0]) == 9
+            assert m.context.table_epoch("root", "t") == 3
+        # a retry of the poisoned qid dedupes to the tombstone: no effect
+        with_retry = router.execute(
+            "INSERT INTO t SELECT x FROM t WHERE x < 1", qid="poison")
+        assert with_retry is None
+        for m in members:
+            out = m.context.sql("SELECT COUNT(*) AS n FROM t",
+                                return_futures=False)
+            assert int(out["n"][0]) == 9
+    finally:
+        router.shutdown()
+
+
+def test_classification_is_parser_backed_not_regex():
+    from dask_sql_tpu.resilience.errors import UnroutableStatementError
+
+    router, members, _ = build_fleet(_ctx, replicas=2)
+    try:
+        # a leading comment defeated the old regex and would have routed
+        # this INSERT to a single replica, diverging the fleet
+        router.execute("-- append\nINSERT INTO t "
+                       "SELECT x + 100, g FROM t WHERE x < 1", qid="c1")
+        for m in members:
+            out = m.context.sql("SELECT COUNT(*) AS n FROM t",
+                                return_futures=False)
+            assert int(out["n"][0]) == 9
+            assert m.context.table_epoch("root", "t") == 2
+        # non-INSERT mutations are rejected up front with a structured
+        # user error instead of executing on one replica
+        for sql in ("CREATE TABLE u AS (SELECT x FROM t)",
+                    "DROP TABLE t",
+                    "ALTER TABLE t RENAME TO t2"):
+            with pytest.raises(UnroutableStatementError) as ei:
+                router.execute(sql, qid=f"ddl-{hash(sql) & 0xffff}")
+            assert not ei.value.retryable
+        # nothing diverged: both replicas still agree on catalog + epoch
+        for m in members:
+            assert m.context.table_epoch("root", "t") == 2
+            assert "t" in m.context.schema["root"].tables
+            assert "u" not in m.context.schema["root"].tables
+    finally:
+        router.shutdown()
+
+
+def test_failover_deprioritizes_just_failed_replica():
+    router, members, _ = build_fleet(_ctx, replicas=2)
+    try:
+        # a per-query avoid set puts the failed member last
+        order = router._candidates(0, avoid=("replica-0",))
+        assert [r.name for r in order][-1] == "replica-0"
+        # a replica-level failure marks the member suspect: it sorts last
+        # for every query until the cooldown expires, even while READY
+        router._note_failure(members[0])
+        assert members[0].state == READY
+        order = router._candidates(0)
+        assert [r.name for r in order][-1] == "replica-0"
+        # end to end: the next query routes around the suspect member
+        out = router.execute("SELECT COUNT(*) AS n FROM t", qid="avoid-0")
+        assert int(out["n"][0]) == 8
+        routed = {r[0]: int(r[4]) for r in router.rows()}
+        assert routed == {"replica-0": 0, "replica-1": 1}
     finally:
         router.shutdown()
 
