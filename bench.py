@@ -797,10 +797,18 @@ def run_predict_smoke():
 
 
 def run_lint_smoke():
-    """`bench.py --lint`: static-analysis smoke.
+    """`bench.py --lint`: static + runtime concurrency-analysis smoke.
 
-    Runs the engine self-lint (must be clean) and an `EXPLAIN LINT` of the
-    benchmark query (must verify with zero errors), printing one JSON line.
+    Three gates, one JSON line, exit 1 on any failure:
+
+    1. engine self-lint (all rules DSQL101-603, including the repo-wide
+       lock-order pass) must be clean;
+    2. `EXPLAIN LINT` of the benchmark query must verify with zero errors;
+    3. a 2-replica fleet booted with the runtime lock sanitizer ON serves
+       concurrent reads plus a fanned-out INSERT INTO with ZERO
+       ``lock.order_violation`` flight events — the dynamic counterpart
+       of gate 1's DSQL601.
+
     Pure host work — safe to run on every change without touching devices.
     """
     from dask_sql_tpu.analysis import self_lint
@@ -816,13 +824,61 @@ def run_lint_smoke():
     c.create_table("lineitem", gen_lineitem(10_000, seed=0))
     rows = list(c.sql("EXPLAIN LINT " + QUERY, return_futures=False)["LINT"])
     errors = sum(1 for r in rows if r.startswith("error["))
-    ok = not findings and errors == 0
+
+    # gate 3: the sanitizer watching the full declared rank order
+    # (router.apply 10 -> ... -> observability.flight 95) under a real
+    # concurrent fleet workload
+    from concurrent.futures import ThreadPoolExecutor
+
+    from dask_sql_tpu import config as _config_module
+    from dask_sql_tpu.fleet import build_fleet
+    from dask_sql_tpu.observability import flight
+    from dask_sql_tpu.runtime import locks as runtime_locks
+
+    _config_module.config.update({"analysis.lock_sanitizer": True})
+    lock_baseline = runtime_locks.violation_count()
+    flight_baseline = len(flight.RECORDER.events(name="lock.order_violation"))
+    df = gen_lineitem(5_000, seed=1)
+
+    def factory():
+        fc = Context()  # arms the sanitizer (analysis.lock_sanitizer)
+        fc.create_table("lineitem", df)
+        return fc
+
+    router, members, _replicator = build_fleet(factory, replicas=2,
+                                               standby=False)
+    try:
+        with ThreadPoolExecutor(max_workers=4,
+                                thread_name_prefix="lint-fleet") as pool:
+            futs = [pool.submit(router.execute, QUERY, f"lint-r{i}")
+                    for i in range(6)]
+            futs.append(pool.submit(
+                router.execute,
+                "INSERT INTO lineitem SELECT * FROM lineitem LIMIT 5",
+                "lint-w0"))
+            fleet_results = [f.result(300.0) for f in futs]
+    finally:
+        router.shutdown()
+    lock_violations = runtime_locks.violation_count() - lock_baseline
+    flight_violations = len(flight.RECORDER.events(
+        name="lock.order_violation")) - flight_baseline
+    fleet_ok = (all(r is not None for r in fleet_results)
+                and lock_violations == 0 and flight_violations == 0)
+    for v in runtime_locks.violations()[-max(lock_violations, 0):] \
+            if lock_violations else []:
+        print(f"  LOCK VIOLATION: {v['kind']}: holding {v['holding']} "
+              f"acquiring {v['acquiring']} on {v['thread']}", flush=True)
+
+    ok = not findings and errors == 0 and fleet_ok
     print(json.dumps({
         "metric": "static_analysis_smoke",
         "ok": bool(ok),
         "self_lint_findings": len(findings),
         "explain_lint_errors": errors,
         "explain_lint_rows": len(rows),
+        "fleet_queries": len(fleet_results),
+        "lock_order_violations": int(lock_violations),
+        "lock_sanitizer_edges": len(runtime_locks.snapshot()["edges"]),
     }), flush=True)
     if not ok:
         raise SystemExit(1)

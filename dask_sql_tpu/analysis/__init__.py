@@ -21,12 +21,16 @@ Three parts (see docs/analysis.md):
   (``serving.admission.max_estimated_bytes``), result-cache admission,
   and proof-driven ladder rung pre-skips.
 
-- **Engine self-lint** (`selflint.py`): an AST analyzer over the engine's
-  own source (``python -m dask_sql_tpu.analysis --self``) with rules for
-  broad exception handlers that can swallow taxonomy errors (DSQL101),
-  lock-coverage gaps on the serving path (DSQL201), and host-sync calls
-  inside jit-traced code (DSQL301).  Run as a tier-1 test so regressions
-  fail CI.
+- **Engine self-lint** (`selflint.py` + `concurrency.py`): an AST
+  analyzer over the engine's own source (``python -m dask_sql_tpu.analysis
+  --self``) with rules for broad exception handlers that can swallow
+  taxonomy errors (DSQL101), lock-coverage gaps on the serving path
+  (DSQL201), host-sync calls inside jit-traced code (DSQL301), metric and
+  flight-event vocabulary drift (DSQL401/501), and the concurrency suite
+  (DSQL601 repo-wide lock-order cycles, DSQL602 blocking calls under a
+  held lock, DSQL603 the ``_locked``-suffix contract).  Run as a tier-1
+  test so regressions fail CI; the runtime counterpart of DSQL601 is the
+  lock sanitizer in runtime/locks.py.
 """
 from .estimator import (
     Interval,

@@ -4,10 +4,16 @@
 Exit code 0 = clean, 1 = findings, 2 = bad invocation.  CI runs ``--self``
 (also wired as a tier-1 test in tests/unit/test_analysis.py and the
 ``bench.py --lint`` smoke mode).
+
+``--rule DSQLnnn`` (repeatable) restricts the report to specific rules so
+a pre-commit hook can gate on e.g. the concurrency rules alone;
+``--format json`` emits a machine-readable report (one object with
+``findings`` / ``files`` / ``rules``) so CI can diff findings across runs.
 """
 from __future__ import annotations
 
 import argparse
+import json
 import sys
 
 from .selflint import RULES, lint_paths, package_files, self_lint
@@ -21,6 +27,12 @@ def main(argv=None) -> int:
                         help="lint the installed engine package")
     parser.add_argument("--rules", action="store_true",
                         help="list rule ids and exit")
+    parser.add_argument("--rule", action="append", default=[],
+                        metavar="DSQLnnn",
+                        help="report only this rule id (repeatable)")
+    parser.add_argument("--format", choices=("text", "json"),
+                        default="text",
+                        help="output format (default: text)")
     parser.add_argument("paths", nargs="*", help="python files to lint")
     args = parser.parse_args(argv)
 
@@ -31,6 +43,11 @@ def main(argv=None) -> int:
     if not args.self_mode and not args.paths:
         parser.print_usage(sys.stderr)
         return 2
+    unknown = [r for r in args.rule if r not in RULES and r != "DSQL000"]
+    if unknown:
+        print(f"unknown rule id(s): {', '.join(unknown)} "
+              f"(--rules lists them)", file=sys.stderr)
+        return 2
 
     if args.self_mode:
         findings = self_lint()
@@ -38,9 +55,25 @@ def main(argv=None) -> int:
     else:
         findings = lint_paths(args.paths)
         n_files = len(args.paths)
-    for f in findings:
-        print(f.format())
-    print(f"self-lint: {len(findings)} finding(s) in {n_files} file(s)")
+    if args.rule:
+        wanted = set(args.rule)
+        findings = [f for f in findings if f.rule in wanted]
+
+    if args.format == "json":
+        print(json.dumps({
+            "findings": [
+                {"rule": f.rule, "path": f.path, "line": f.line,
+                 "message": f.message}
+                for f in findings
+            ],
+            "files": n_files,
+            "rules": sorted(args.rule) if args.rule else sorted(RULES),
+        }, indent=2))
+    else:
+        for f in findings:
+            print(f.format())
+        print(f"self-lint: {len(findings)} finding(s) in "
+              f"{n_files} file(s)")
     return 1 if findings else 0
 
 

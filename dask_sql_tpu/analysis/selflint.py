@@ -54,6 +54,31 @@ DSQL501  flight-recorder event vocabulary
     Same literal/prefix machinery as DSQL401; suppress deliberate
     one-offs with ``# dsql: allow-flight-event``.
 
+DSQL601  lock-order cycle (whole-repo; analysis/concurrency.py)
+    A cycle in the repo-wide lock-acquisition graph (every ``with
+    self.<lock>`` / ``.acquire()`` site, one interprocedural level
+    through same-class/same-module helpers) is a potential deadlock;
+    the finding carries both witness paths.  Suppress a deliberate
+    edge with ``# dsql: allow-lock-order``.
+
+DSQL602  blocking call under a held lock (analysis/concurrency.py)
+    jit/compile entry points, h2d/d2h transfers, ``time.sleep``,
+    socket/HTTP and ``subprocess`` calls inside a lock-guarded region
+    convoy every other thread behind one slow call.  Suppress a
+    justified site with ``# dsql: allow-blocking-under-lock``.
+
+DSQL603  ``_locked``-suffix convention (analysis/concurrency.py)
+    Bidirectional: a ``*_locked`` function acquiring its own lock
+    breaks the contract its name states; a non-``_locked`` callee of a
+    locked region that mutates guarded attributes off-lock should be
+    renamed to carry the contract.  Suppress with
+    ``# dsql: allow-locked-naming``.
+
+The runtime counterpart of DSQL601 is the lock sanitizer
+(runtime/locks.py): NamedLock ranks + the dynamic order graph verify
+the same invariant over executed schedules, wired into the chaos
+campaigns.
+
 Suppression comments live on the offending line or the line above it, so
 ``git blame`` keeps the reason next to the decision.
 """
@@ -70,6 +95,9 @@ RULES: Dict[str, str] = {
     "DSQL301": "host-sync call inside jit-traced code",
     "DSQL401": "metric name not in the documented metric registry",
     "DSQL501": "flight-recorder event not in the registered vocabulary",
+    "DSQL601": "lock-order cycle across the repo lock graph",
+    "DSQL602": "blocking or device call under a held lock",
+    "DSQL603": "_locked-suffix convention violated",
 }
 
 _SUPPRESS = {
@@ -78,6 +106,9 @@ _SUPPRESS = {
     "DSQL301": "dsql: allow-host-sync",
     "DSQL401": "dsql: allow-metric-name",
     "DSQL501": "dsql: allow-flight-event",
+    "DSQL601": "dsql: allow-lock-order",
+    "DSQL602": "dsql: allow-blocking-under-lock",
+    "DSQL603": "dsql: allow-locked-naming",
 }
 
 #: modules whose closure factories build jit-traced kernels: a nested def
@@ -491,6 +522,11 @@ def _check_flight_events(tree: ast.AST, path: str,
 # driver
 # ---------------------------------------------------------------------------
 def lint_source(source: str, path: str) -> List[LintFinding]:
+    """Every per-file rule over one source text.  DSQL601 is repo-wide
+    (a cycle's halves usually live in different files) and runs in
+    `lint_paths` / `concurrency.lock_order_findings` instead."""
+    from .concurrency import check_blocking_under_lock, check_locked_naming
+
     try:
         tree = ast.parse(source)
     except SyntaxError as e:
@@ -503,15 +539,22 @@ def lint_source(source: str, path: str) -> List[LintFinding]:
     out += _check_host_sync(tree, path, lines)
     out += _check_metric_names(tree, path, lines)
     out += _check_flight_events(tree, path, lines)
+    out += check_blocking_under_lock(tree, path, lines)
+    out += check_locked_naming(tree, path, lines)
     return sorted(out, key=lambda f: (f.path, f.line, f.rule))
 
 
 def lint_paths(paths: Iterable[str]) -> List[LintFinding]:
+    from .concurrency import lock_order_findings
+
+    sources: Dict[str, str] = {}
     findings: List[LintFinding] = []
     for path in paths:
         with open(path, "r", encoding="utf-8") as f:
-            findings.extend(lint_source(f.read(), path))
-    return findings
+            sources[path] = f.read()
+        findings.extend(lint_source(sources[path], path))
+    findings.extend(lock_order_findings(sources))
+    return sorted(findings, key=lambda f: (f.path, f.line, f.rule))
 
 
 def package_files(root: Optional[str] = None) -> List[str]:

@@ -127,6 +127,13 @@ DEFAULTS: Dict[str, Any] = {
     "analysis.estimate.feedback": True,
     "analysis.estimate.feedback.margin": 2.0,  # safety multiple over the observed max
     "analysis.estimate.feedback.min_obs": 2,  # observed executions before feedback applies
+    # Runtime lock sanitizer (runtime/locks.py, docs/analysis.md "Lock
+    # ranks"): NamedLock rank + order-graph checking on every blocking
+    # acquire, raising LockOrderError BEFORE a deadlock can form.  Off in
+    # production (per-acquire bookkeeping on hot locks); the test suite
+    # turns it on globally in tests/conftest.py, and a Context whose
+    # config enables it arms the process-wide sanitizer (never disarms).
+    "analysis.lock_sanitizer": False,
     # Parameterized plan families (families/, docs/serving.md "Plan
     # families and batching"): post-optimize literal extraction into a
     # runtime parameter vector.  One XLA executable then serves every

@@ -26,6 +26,7 @@ import numpy as np
 
 from . import config as config_module
 from . import observability
+from .runtime import locks as runtime_locks
 from .columnar.dtypes import SqlType, np_to_sql
 from .columnar.table import Table
 from .datacontainer import (
@@ -411,8 +412,10 @@ class Context:
         #: guards _plan_cache and _catalog_buf_cache: one Context serves
         #: every worker thread of the Presto server, and an unguarded
         #: OrderedDict move_to_end/popitem pair racing across threads
-        #: corrupts the LRU order or KeyErrors (self-lint rule DSQL201)
-        self._plan_lock = threading.Lock()
+        #: corrupts the LRU order or KeyErrors (self-lint rule DSQL201).
+        #: rank 55: nests inside replica write locks; planning/compiles
+        #: happen OUTSIDE it (singleflight in physical/compiled.py)
+        self._plan_lock = runtime_locks.named_lock("context.plan_cache")
         #: bumped on every view/function (re)definition or drop
         self._catalog_serial = 0
         from .serving.cache import ResultCache
@@ -421,6 +424,13 @@ class Context:
         #: serving metrics registry: query/cache/executor counters and
         #: latency histograms (SHOW METRICS, server /v1/metrics)
         self.metrics = MetricsRegistry()
+        # arm the process-wide lock sanitizer when this context's config
+        # asks for it (arming is one-way: a later default-config Context
+        # must not disarm a suite that opted in), and point its
+        # violation counters at this registry
+        if self.config.get("analysis.lock_sanitizer", False):
+            runtime_locks.set_enabled(True)
+        runtime_locks.attach_metrics(self.metrics)
         #: materialized-result cache (serving/cache.py); keyed via
         #: _result_cache_key so DDL/DML versions entries out
         self._result_cache = ResultCache(

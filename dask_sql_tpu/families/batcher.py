@@ -26,6 +26,8 @@ import logging
 import threading
 from typing import Any, Callable, Dict, List, Optional
 
+from ..runtime import locks
+
 logger = logging.getLogger(__name__)
 
 #: upper bound on how long a follower waits for its leader's launch; the
@@ -79,7 +81,9 @@ class FamilyBatcher:
         #: waits the window with certainty instead of guessing from the
         #: in-flight heuristic (0 / None when no scheduler or no family)
         self._mates = mates
-        self._lock = threading.Lock()
+        # rank 50: only group-dict bookkeeping runs under this lock —
+        # leaders execute and members wait on per-group Events OUTSIDE it
+        self._lock = locks.named_lock("families.batcher")
         self._groups: Dict[Any, _Group] = {}
 
     # ----------------------------------------------------------------- run

@@ -38,6 +38,7 @@ from concurrent.futures import TimeoutError as FutureTimeoutError
 from typing import Any, Dict, Optional, Tuple
 
 from ..resilience.errors import ReplicaFailedError
+from ..runtime import locks
 
 logger = logging.getLogger(__name__)
 
@@ -56,11 +57,15 @@ class Replica:
         self.runtime = ServingRuntime.from_config(
             context.config, metrics=context.metrics)
         context.serving = self.runtime
-        self._lock = threading.Lock()
+        # rank 30: lifecycle state, taken from under the router's apply
+        # lock (rank 10) during promotion
+        self._lock = locks.named_lock("fleet.replica.state")
         self._state = STANDBY if standby else READY
         #: serializes write application so fence-check + apply is atomic
-        #: per replica (concurrent routed reads are unaffected)
-        self._write_lock = threading.Lock()
+        #: per replica (concurrent routed reads are unaffected).  rank 32:
+        #: held across context.sql (plan cache rank 55, registry 70,
+        #: metrics 90) — deliberate per-replica write serialization
+        self._write_lock = locks.named_lock("fleet.replica.write")
         #: per-replica dispatch suffix: the router re-dispatches the SAME
         #: client qid across replicas/attempts, but each runtime submit
         #: needs its own scheduler identity

@@ -45,6 +45,7 @@ import uuid
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Tuple
 
+from ..runtime import locks
 from ..resilience.errors import (
     ReplicaFailedError,
     ShutdownError,
@@ -93,12 +94,16 @@ class Router:
         from .. import config as config_module
 
         self.config = config if config is not None else config_module.config
-        self._lock = threading.Lock()
+        # rank 20: membership/epoch state — taken from under _apply_lock
+        # (rank 10) during fan-out and promotion, never the reverse
+        self._lock = locks.named_lock("fleet.router.state")
         #: serializes write APPLICATION (fan-out and promotion replay):
         #: sequencing happens under `_lock`, but applies must land in
         #: sequence order or concurrent writers would trip each other's
-        #: epoch fences ("behind, replay required") on every replica
-        self._apply_lock = threading.Lock()
+        #: epoch fences ("behind, replay required") on every replica.
+        #: rank 10: the fleet's outermost lock — held across replica
+        #: apply/replay/promote, which takes replica + context locks
+        self._apply_lock = locks.named_lock("fleet.router.apply")
         #: global per-table write sequence: the fence every fanned-out
         #: write carries, and the replay source for promoted standbys
         self._write_log: Dict[Tuple[str, str], List[_WriteEntry]] = {}

@@ -33,6 +33,7 @@ from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
+from ..runtime import locks
 from .programs import ModelProgram, try_lower
 
 logger = logging.getLogger(__name__)
@@ -42,7 +43,10 @@ logger = logging.getLogger(__name__)
 #: is replaced on next use after a swap and dropped by `invalidate`.
 _Entry = Tuple[Any, Optional[ModelProgram], str, bool]
 
-_lock = threading.Lock()
+# rank 70: the publish lock.  Lowering AND the h2d weight commit run
+# OUTSIDE it (see program_for) — only dict publishes happen under it,
+# so it nests safely inside any serving/fleet lock
+_lock = locks.named_lock("inference.registry")
 
 
 def _registry(context) -> Dict[Tuple[str, str], _Entry]:

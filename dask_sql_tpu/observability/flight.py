@@ -33,6 +33,8 @@ import logging
 import threading
 import time
 from collections import deque
+
+from ..runtime import locks
 from typing import Any, Dict, List, Optional
 
 logger = logging.getLogger(__name__)
@@ -80,6 +82,9 @@ EVENT_NAMES = frozenset({
     "pressure.reclaim",
     # chaos campaign harness (resilience/chaos.py)
     "chaos.arm",
+    # runtime lock sanitizer (runtime/locks.py): a rank inversion or
+    # order-graph cycle caught before the acquire blocked
+    "lock.order_violation",
     # fleet tier (fleet/): routing, failover, promotion, drain, kill
     "fleet.route",
     "fleet.failover",
@@ -108,7 +113,9 @@ class FlightRecorder:
     """Bounded ring of ``{ts, event, qid?, **attrs}`` dicts."""
 
     def __init__(self, capacity: int = 4096):
-        self._lock = threading.Lock()
+        # leaf rank: nothing is acquired while the ring lock is held, so
+        # any thread may record from under any other sanitized lock
+        self._lock = locks.named_lock("observability.flight")
         self._ring: "deque[Dict[str, Any]]" = deque(
             maxlen=max(16, int(capacity)))
         self.recorded = 0

@@ -40,6 +40,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
+from ..runtime import locks as runtime_locks
+
 logger = logging.getLogger(__name__)
 
 #: process-unique campaign nonce folded into every qid: the flight
@@ -171,6 +173,7 @@ def run_campaign(seed: int, queries: int = 40, rounds: int = 4,
     report = ChaosReport(seed=seed)
     saved = list(config_module.config.effective_items())
     faults.reset()
+    lock_baseline = runtime_locks.violation_count()
     try:
         config_module.config.update(dict(_BASE_CONFIG))
         context = _build_context(rng)
@@ -282,7 +285,8 @@ def run_campaign(seed: int, queries: int = 40, rounds: int = 4,
             # drain FIRST: the thread/ledger/reservation invariants are
             # statements about the engine's state after a clean shutdown
             runtime.shutdown(wait=True)
-            _check_invariants(report, context, runtime, qids)
+            _check_invariants(report, context, runtime, qids,
+                              lock_baseline=lock_baseline)
         finally:
             runtime.shutdown(wait=True)
     finally:
@@ -320,7 +324,7 @@ def _finisher(context, qid: str):
 
 
 def _check_invariants(report: ChaosReport, context, runtime,
-                      qids: List[str]) -> None:
+                      qids: List[str], lock_baseline: int = 0) -> None:
     """The global post-drain invariants; appends human-readable violation
     strings to the report (and counts ``chaos.violations``)."""
     from ..observability import flight
@@ -400,6 +404,18 @@ def _check_invariants(report: ChaosReport, context, runtime,
         if finishes and admits and admits[0]["ts"] > finishes[0]["ts"]:
             violate(f"{qid}: query.admit after query.finish")
 
+    # 6. no lock-order violation observed (runtime/locks.py sanitizer —
+    # a no-op unless the suite armed it; the storm IS the stress test
+    # for the declared rank order)
+    excess = runtime_locks.violation_count() - lock_baseline
+    if excess:
+        details = "; ".join(
+            f"{v['kind']}: holding {v['holding']} acquiring "
+            f"{v['acquiring']} on {v['thread']}"
+            for v in runtime_locks.violations()[-excess:])
+        violate(f"{excess} lock-order violation(s) during the storm "
+                f"({details})")
+
 
 # ===================================================================== fleet
 @dataclass
@@ -454,6 +470,7 @@ def run_fleet_campaign(seed: int, queries: int = 30, rounds: int = 3,
     saved = list(config_module.config.effective_items())
     faults.reset()
     nonce = next(_campaign_nonce)
+    lock_baseline = runtime_locks.violation_count()
     try:
         config_module.config.update({
             **_BASE_CONFIG,
@@ -595,6 +612,20 @@ def run_fleet_campaign(seed: int, queries: int = 30, rounds: int = 3,
                     report.violations.append(
                         f"{r.name}: ledger still holds {reserved} reserved "
                         f"bytes after fleet drain")
+
+            # no lock-order violation observed (runtime/locks.py): the
+            # kill/failover/promotion storm exercises the full declared
+            # rank order — router apply -> router state -> replica
+            # state/write -> plan cache -> registry -> metrics/flight
+            excess = runtime_locks.violation_count() - lock_baseline
+            if excess:
+                details = "; ".join(
+                    f"{v['kind']}: holding {v['holding']} acquiring "
+                    f"{v['acquiring']} on {v['thread']}"
+                    for v in runtime_locks.violations()[-excess:])
+                report.violations.append(
+                    f"{excess} lock-order violation(s) during the "
+                    f"fleet storm ({details})")
         finally:
             router.shutdown()
     finally:

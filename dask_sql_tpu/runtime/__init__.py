@@ -1,0 +1,11 @@
+"""Process-runtime primitives shared by every layer.
+
+This package sits BELOW observability/serving/resilience in the import
+graph (stdlib-only imports at module scope), so the lock sanitizer can
+wrap the flight recorder's and metrics registry's own locks without a
+cycle.  `locks` is the runtime tier of the ISSUE 19 concurrency suite;
+the static tier lives in analysis/concurrency.py.
+"""
+from . import locks  # noqa: F401
+from .locks import (DECLARED_RANKS, LockOrderError, NamedLock,  # noqa: F401
+                    named_condition, named_lock)

@@ -20,6 +20,8 @@ import threading
 import time
 from typing import Dict, Optional
 
+from ..runtime import locks
+
 from ..resilience.errors import (
     INSUFFICIENT_RESOURCES,
     CancelledError,
@@ -279,7 +281,9 @@ class AdmissionController:
         self.workers = max(1, int(workers))
         self.retry_after_s = float(retry_after_s)
         self.metrics = metrics
-        self._lock = threading.Lock()
+        # rank 45: taken from under the runtime's cv (rank 40) on the
+        # shed path; only leaf work (counter math, metrics) happens here
+        self._lock = locks.named_lock("serving.admission")
         self.waiting = {c: 0 for c in CLASSES}
         self.running = {c: 0 for c in CLASSES}
         self._latency_sum = 0.0

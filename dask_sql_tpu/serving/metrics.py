@@ -9,9 +9,10 @@ Aggregation from the per-node `Tracer` happens through `observe_trace`.
 """
 from __future__ import annotations
 
-import threading
 from collections import deque
 from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+from ..runtime import locks
 
 
 # ---------------------------------------------------------------------------
@@ -24,6 +25,9 @@ from typing import Any, Dict, Iterable, List, Optional, Tuple
 #: fails CI.  Add the name here (with the emitting site) when introducing
 #: a metric; docs/serving.md and docs/analysis.md describe the families.
 DOCUMENTED_METRICS = frozenset({
+    # runtime/locks.py — lock sanitizer (ISSUE 19)
+    "analysis.locks.order_violation",
+    "analysis.locks.registered",
     # analysis/ — plan verifier + cost/memory estimator
     "analysis.verify.runs",
     "analysis.plan_error",
@@ -321,7 +325,9 @@ class MetricsRegistry:
     ``SHOW METRICS``."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        # leaf rank (90): counters are bumped from under every other
+        # subsystem's lock, and nothing is acquired while this is held
+        self._lock = locks.named_lock("serving.metrics")
         self._counters: Dict[str, int] = {}
         self._gauges: Dict[str, float] = {}
         self._hists: Dict[str, Histogram] = {}

@@ -29,6 +29,7 @@ from concurrent.futures import Future, InvalidStateError
 from typing import Callable, Dict, Optional, Tuple
 
 from ..resilience.errors import ShutdownError
+from ..runtime import locks
 from ..resilience.retry import BackoffPolicy, retry_call
 from .admission import (
     CLASSES,
@@ -133,7 +134,9 @@ class ServingRuntime:
             tenant_rate=tenant_rate, tenant_burst=tenant_burst,
             fair_horizon_s=fair_horizon_s,
             metrics=self.metrics) if scheduler_enabled else None
-        self._cv = threading.Condition()
+        # rank 40: held while calling admission.on_finish (rank 45) on
+        # the shed path, so cv-before-admission is the declared order
+        self._cv = locks.named_condition("serving.runtime.cv")
         #: batch queries popped-but-not-finished, owned by _cv (admission's
         #: running counter is updated later under its own lock, so checking
         #: it from _pop_locked would let a burst overshoot the cap)

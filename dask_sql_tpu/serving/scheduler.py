@@ -31,8 +31,12 @@ engine already computes:
 
 Locking: the scheduler owns NO lock.  Every mutating method is named
 ``*_locked`` or documented as called under the owning runtime's condition
-variable (`ServingRuntime._cv`) — the same discipline the legacy deques had.
-Metric gauges/counters are leaf calls (MetricsRegistry has its own lock).
+variable (`ServingRuntime._cv`, sanitizer name "serving.runtime.cv", rank
+40 in the declared order — runtime/locks.py) — the same discipline the
+legacy deques had.  Metric gauges/counters are leaf calls
+(MetricsRegistry's own lock is the rank-90 leaf "serving.metrics"); the
+static side of this contract is checked by DSQL603 (a ``*_locked`` method
+here must never acquire a lock itself).
 
 ``serving.scheduler.enabled = false`` removes this module from the pop path
 entirely — the runtime keeps its original FIFO deques, byte-unaware and

@@ -32,6 +32,18 @@ import pytest
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# The lock sanitizer (runtime/locks.py, ISSUE 19) is ON for the whole
+# suite: every test thread's lock acquisitions feed the process-global
+# order graph, and any rank inversion / cycle raises LockOrderError at
+# the acquire instead of deadlocking a worker.  Set both the config
+# default (so Contexts built from config agree) and the module switch
+# (so locks taken before the first Context exists are sanitized too).
+from dask_sql_tpu import config as _config_module
+from dask_sql_tpu.runtime import locks as _runtime_locks
+
+_config_module.config.update({"analysis.lock_sanitizer": True})
+_runtime_locks.set_enabled(True)
+
 
 @pytest.fixture
 def df_simple():

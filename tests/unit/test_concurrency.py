@@ -1,0 +1,415 @@
+"""Static concurrency rules (analysis/concurrency.py, ISSUE 19):
+DSQL601 repo-wide lock-order cycles, DSQL602 blocking calls under a held
+lock, DSQL603 the ``_locked``-suffix contract — synthetic positive,
+suppressed and clean cases per rule, plus the parametrized suppression
+test shared by EVERY DSQL rule (its token silences exactly its own rule,
+on the offending line only) and the ``--format json`` / ``--rule`` CLI.
+"""
+import json
+
+import pytest
+
+from dask_sql_tpu.analysis.concurrency import lock_order_findings
+from dask_sql_tpu.analysis.selflint import RULES, _SUPPRESS, lint_source
+
+pytestmark = [pytest.mark.analysis, pytest.mark.concurrency]
+
+
+def rules_of(findings):
+    return [f.rule for f in findings]
+
+
+def _findings(rule, src):
+    """The right driver per rule: DSQL601 is the repo-wide pass, every
+    other rule runs per-file through lint_source."""
+    if rule == "DSQL601":
+        return lock_order_findings({"f.py": src})
+    return lint_source(src, "f.py")
+
+
+# --------------------------------------------------------------- DSQL601
+CYCLE_SRC = """\
+import threading
+
+class A:
+    def __init__(self):
+        self.a = threading.Lock()
+        self.b = threading.Lock()
+
+    def m1(self):
+        with self.a:
+            with self.b:{mark}
+                pass
+
+    def m2(self):
+        with self.b:
+            with self.a:
+                pass
+"""
+
+
+def test_lock_order_cycle_reports_both_witness_paths():
+    findings = lock_order_findings({"f.py": CYCLE_SRC.format(mark="")})
+    assert rules_of(findings) == ["DSQL601"]
+    msg = findings[0].message
+    # both directions of the cycle, each with its file:line witness
+    assert "A.a -> A.b at f.py:10" in msg
+    assert "A.b -> A.a at f.py:15" in msg
+
+
+def test_lock_order_cycle_across_files():
+    # the two halves of the cycle live in different files — the rule
+    # must merge edges repo-wide before looking for cycles
+    one = ("import threading\n"
+           "class R:\n"
+           "    def __init__(self):\n"
+           "        self.a = threading.Lock()\n"
+           "        self.b = threading.Lock()\n"
+           "    def m(self):\n"
+           "        with self.a:\n"
+           "            with self.b:\n"
+           "                pass\n")
+    two = one.replace("with self.a:\n", "with self.TMP:\n").replace(
+        "with self.b:\n", "with self.a:\n").replace(
+        "with self.TMP:\n", "with self.b:\n")
+    assert lock_order_findings({"one.py": one}) == []
+    assert lock_order_findings({"two.py": two}) == []
+    both = lock_order_findings({"one.py": one, "two.py": two})
+    assert rules_of(both) == ["DSQL601"]
+    assert "one.py" in both[0].message and "two.py" in both[0].message
+
+
+def test_lock_order_interprocedural_one_level():
+    src = ("import threading\n"
+           "class A:\n"
+           "    def __init__(self):\n"
+           "        self.a = threading.Lock()\n"
+           "        self.b = threading.Lock()\n"
+           "    def m1(self):\n"
+           "        with self.a:\n"
+           "            with self.b:\n"
+           "                pass\n"
+           "    def m2(self):\n"
+           "        with self.b:\n"
+           "            self.helper()\n"
+           "    def helper(self):\n"
+           "        with self.a:\n"
+           "            pass\n")
+    findings = lock_order_findings({"f.py": src})
+    assert rules_of(findings) == ["DSQL601"]
+    assert "via helper()" in findings[0].message
+
+
+def test_lock_order_self_reacquire_is_flagged():
+    src = ("import threading\n"
+           "class A:\n"
+           "    def __init__(self):\n"
+           "        self.a = threading.Lock()\n"
+           "    def m(self):\n"
+           "        with self.a:\n"
+           "            self.helper()\n"
+           "    def helper(self):\n"
+           "        with self.a:\n"
+           "            pass\n")
+    findings = lock_order_findings({"f.py": src})
+    assert rules_of(findings) == ["DSQL601"]
+    assert "re-acquired" in findings[0].message
+
+
+def test_lock_order_consistent_nesting_is_clean():
+    src = ("import threading\n"
+           "class A:\n"
+           "    def __init__(self):\n"
+           "        self.a = threading.Lock()\n"
+           "        self.b = threading.Lock()\n"
+           "    def m1(self):\n"
+           "        with self.a:\n"
+           "            with self.b:\n"
+           "                pass\n"
+           "    def m2(self):\n"
+           "        with self.a:\n"
+           "            with self.b:\n"
+           "                pass\n")
+    assert lock_order_findings({"f.py": src}) == []
+
+
+def test_lock_order_sees_module_locks_and_acquire_calls():
+    src = ("import threading\n"
+           "_a = threading.Lock()\n"
+           "_b = threading.Lock()\n"
+           "def m1():\n"
+           "    with _a:\n"
+           "        _b.acquire()\n"
+           "def m2():\n"
+           "    with _b:\n"
+           "        with _a:\n"
+           "            pass\n")
+    findings = lock_order_findings({"f.py": src})
+    assert rules_of(findings) == ["DSQL601"]
+    assert "f.py:_a" in findings[0].message
+    assert "f.py:_b" in findings[0].message
+
+
+def test_lock_order_named_locks_are_tracked():
+    # migrated sites (runtime/locks.py NamedLock) stay visible
+    src = ("from dask_sql_tpu.runtime import locks\n"
+           "class A:\n"
+           "    def __init__(self):\n"
+           "        self.a = locks.named_lock('x.a')\n"
+           "        self.b = locks.named_lock('x.b')\n"
+           "    def m1(self):\n"
+           "        with self.a:\n"
+           "            with self.b:\n"
+           "                pass\n"
+           "    def m2(self):\n"
+           "        with self.b:\n"
+           "            with self.a:\n"
+           "                pass\n")
+    assert rules_of(lock_order_findings({"f.py": src})) == ["DSQL601"]
+
+
+# --------------------------------------------------------------- DSQL602
+BLOCKING_SRC = """\
+import threading
+import time
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def f(self):
+        with self._lock:
+            time.sleep(0.1){mark}
+"""
+
+
+@pytest.mark.parametrize("call,expect", [
+    ("time.sleep(0.1)", True),
+    ("jax.jit(fn)(x)", True),
+    ("jax.device_put(x)", True),
+    ("np.asarray(x)", True),
+    ("jnp.asarray(x)", True),
+    ("requests.get('http://x')", True),
+    ("subprocess.check_call(['ls'])", True),
+    ("x.block_until_ready()", True),
+    ("fut.result(5)", True),
+    ("self.helper(x)", False),          # ordinary call: fine
+    ("array(x)", False),                # bare name, not a transfer ns
+    ("self._lock.release()", False),
+])
+def test_blocking_under_lock_catalog(call, expect):
+    src = BLOCKING_SRC.format(mark="").replace("time.sleep(0.1)", call)
+    found = [f for f in lint_source(src, "f.py") if f.rule == "DSQL602"]
+    assert bool(found) == expect, (call, found)
+
+
+def test_blocking_in_locked_suffix_function_is_flagged():
+    # a *_locked body runs under its caller's lock by convention
+    src = ("import numpy as np\n"
+           "def refresh_locked(state):\n"
+           "    state.buf = np.asarray(state.pending)\n")
+    found = [f for f in lint_source(src, "f.py") if f.rule == "DSQL602"]
+    assert len(found) == 1 and "np.asarray" in found[0].message
+
+
+def test_blocking_outside_lock_is_clean():
+    src = ("import threading\n"
+           "import time\n"
+           "class C:\n"
+           "    def __init__(self):\n"
+           "        self._lock = threading.Lock()\n"
+           "    def f(self):\n"
+           "        with self._lock:\n"
+           "            n = 1\n"
+           "        time.sleep(0.1)\n")
+    assert [f for f in lint_source(src, "f.py")
+            if f.rule == "DSQL602"] == []
+
+
+def test_blocking_in_nested_closure_is_not_charged_to_the_lock():
+    # a closure defined under the lock runs on its own schedule
+    src = ("import threading\n"
+           "import time\n"
+           "class C:\n"
+           "    def __init__(self):\n"
+           "        self._lock = threading.Lock()\n"
+           "    def f(self):\n"
+           "        with self._lock:\n"
+           "            def later():\n"
+           "                time.sleep(0.1)\n"
+           "            return later\n")
+    assert [f for f in lint_source(src, "f.py")
+            if f.rule == "DSQL602"] == []
+
+
+# --------------------------------------------------------------- DSQL603
+def test_locked_suffix_function_acquiring_own_lock_is_flagged():
+    src = ("import threading\n"
+           "class D:\n"
+           "    def __init__(self):\n"
+           "        self._lock = threading.Lock()\n"
+           "    def go_locked(self):\n"
+           "        with self._lock:\n"
+           "            pass\n")
+    found = [f for f in lint_source(src, "f.py") if f.rule == "DSQL603"]
+    assert len(found) == 1 and "go_locked" in found[0].message
+
+
+def test_locked_suffix_module_function_acquiring_module_lock():
+    src = ("import threading\n"
+           "_lock = threading.Lock()\n"
+           "def publish_locked(entry):\n"
+           "    _lock.acquire()\n")
+    found = [f for f in lint_source(src, "f.py") if f.rule == "DSQL603"]
+    assert len(found) == 1
+
+
+def test_unlocked_callee_touching_guarded_attrs_is_flagged():
+    src = ("import threading\n"
+           "class B:\n"
+           "    def __init__(self):\n"
+           "        self._lock = threading.Lock()\n"
+           "        self.count = 0\n"
+           "    def run(self):\n"
+           "        with self._lock:\n"
+           "            self.count += 1\n"
+           "            self.bump()\n"
+           "    def bump(self):\n"
+           "        self.count += 1  # dsql: allow-unlocked — caller holds\n")
+    found = [f for f in lint_source(src, "f.py") if f.rule == "DSQL603"]
+    assert len(found) == 1 and "bump_locked" in found[0].message
+
+
+def test_locked_named_callee_is_clean():
+    src = ("import threading\n"
+           "class B:\n"
+           "    def __init__(self):\n"
+           "        self._lock = threading.Lock()\n"
+           "        self.count = 0\n"
+           "    def run(self):\n"
+           "        with self._lock:\n"
+           "            self.count += 1\n"
+           "            self.bump_locked()\n"
+           "    def bump_locked(self):\n"
+           "        self.count += 1\n")
+    assert [f for f in lint_source(src, "f.py")
+            if f.rule == "DSQL603"] == []
+
+
+def test_locked_suffix_taking_a_foreign_lock_is_clean():
+    # _locked promises "my OWN lock is held"; touching another object's
+    # lock is not this rule's business
+    src = ("import threading\n"
+           "class D:\n"
+           "    def go_locked(self, other):\n"
+           "        with other.lock:\n"
+           "            pass\n")
+    assert [f for f in lint_source(src, "f.py")
+            if f.rule == "DSQL603"] == []
+
+
+# ----------------------------------------------- suppression machinery
+# One minimal offender per rule.  ``{mark}`` sits at the END of the
+# offending line, ``line`` is the reported lineno — the shared test
+# proves each token silences exactly its own rule, on that line only.
+_OFFENDERS = {
+    "DSQL101": ("try:\n"
+                "    x = 1\n"
+                "except Exception:{mark}\n"
+                "    pass\n", 3),
+    "DSQL201": ("import threading\n"
+                "class R:\n"
+                "    def __init__(self):\n"
+                "        self._lock = threading.Lock()\n"
+                "        self.n = 0\n"
+                "    def a(self):\n"
+                "        with self._lock:\n"
+                "            self.n = 1\n"
+                "    def b(self):\n"
+                "        self.n = 2{mark}\n", 10),
+    "DSQL301": ("import jax\n"
+                "import numpy as np\n"
+                "def k(x):\n"
+                "    return np.asarray(x){mark}\n"
+                "f = jax.jit(k)\n", 4),
+    "DSQL401": ("def f(metrics):\n"
+                "    metrics.inc('totally.bogus.metric'){mark}\n", 2),
+    "DSQL501": ("def f(flight):\n"
+                "    flight.record('totally.bogus.event'){mark}\n", 2),
+    "DSQL601": (CYCLE_SRC, 10),
+    "DSQL602": (BLOCKING_SRC, 10),
+    "DSQL603": ("import threading\n"
+                "class D:\n"
+                "    def __init__(self):\n"
+                "        self._lock = threading.Lock()\n"
+                "    def go_locked(self):\n"
+                "        with self._lock:{mark}\n"
+                "            pass\n", 6),
+}
+
+
+@pytest.mark.parametrize("rule", sorted(_OFFENDERS))
+def test_suppression_token_silences_exactly_its_own_rule(rule):
+    template, line = _OFFENDERS[rule]
+    token = _SUPPRESS[rule]
+
+    # bare: the rule fires at the expected line
+    bare = _findings(rule, template.format(mark=""))
+    assert rule in rules_of(bare), bare
+    assert any(f.line == line for f in bare if f.rule == rule)
+
+    # its own token on the offending line: silenced
+    own = _findings(rule, template.format(mark=f"  # {token} — reason"))
+    assert rule not in rules_of(own), own
+
+    # a DIFFERENT rule's token on the same line: NOT silenced
+    other_rule = next(r for r in sorted(_SUPPRESS) if r != rule)
+    other = _findings(
+        rule, template.format(mark=f"  # {_SUPPRESS[other_rule]}"))
+    assert rule in rules_of(other), other
+
+    # its own token on an UNRELATED line (decoy comment prepended, so
+    # every lineno shifts by one): NOT silenced
+    decoy = _findings(rule, f"# {token}\n" + template.format(mark=""))
+    assert rule in rules_of(decoy), decoy
+
+
+def test_every_rule_has_a_suppression_token_and_catalog_entry():
+    assert set(_SUPPRESS) == set(RULES)
+    tokens = list(_SUPPRESS.values())
+    assert len(set(tokens)) == len(tokens), "suppression tokens collide"
+
+
+# --------------------------------------------------------------- the CLI
+def test_cli_rule_filter_and_json(tmp_path, capsys):
+    from dask_sql_tpu.analysis.__main__ import main
+
+    bad = tmp_path / "bad.py"
+    bad.write_text(CYCLE_SRC.format(mark="")
+                   + "\ntry:\n    x = 1\nexcept Exception:\n    pass\n")
+
+    # unfiltered: both rules fire, exit 1
+    assert main([str(bad)]) == 1
+    out = capsys.readouterr().out
+    assert "DSQL601" in out and "DSQL101" in out
+
+    # --rule keeps only the asked-for rule
+    assert main(["--rule", "DSQL101", str(bad)]) == 1
+    out = capsys.readouterr().out
+    assert "DSQL101" in out and "DSQL601" not in out
+
+    # --format json round-trips
+    assert main(["--format", "json", "--rule", "DSQL601", str(bad)]) == 1
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["rules"] == ["DSQL601"]
+    assert [f["rule"] for f in payload["findings"]] == ["DSQL601"]
+    assert payload["findings"][0]["path"] == str(bad)
+
+    # a clean file filtered to one rule exits 0
+    ok = tmp_path / "ok.py"
+    ok.write_text("x = 1\n")
+    assert main(["--format", "json", str(ok)]) == 0
+    assert json.loads(capsys.readouterr().out)["findings"] == []
+
+    # unknown rule id: usage error
+    assert main(["--rule", "DSQL999", str(ok)]) == 2
