@@ -801,8 +801,9 @@ def run_lint_smoke():
 
     Three gates, one JSON line, exit 1 on any failure:
 
-    1. engine self-lint (all rules DSQL101-603, including the repo-wide
-       lock-order pass) must be clean;
+    1. engine self-lint (all rules DSQL101-703, including the repo-wide
+       lock-order pass and the CFG-based effect-lifecycle rules) must be
+       clean — a per-rule findings table is printed either way;
     2. `EXPLAIN LINT` of the benchmark query must verify with zero errors;
     3. a 2-replica fleet booted with the runtime lock sanitizer ON serves
        concurrent reads plus a fanned-out INSERT INTO with ZERO
@@ -812,10 +813,19 @@ def run_lint_smoke():
     Pure host work — safe to run on every change without touching devices.
     """
     from dask_sql_tpu.analysis import self_lint
+    from dask_sql_tpu.analysis.selflint import RULES
 
     findings = self_lint()
     for f in findings:
         print(f.format(), flush=True)
+    by_rule = {rule: 0 for rule in sorted(RULES)}
+    for f in findings:
+        by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
+    width = max(len(r) for r in by_rule)
+    print(f"  {'rule':<{width}}  findings  description", flush=True)
+    for rule, count in sorted(by_rule.items()):
+        desc = RULES.get(rule, "syntax error")
+        print(f"  {rule:<{width}}  {count:>8}  {desc}", flush=True)
 
     _ensure_backend()
     from dask_sql_tpu import Context
@@ -874,6 +884,7 @@ def run_lint_smoke():
         "metric": "static_analysis_smoke",
         "ok": bool(ok),
         "self_lint_findings": len(findings),
+        "findings_by_rule": {r: n for r, n in sorted(by_rule.items()) if n},
         "explain_lint_errors": errors,
         "explain_lint_rows": len(rows),
         "fleet_queries": len(fleet_results),

@@ -8,7 +8,9 @@ Exit code 0 = clean, 1 = findings, 2 = bad invocation.  CI runs ``--self``
 ``--rule DSQLnnn`` (repeatable) restricts the report to specific rules so
 a pre-commit hook can gate on e.g. the concurrency rules alone;
 ``--format json`` emits a machine-readable report (one object with
-``findings`` / ``files`` / ``rules``) so CI can diff findings across runs.
+``findings`` / ``files`` / ``rules``) so CI can diff findings across
+runs; ``--format sarif`` emits a minimal SARIF 2.1.0 log so code-scanning
+UIs (GitHub, VS Code SARIF viewers) can render findings in place.
 """
 from __future__ import annotations
 
@@ -30,7 +32,7 @@ def main(argv=None) -> int:
     parser.add_argument("--rule", action="append", default=[],
                         metavar="DSQLnnn",
                         help="report only this rule id (repeatable)")
-    parser.add_argument("--format", choices=("text", "json"),
+    parser.add_argument("--format", choices=("text", "json", "sarif"),
                         default="text",
                         help="output format (default: text)")
     parser.add_argument("paths", nargs="*", help="python files to lint")
@@ -59,7 +61,9 @@ def main(argv=None) -> int:
         wanted = set(args.rule)
         findings = [f for f in findings if f.rule in wanted]
 
-    if args.format == "json":
+    if args.format == "sarif":
+        print(json.dumps(_sarif(findings), indent=2))
+    elif args.format == "json":
         print(json.dumps({
             "findings": [
                 {"rule": f.rule, "path": f.path, "line": f.line,
@@ -75,6 +79,38 @@ def main(argv=None) -> int:
         print(f"self-lint: {len(findings)} finding(s) in "
               f"{n_files} file(s)")
     return 1 if findings else 0
+
+
+def _sarif(findings) -> dict:
+    """Minimal SARIF 2.1.0 log: one run, the full rule catalog in the
+    driver, one ``result`` per finding with a physical location."""
+    return {
+        "$schema": ("https://raw.githubusercontent.com/oasis-tcs/"
+                    "sarif-spec/master/Schemata/sarif-schema-2.1.0.json"),
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "dask-sql-tpu-selflint",
+                "informationUri":
+                    "https://github.com/dask-contrib/dask-sql",
+                "rules": [
+                    {"id": rule,
+                     "shortDescription": {"text": doc}}
+                    for rule, doc in sorted(RULES.items())
+                ],
+            }},
+            "results": [
+                {"ruleId": f.rule,
+                 "level": "error",
+                 "message": {"text": f.message},
+                 "locations": [{"physicalLocation": {
+                     "artifactLocation": {"uri": f.path},
+                     "region": {"startLine": max(f.line, 1)},
+                 }}]}
+                for f in findings
+            ],
+        }],
+    }
 
 
 if __name__ == "__main__":

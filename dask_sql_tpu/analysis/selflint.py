@@ -74,10 +74,38 @@ DSQL603  ``_locked``-suffix convention (analysis/concurrency.py)
     renamed to carry the contract.  Suppress with
     ``# dsql: allow-locked-naming``.
 
+DSQL701  paired-effect release (analysis/effects.py + dataflow.py)
+    Every acquire in the declarative effect-pair table (scheduler
+    reservations, admission tickets, LiveQuery rows, ledger charges,
+    batch groups, compile singleflight, breaker half-open trials) must
+    reach its release on *every* CFG path out of the function —
+    including exception edges — or return the handle to its caller
+    (ownership transfer).  The finding carries a file:line witness per
+    edge of the leaking path.  Suppress a cross-thread/callback handoff
+    with ``# dsql: allow-unpaired-effect`` naming the custodian.
+
+DSQL702  serving-boundary exception flow (analysis/effects.py)
+    Bare ``ValueError``/``RuntimeError``/``KeyError`` raise sites whose
+    exception can propagate (over the DSQL601-style call graph, minus
+    types absorbed by enclosing handlers) to ``TpuFrame.execute``, a
+    Presto ``do_*`` handler, or a public ``Router`` method bypass the
+    taxonomy that retry/degrade/HTTP classification dispatch on.  Also
+    flags catch sites dispatching a taxonomy class against its declared
+    ``retryable``/``degradable`` flags.  Suppress with
+    ``# dsql: allow-boundary-raise``.
+
+DSQL703  config-key registry coverage (analysis/configkeys.py)
+    Every literal key at a ``config.get("...")`` site must be in
+    ``config.py DOCUMENTED_KEYS`` (the DSQL401 design applied to
+    config); registered keys no source file mentions are reported dead.
+    Suppress with ``# dsql: allow-config-key``.
+
 The runtime counterpart of DSQL601 is the lock sanitizer
 (runtime/locks.py): NamedLock ranks + the dynamic order graph verify
 the same invariant over executed schedules, wired into the chaos
-campaigns.
+campaigns.  The runtime counterpart of DSQL703 is
+``analysis.strict_config`` (config.py): dynamic key reads warn once per
+unregistered key.
 
 Suppression comments live on the offending line or the line above it, so
 ``git blame`` keeps the reason next to the decision.
@@ -98,6 +126,9 @@ RULES: Dict[str, str] = {
     "DSQL601": "lock-order cycle across the repo lock graph",
     "DSQL602": "blocking or device call under a held lock",
     "DSQL603": "_locked-suffix convention violated",
+    "DSQL701": "paired effect acquired without a release on every CFG path",
+    "DSQL702": "bare exception can escape to a serving boundary unwrapped",
+    "DSQL703": "config key not in the documented registry (or dead)",
 }
 
 _SUPPRESS = {
@@ -109,6 +140,9 @@ _SUPPRESS = {
     "DSQL601": "dsql: allow-lock-order",
     "DSQL602": "dsql: allow-blocking-under-lock",
     "DSQL603": "dsql: allow-locked-naming",
+    "DSQL701": "dsql: allow-unpaired-effect",
+    "DSQL702": "dsql: allow-boundary-raise",
+    "DSQL703": "dsql: allow-config-key",
 }
 
 #: modules whose closure factories build jit-traced kernels: a nested def
@@ -524,8 +558,12 @@ def _check_flight_events(tree: ast.AST, path: str,
 def lint_source(source: str, path: str) -> List[LintFinding]:
     """Every per-file rule over one source text.  DSQL601 is repo-wide
     (a cycle's halves usually live in different files) and runs in
-    `lint_paths` / `concurrency.lock_order_findings` instead."""
+    `lint_paths` / `concurrency.lock_order_findings` instead, as do
+    DSQL702 (boundary escape needs the repo call graph) and DSQL703's
+    dead-key half."""
     from .concurrency import check_blocking_under_lock, check_locked_naming
+    from .configkeys import config_key_findings
+    from .effects import paired_effect_findings
 
     try:
         tree = ast.parse(source)
@@ -541,11 +579,15 @@ def lint_source(source: str, path: str) -> List[LintFinding]:
     out += _check_flight_events(tree, path, lines)
     out += check_blocking_under_lock(tree, path, lines)
     out += check_locked_naming(tree, path, lines)
+    out += paired_effect_findings(tree, path, lines)
+    out += config_key_findings(tree, path, lines)
     return sorted(out, key=lambda f: (f.path, f.line, f.rule))
 
 
 def lint_paths(paths: Iterable[str]) -> List[LintFinding]:
     from .concurrency import lock_order_findings
+    from .configkeys import dead_config_key_findings
+    from .effects import boundary_exception_findings
 
     sources: Dict[str, str] = {}
     findings: List[LintFinding] = []
@@ -554,6 +596,8 @@ def lint_paths(paths: Iterable[str]) -> List[LintFinding]:
             sources[path] = f.read()
         findings.extend(lint_source(sources[path], path))
     findings.extend(lock_order_findings(sources))
+    findings.extend(boundary_exception_findings(sources))
+    findings.extend(dead_config_key_findings(sources))
     return sorted(findings, key=lambda f: (f.path, f.line, f.rule))
 
 

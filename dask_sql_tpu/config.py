@@ -16,15 +16,15 @@ from __future__ import annotations
 import contextlib
 import logging
 import threading
-from typing import Any, Dict, Optional
+from typing import Any, Dict, NamedTuple, Optional, Tuple
 
 DEFAULTS: Dict[str, Any] = {
     # parity: dask_sql/sql.yaml keys
-    "sql.aggregate.split_out": 1,
-    "sql.aggregate.split_every": None,
+    "sql.aggregate.split_out": 1,  # dsql: allow-config-key — dask-sql parity key, reserved
+    "sql.aggregate.split_every": None,  # dsql: allow-config-key — dask-sql parity key, reserved
     "sql.identifier.case_sensitive": True,
     "sql.join.broadcast": None,  # None=auto, False=never, number=row threshold
-    "sql.limit.check-first-partition": True,
+    "sql.limit.check-first-partition": True,  # dsql: allow-config-key — dask-sql parity key, reserved
     "sql.optimize": True,
     "sql.predicate_pushdown": True,
     "sql.dynamic_partition_pruning": True,
@@ -34,10 +34,10 @@ DEFAULTS: Dict[str, Any] = {
     "sql.optimizer.preserve_user_order": True,
     "sql.optimizer.filter_selectivity": 1.0,
     "sql.sort.topk-nelem-limit": 1000000,
-    "sql.mappings.decimal_support": "float64",
+    "sql.mappings.decimal_support": "float64",  # dsql: allow-config-key — dask-sql parity key, reserved
     # TPU-native additions
-    "sql.backend.default": "tpu",
-    "sql.shuffle.num_buckets": None,  # None = number of devices
+    "sql.backend.default": "tpu",  # dsql: allow-config-key — dask-sql parity key, reserved
+    "sql.shuffle.num_buckets": None,  # None = number of devices; dsql: allow-config-key — dask-sql parity key, reserved
     "sql.native.binder": "auto",  # C++ parse+bind (auto|on|off)
     "sql.compile": True,  # whole-pipeline jit for hot aggregation shapes
     "sql.compile.join": "auto",  # jit the shape-stable join probe phase
@@ -305,7 +305,74 @@ DEFAULTS: Dict[str, Any] = {
     "resilience.inject": None,  # fault-injection spec, e.g. "compile:0.5,oom:once" (tests only)
     "resilience.inject.seed": 0,  # PRNG seed for probabilistic fault modes
     "resilience.inject.hang_s": 30.0,  # sleep modeled by HANG fault sites (compile_hang)
+
+    # ---- static analysis (analysis/) -----------------------------------
+    # warn (once per key) when config.get reads a key absent from
+    # DOCUMENTED_KEYS; read by Config._note_unregistered in THIS module,
+    # which the dead-key scan excludes
+    "analysis.strict_config": False,  # dsql: allow-config-key — read here
+
 }
+
+
+class KeySpec(NamedTuple):
+    """Registry row for one documented config key: its default and the
+    value types a reader may hand to it.  The registry is what DSQL703
+    (analysis/configkeys.py) checks every literal ``config.get`` site
+    against — a typo'd key silently reads its fallback default forever,
+    which is the config twin of a typo'd metric name splitting a time
+    series (DSQL401)."""
+    default: Any
+    types: Tuple[type, ...]
+
+
+#: value types for keys whose default is None (the default alone cannot
+#: imply them); byte budgets accept strings ("64MB") via parse_byte_budget
+_NULLABLE_KEY_TYPES: Dict[str, Tuple[type, ...]] = {
+    "sql.aggregate.split_every": (int,),
+    "sql.join.broadcast": (bool, int, float),
+    "sql.shuffle.num_buckets": (int,),
+    "analysis.estimate.device_budget_bytes": (int, str),
+    "serving.batch.max_running": (int,),
+    "serving.deadline_s": (float, int),
+    "serving.admission.max_estimated_bytes": (int, str),
+    "serving.stream.chunk_rows": (int,),
+    "serving.stream.launch_timeout_ms": (float, int),
+    "serving.compile_cache.path": (str,),
+    "serving.scheduler.device_budget_bytes": (int, str),
+    "serving.tenant.rate_qps": (float, int),
+    "observability.slow_query_ms": (float, int),
+    "observability.slow_query_path": (str,),
+    "observability.flight.dump_path": (str,),
+    "resilience.compile_timeout_ms": (float, int),
+    "resilience.inject": (str,),
+}
+
+
+def _types_of(key: str, default: Any) -> Tuple[type, ...]:
+    if default is None:
+        return _NULLABLE_KEY_TYPES.get(key, (object,))
+    if isinstance(default, bool):
+        return (bool,)
+    if isinstance(default, int):
+        return (int,)
+    if isinstance(default, float):
+        return (float, int)
+    return (type(default),)
+
+
+#: every key a ``config.get("<literal>")`` site may read.  Built from
+#: DEFAULTS so the inline doc comments above stay the single source of
+#: truth; DSQL703 reports literal reads of unregistered keys, and
+#: registered keys no source file ever mentions are reported as dead.
+DOCUMENTED_KEYS: Dict[str, KeySpec] = {
+    key: KeySpec(default, _types_of(key, default))
+    for key, default in DEFAULTS.items()
+}
+
+
+def is_documented_key(key: str) -> bool:
+    return key in DOCUMENTED_KEYS
 
 
 def parse_byte_budget(value: Any) -> Optional[int]:
@@ -351,6 +418,12 @@ def parse_byte_budget(value: Any) -> Optional[int]:
     return n if n > 0 else None
 
 
+#: keys already warned about under analysis.strict_config — once per key
+#: per process; plain set on purpose (a racing double-add only repeats
+#: one log line)
+_warned_unregistered: set = set()
+
+
 class Config:
     """Process-global base values + thread-local scoped overlays.
 
@@ -369,6 +442,8 @@ class Config:
         return getattr(self._local, "stack", None)
 
     def get(self, key: str, default: Any = None) -> Any:
+        if key not in DOCUMENTED_KEYS:
+            self._note_unregistered(key)
         stack = self._overlay_stack()
         if stack:
             for frame in reversed(stack):
@@ -378,6 +453,21 @@ class Config:
             if key in self._values:
                 return self._values[key]
             return DEFAULTS.get(key, default)
+
+    def _note_unregistered(self, key: str) -> None:
+        """Runtime twin of DSQL703 for keys the static pass cannot see
+        (computed names): under ``analysis.strict_config``, warn once per
+        key.  The strict key itself is documented, so the recursive
+        ``get`` below terminates after one level."""
+        if key in _warned_unregistered:
+            return
+        if not self.get("analysis.strict_config", False):
+            return
+        _warned_unregistered.add(key)
+        logging.getLogger(__name__).warning(
+            "config.get(%r): key is not in config.DOCUMENTED_KEYS; "
+            "register it with a default and type (analysis.strict_config)",
+            key)
 
     def update(self, options: Optional[Dict[str, Any]]) -> None:
         if not options:

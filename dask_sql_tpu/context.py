@@ -168,6 +168,7 @@ class TpuFrame:
                         live_qid = live_qid or _uuid.uuid4().hex[:16]
                         live_ticket = QueryTicket(live_qid)
                         stack.enter_context(ticket_scope(live_ticket))
+                    # dsql: allow-unpaired-effect — _finish_live ExitStack
                     entry = ctx.live_queries.begin(
                         live_qid or live_ticket.qid, sql=sql_text,
                         ticket=live_ticket, trace=tr,

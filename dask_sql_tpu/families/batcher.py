@@ -99,7 +99,8 @@ class FamilyBatcher:
             group = self._groups.get(key)
             if group is None or group.closed \
                     or len(group.members) >= self.max_queries:
-                group = _Group()
+                # dsql: allow-unpaired-effect — leader-only path: _lead()
+                group = _Group()  # settles group.done in its finally
                 self._groups[key] = group
                 leader = True
             else:

@@ -113,7 +113,11 @@ def program_for(context, schema_name: str, name: str, model: Any,
         _model, program, reason, committed = entry
         if not commit or committed or program is None:
             return program, reason
-        program = _commit(program)  # h2d outside the lock
+        # h2d outside the lock; the charge is custodied by the registry
+        # entry — DROP MODEL / reclaim_idle_models drops the reference and
+        # the scrape-based ledger self-corrects
+        # dsql: allow-unpaired-effect — registry-entry custody
+        program = _commit(program)
         with _lock:
             cur = reg.get(key)
             if cur is not None and cur[0] is model and cur[3]:
@@ -123,6 +127,7 @@ def program_for(context, schema_name: str, name: str, model: Any,
         return program, reason
     program, reason = try_lower(model)
     if program is not None and commit:
+        # dsql: allow-unpaired-effect — registry-entry custody (above)
         program = _commit(program)
     from ..observability import flight
 

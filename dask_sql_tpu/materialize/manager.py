@@ -303,6 +303,9 @@ class MaterializationManager:
             # stays, so the next observation under GREEN pins immediately.
             self.context.metrics.inc("resilience.pressure.suspended")
             return
+        # the pin's ledger charge is custodied by the manager: pressure
+        # reclaim and staleness eviction release it via _evict_locked
+        # dsql: allow-unpaired-effect — policy-driven eviction custody
         self._pin(si, key)
 
     def _pin(self, si, key) -> None:

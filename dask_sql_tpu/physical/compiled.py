@@ -1327,6 +1327,9 @@ def singleflight_get_or_build(ctx, cache: "OrderedDict", key: Tuple, build):
         if compiled is not None:
             cache.move_to_end(key)
             return compiled, False
+    # builder=False means no token was taken; the builder path settles in
+    # the shared finally below — flag-correlated, invisible to the CFG
+    # dsql: allow-unpaired-effect — settled in the finally when builder
     builder, build_ev = singleflight_begin(key)
     if not builder:
         build_ev.wait(_BUILD_WAIT_S)
@@ -1337,6 +1340,7 @@ def singleflight_get_or_build(ctx, cache: "OrderedDict", key: Tuple, build):
                 return compiled, False
         # the builder failed or declined; build here so the failure
         # surfaces under this query's own policy
+        # dsql: allow-unpaired-effect — settled in the finally when builder
         builder, build_ev = singleflight_begin(key)
     try:
         return build(), True

@@ -231,6 +231,7 @@ def run_campaign(seed: int, queries: int = 40, rounds: int = 4,
                                                        **_opts}):
                             return context.sql(_sql).compute()
 
+                    # dsql: allow-unpaired-effect — settled by _finisher
                     entry = context.live_queries.begin(qid, sql=sql,
                                                        priority_class=cls)
                     try:
@@ -363,6 +364,9 @@ def _check_invariants(report: ChaosReport, context, runtime,
         time.sleep(context.breaker.cooldown_s + 0.05)
         for entry in state["open"]:
             key = tuple(entry["key"])
+            # invariant probe: the granted trial is intentionally left
+            # unsettled — the campaign ends here
+            # dsql: allow-unpaired-effect — probe-only grant
             if not context.breaker.allow(key):
                 violate(f"breaker {key} still refuses its half-open "
                         f"trial after cooldown")

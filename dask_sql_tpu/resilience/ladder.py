@@ -182,6 +182,9 @@ def attempt(executor, rung: str, fn: Callable[[], Optional[T]],
     key = None
     if breaker is not None and rel is not None:
         key = (_fingerprint_of(executor, rel), rung)
+        # a declined/skipped rung leaves the half-open trial pending by
+        # design; the breaker cooldown re-arms it (see retry.py)
+        # dsql: allow-unpaired-effect — cooldown re-arms a pending trial
         if not breaker.allow(key):
             metrics.inc("resilience.breaker.skip")
             metrics.inc(f"resilience.breaker.skip.{rung}")

@@ -188,6 +188,9 @@ class _QueryRegistry:
             # this entry through the serving ticket and updates it in place
             from ..serving.admission import CLASSES
 
+            # finished by TpuFrame.execute / the _finish done-callback;
+            # every submit failure discards in the except below
+            # dsql: allow-unpaired-effect — custodian is _finish
             live_entry = self.context.live_queries.begin(
                 qid, sql=sql, trace=trace, tenant=tenant,
                 priority_class=priority_class
@@ -210,10 +213,13 @@ class _QueryRegistry:
                                                 submitted=time.monotonic(),
                                                 ticket=ticket, trace=trace)
                 self.n_queued += 1
-        except QueueFullError:
+        except BaseException:
             if live_entry is not None:
-                # never admitted: a shed must not occupy the live table
-                # (the registry has its own lock; no self.lock needed)
+                # never admitted (shed, shutdown race, submit validation):
+                # a failed submit must not occupy the live table — it
+                # previously leaked the row on any non-QueueFullError
+                # failure (the registry has its own lock; no self.lock
+                # needed)
                 self.context.live_queries.discard(qid)
             raise
         if trace is not None:

@@ -274,22 +274,30 @@ class ServingRuntime:
                 raise QueueFullError(e.priority_class, e.bound,
                                      min(retry_after_cap(), drain)) from None
             raise
-        ticket.cost = cost
-        flight.record("query.admit", qid=qid, cls=priority_class,
-                      tenant=(cost.tenant or None) if cost is not None
-                      else None)
-        fut: Future = Future()
-        with self._cv:
-            if self._shutdown:
-                # lost the race with a concurrent shutdown(): enqueueing now
-                # would strand the future (the drain already ran)
-                self.admission.on_finish(ticket, started=False)
-                raise ShutdownError("serving runtime is shut down")
-            if self.scheduler is not None:
-                self.scheduler.push_locked(ticket, fn, fut, cost)
-            else:
-                self._queues[ticket.priority_class].append((ticket, fn, fut))
-            self._cv.notify()
+        try:
+            ticket.cost = cost
+            flight.record("query.admit", qid=qid, cls=priority_class,
+                          tenant=(cost.tenant or None) if cost is not None
+                          else None)
+            fut: Future = Future()
+            with self._cv:
+                if self._shutdown:
+                    # lost the race with a concurrent shutdown(): enqueueing
+                    # now would strand the future (the drain already ran)
+                    raise ShutdownError("serving runtime is shut down")
+                if self.scheduler is not None:
+                    self.scheduler.push_locked(ticket, fn, fut, cost)
+                else:
+                    self._queues[ticket.priority_class].append(
+                        (ticket, fn, fut))
+                self._cv.notify()
+        except BaseException:
+            # admitted but never reached the queue (push_locked validation,
+            # the shutdown race, even a flight-recorder failure): undo the
+            # admission charge exactly once, or depth/byte accounting leaks
+            # until restart
+            self.admission.on_finish(ticket, started=False)
+            raise
         return qid, fut, ticket
 
     def _predicted_drain_s(self) -> Optional[float]:
@@ -318,67 +326,84 @@ class ServingRuntime:
     def _worker(self):
         while True:
             with self._cv:
+                # conditional pop: a None result acquires nothing; a
+                # non-None item's reservation is released by the
+                # try/finally below — path-correlated, which the CFG
+                # proof cannot see
+                # dsql: allow-unpaired-effect — released by _release below
                 item = self._pop_locked()
                 while item is None and not self._shutdown:
                     self._cv.wait()
+                    # dsql: allow-unpaired-effect — same conditional pop
                     item = self._pop_locked()
                 if item is None:  # shutdown with a drained queue
                     return
             ticket, fn, fut = item
-            if not fut.set_running_or_notify_cancel():
-                # cancelled while queued through Future.cancel()
-                self.admission.on_finish(ticket, started=False)
-                self.metrics.inc("serving.cancelled")
-                self._release(ticket)
-                continue
-            if ticket.cancelled or ticket.expired():
-                self.admission.on_finish(ticket, started=False)
-                if ticket.cancelled:
-                    self.metrics.inc("serving.cancelled")
-                    _resolve(fut, exc=QueryCancelledError(
-                        f"query {ticket.qid} cancelled"))
-                else:
-                    self.metrics.inc("serving.timeouts")
-                    _resolve(fut, exc=DeadlineExceededError(
-                        f"query {ticket.qid} expired while queued"))
-                self._release(ticket)
-                continue
-            if ticket.queue_reason is None:
-                # the scheduler stamps byte_blocked/quota_throttled at
-                # dispatch; anything else waited only for a free worker
-                ticket.queue_reason = "workers_busy"
-            self.admission.on_start(ticket)
-            with self._cv:
-                self._inflight[ticket.qid] = (ticket, fut)
-            _tls.ticket = ticket
             try:
-                # taxonomy-retryable failures (transient device/runtime
-                # errors) are retried here with backoff, bounded by the
-                # ticket's deadline; everything else surfaces on first throw
-                result = retry_call(lambda: fn(ticket), self.retry_policy,
-                                    ticket=ticket, metrics=self.metrics)
-            except QueryCancelledError as e:
-                self.metrics.inc("serving.cancelled")
-                _resolve(fut, exc=e)
-            except DeadlineExceededError as e:
-                self.metrics.inc("serving.timeouts")
-                _resolve(fut, exc=e)
-            except BaseException as e:  # dsql: allow-broad-except — surfaced via Future
-                self.metrics.inc("serving.failed")
-                _resolve(fut, exc=e)
-            else:
-                self.metrics.inc("serving.completed")
-                _resolve(fut, result=result)
+                self._run_one(ticket, fn, fut)
             finally:
-                _tls.ticket = None
-                with self._cv:
-                    self._inflight.pop(ticket.qid, None)
-                self.admission.on_finish(ticket)
-                if ticket.started_at is not None:
-                    self.metrics.observe(
-                        "serving.latency_ms",
-                        (time.monotonic() - ticket.admitted_at) * 1000.0)
+                # the batch slot and the packer's byte reservation are
+                # freed on EVERY outcome — including a bug between pop and
+                # execution, which previously leaked the reservation and
+                # killed the worker thread
                 self._release(ticket)
+
+    def _run_one(self, ticket: QueryTicket, fn, fut: Future) -> None:
+        """Run one popped item to a terminal state: admission accounting,
+        cancellation/expiry checks, taxonomy-aware retry, future
+        resolution.  The caller owns the scheduler reservation and calls
+        `_release` whatever happens here."""
+        if not fut.set_running_or_notify_cancel():
+            # cancelled while queued through Future.cancel()
+            self.admission.on_finish(ticket, started=False)
+            self.metrics.inc("serving.cancelled")
+            return
+        if ticket.cancelled or ticket.expired():
+            self.admission.on_finish(ticket, started=False)
+            if ticket.cancelled:
+                self.metrics.inc("serving.cancelled")
+                _resolve(fut, exc=QueryCancelledError(
+                    f"query {ticket.qid} cancelled"))
+            else:
+                self.metrics.inc("serving.timeouts")
+                _resolve(fut, exc=DeadlineExceededError(
+                    f"query {ticket.qid} expired while queued"))
+            return
+        if ticket.queue_reason is None:
+            # the scheduler stamps byte_blocked/quota_throttled at
+            # dispatch; anything else waited only for a free worker
+            ticket.queue_reason = "workers_busy"
+        self.admission.on_start(ticket)
+        with self._cv:
+            self._inflight[ticket.qid] = (ticket, fut)
+        _tls.ticket = ticket
+        try:
+            # taxonomy-retryable failures (transient device/runtime
+            # errors) are retried here with backoff, bounded by the
+            # ticket's deadline; everything else surfaces on first throw
+            result = retry_call(lambda: fn(ticket), self.retry_policy,
+                                ticket=ticket, metrics=self.metrics)
+        except QueryCancelledError as e:
+            self.metrics.inc("serving.cancelled")
+            _resolve(fut, exc=e)
+        except DeadlineExceededError as e:
+            self.metrics.inc("serving.timeouts")
+            _resolve(fut, exc=e)
+        except BaseException as e:  # dsql: allow-broad-except — surfaced via Future
+            self.metrics.inc("serving.failed")
+            _resolve(fut, exc=e)
+        else:
+            self.metrics.inc("serving.completed")
+            _resolve(fut, result=result)
+        finally:
+            _tls.ticket = None
+            with self._cv:
+                self._inflight.pop(ticket.qid, None)
+            self.admission.on_finish(ticket)
+            if ticket.started_at is not None:
+                self.metrics.observe(
+                    "serving.latency_ms",
+                    (time.monotonic() - ticket.admitted_at) * 1000.0)
 
     def _release(self, ticket: QueryTicket):
         """Return a popped item's scheduling slot: frees the batch

@@ -41,3 +41,38 @@ def test_per_query_config_options():
     result = c.sql("SELECT SUM(a) AS s FROM t",
                    config_options={"sql.optimize": False}, return_futures=False)
     assert result["s"][0] == 6
+
+
+def test_documented_keys_registry_covers_defaults():
+    from dask_sql_tpu.config import (DEFAULTS, DOCUMENTED_KEYS, KeySpec,
+                                     is_documented_key)
+
+    assert set(DOCUMENTED_KEYS) == set(DEFAULTS)
+    spec = DOCUMENTED_KEYS["sql.optimize"]
+    assert isinstance(spec, KeySpec)
+    assert spec.default is True and bool in spec.types
+    # None-default keys still declare the type a non-None value takes
+    assert int in DOCUMENTED_KEYS["serving.deadline_s"].types \
+        or float in DOCUMENTED_KEYS["serving.deadline_s"].types
+    assert is_documented_key("sql.optimize")
+    assert not is_documented_key("sql.not-a-key")
+
+
+def test_strict_config_warns_once_per_unregistered_key(caplog):
+    import logging
+
+    from dask_sql_tpu import config
+
+    # off (the default): silent
+    with caplog.at_level(logging.WARNING, logger="dask_sql_tpu.config"):
+        assert config.get("strictcfg.test.off", 7) == 7
+    assert not caplog.records
+
+    with config.set({"analysis.strict_config": True}):
+        with caplog.at_level(logging.WARNING, logger="dask_sql_tpu.config"):
+            assert config.get("strictcfg.test.on", 7) == 7
+            assert config.get("strictcfg.test.on", 7) == 7  # second: silent
+    warned = [r for r in caplog.records
+              if "strictcfg.test.on" in r.getMessage()]
+    assert len(warned) == 1
+    assert "DOCUMENTED_KEYS" in warned[0].getMessage()

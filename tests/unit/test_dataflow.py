@@ -1,0 +1,143 @@
+"""CFG builder + forward dataflow engine (analysis/dataflow.py, ISSUE 20):
+exact entry-to-exit path sets for the constructs the effect rules lean on
+(early return, try/finally, except-dispatch, loop back-edges, with
+suites, ``while True``), plus the engine's except-edge pre-state rule and
+`find_path` witness extraction.
+"""
+import ast
+
+import pytest
+
+from dask_sql_tpu.analysis.dataflow import (ForwardAnalysis, build_cfg,
+                                            find_path, format_witness,
+                                            path_lines)
+
+pytestmark = [pytest.mark.analysis]
+
+
+def _cfg(src: str):
+    return build_cfg(ast.parse(src).body[0])
+
+
+# ------------------------------------------------------------ path shapes
+def test_early_return_splits_into_two_exit_paths():
+    cfg = _cfg(
+        "def f(a):\n"          # 1
+        "    if a:\n"          # 2
+        "        return 1\n"   # 3
+        "    return 2\n")      # 4
+    # a bare-name test cannot raise: exactly the two normal paths
+    assert path_lines(cfg) == {(2, 3, "exit"), (2, 4, "exit")}
+
+
+def test_try_finally_runs_finally_on_both_continuations():
+    cfg = _cfg(
+        "def f(x):\n"          # 1
+        "    try:\n"           # 2
+        "        g(x)\n"       # 3
+        "    finally:\n"       # 4
+        "        h()\n"        # 5
+        "    return 0\n")      # 6
+    # the finally body (5) is on EVERY path; the pending exception from
+    # g(x) re-raises after it (h() raising folds into the same shape)
+    assert path_lines(cfg) == {(3, 5, 6, "exit"), (3, 5, "raise")}
+
+
+def test_except_edge_dispatches_to_handler_or_reraises():
+    cfg = _cfg(
+        "def f(x):\n"              # 1
+        "    try:\n"               # 2
+        "        g(x)\n"           # 3
+        "    except ValueError:\n"  # 4
+        "        return -1\n"      # 5
+        "    return 0\n")          # 6
+    # normal, handled (typed handler matched), and unmatched re-raise —
+    # a typed handler may not match, so the raw raise-exit path survives
+    assert path_lines(cfg) == {
+        (3, 6, "exit"), (3, 5, "exit"), (3, "raise")}
+
+
+def test_loop_back_edge_exists_and_zero_iteration_path_is_simple():
+    cfg = _cfg(
+        "def f(xs):\n"          # 1
+        "    out = 0\n"         # 2
+        "    for x in xs:\n"    # 3
+        "        out += x\n"    # 4
+        "    return out\n")     # 5
+    # simple paths visit each node once: only the zero-iteration shape
+    assert path_lines(cfg) == {(2, 3, 5, "exit")}
+    # ...but the body loops back to the head for the fixpoint engine
+    back = [e for edges in cfg.succ.values() for e in edges
+            if e.kind == "back"]
+    assert [(cfg.nodes[e.src].line, cfg.nodes[e.dst].line)
+            for e in back] == [(4, 3)]
+
+
+def test_with_suite_body_raise_escapes_the_with():
+    cfg = _cfg(
+        "def f(lock):\n"    # 1
+        "    with lock:\n"  # 2
+        "        g()\n"     # 3
+        "    return 1\n")   # 4
+    # the context expression is a bare name (no except edge of its own);
+    # the body's g() can raise out of the suite
+    assert path_lines(cfg) == {(2, 3, 4, "exit"), (2, 3, "raise")}
+
+
+def test_while_true_has_no_fall_through_exit():
+    cfg = _cfg(
+        "def f(q):\n"                  # 1
+        "    while True:\n"            # 2
+        "        item = q.pop()\n"     # 3
+        "        if item is None:\n"   # 4
+        "            return 0\n")      # 5
+    # no test-false edge: the only exits are the return and q.pop() raising
+    assert path_lines(cfg) == {(2, 3, 4, 5, "exit"), (2, 3, "raise")}
+
+
+# ------------------------------------------------- engine + witness search
+class _Reaches(ForwardAnalysis):
+    """Set-of-visited-lines lattice — enough to see except-edge pre-state."""
+
+    def transfer(self, node, fact):
+        if node.stmt is None:
+            return fact
+        return frozenset(fact | {node.line})
+
+
+def test_except_edges_propagate_pre_state():
+    cfg = _cfg(
+        "def f(x):\n"               # 1
+        "    try:\n"                # 2
+        "        g(x)\n"            # 3
+        "    except Exception:\n"   # 4
+        "        h()\n"             # 5
+        "    return 0\n")           # 6
+    fact_in, _ = _Reaches().run(cfg)
+    handler = next(n for n in cfg.stmt_nodes() if n.line == 5)
+    # the handler's input came through g(x)'s except edge: g's own
+    # effect (line 3) must NOT be in the incoming fact
+    assert 3 not in fact_in[handler.nid]
+
+
+def test_find_path_blocking_modes_and_witness_format():
+    cfg = _cfg(
+        "def f(s):\n"                  # 1
+        "    t = s.acquire()\n"        # 2
+        "    s.use(t)\n"               # 3
+        "    s.release(t)\n")          # 4
+    start = next(n for n in cfg.stmt_nodes() if n.line == 2)
+    release = next(n for n in cfg.stmt_nodes() if n.line == 4)
+
+    # "all": the release settles even when it raises — no leak path
+    # survives through it, only s.use(t)'s own raise escapes
+    path = find_path(cfg, start.nid, {cfg.exit, cfg.raise_exit},
+                     lambda n: "all" if n.nid == release.nid else False)
+    assert path is not None
+    witness = format_witness(cfg, path)
+    assert "except" in witness and witness.endswith("raise-exit")
+    assert "4" not in witness  # never crosses the release
+
+    # blocking every node after the acquire: no witness at all
+    assert find_path(cfg, start.nid, {cfg.exit, cfg.raise_exit},
+                     lambda n: "all") is None

@@ -1,0 +1,258 @@
+"""Effect-lifecycle rules (ISSUE 20): DSQL701 release-on-all-paths proofs
+over the CFG, DSQL702 serving-boundary exception flow + taxonomy dispatch
+cross-check, DSQL703 config-key registry coverage and dead keys — seeded
+synthetic modules per rule with file:line witnesses, plus the
+parametrized suppression test mirroring the DSQL101-603 one.
+"""
+import inspect
+import os
+
+import pytest
+
+from dask_sql_tpu.analysis.effects import boundary_exception_findings
+from dask_sql_tpu.analysis.configkeys import dead_config_key_findings
+from dask_sql_tpu.analysis.selflint import _SUPPRESS, lint_source
+
+pytestmark = [pytest.mark.analysis]
+
+_ROUTER = os.path.join("fleet", "router.py")
+_CONFIG = os.path.join("dask_sql_tpu", "config.py")
+
+
+def rules_of(findings):
+    return [f.rule for f in findings]
+
+
+# --------------------------------------------------------------- DSQL701
+LEAK_SRC = """\
+class Runtime:
+    def go(self):
+        ticket = self.scheduler.pop_locked(){mark}
+        self.run(ticket)
+        self.scheduler.release_locked(ticket)
+"""
+
+
+def test_reservation_leaking_on_exception_path_is_flagged():
+    findings = lint_source(LEAK_SRC.format(mark=""), "f.py")
+    assert rules_of(findings) == ["DSQL701"]
+    f = findings[0]
+    # anchored at the acquire, witness = the exception path that skips
+    # the release (self.run raising)
+    assert f.path == "f.py" and f.line == 3
+    assert "scheduler-reservation" in f.message
+    assert "release_locked" in f.message
+    assert "except" in f.message and "raise-exit" in f.message
+
+
+def test_release_in_finally_proves_every_path():
+    src = (
+        "class Runtime:\n"
+        "    def go(self):\n"
+        "        ticket = self.scheduler.pop_locked()\n"
+        "        try:\n"
+        "            self.run(ticket)\n"
+        "        finally:\n"
+        "            self.scheduler.release_locked(ticket)\n")
+    assert lint_source(src, "f.py") == []
+
+
+def test_returning_the_handle_is_an_ownership_handoff():
+    src = (
+        "class Runtime:\n"
+        "    def pop(self):\n"
+        "        return self.scheduler.pop_locked()\n"
+        "    def pop2(self):\n"
+        "        item = self.scheduler.pop_locked()\n"
+        "        return item\n")
+    assert lint_source(src, "f.py") == []
+
+
+def test_one_hop_helper_attribution_flags_the_caller():
+    src = (
+        "class Runtime:\n"
+        "    def _grab(self):\n"
+        "        return self.scheduler.pop_locked()\n"
+        "    def go(self):\n"
+        "        item = self._grab()\n"       # inherits the obligation
+        "        self.run(item)\n")           # ...and can raise past it
+    findings = lint_source(src, "f.py")
+    assert rules_of(findings) == ["DSQL701"]
+    assert findings[0].line == 5 and "go()" in findings[0].message
+
+
+def test_annotated_acquire_does_not_charge_callers_either():
+    src = (
+        "class Runtime:\n"
+        "    def _grab(self):\n"
+        "        # dsql: allow-unpaired-effect — custodian elsewhere\n"
+        "        self.scheduler.pop_locked()\n"
+        "    def go(self):\n"
+        "        self._grab()\n"
+        "        self.run()\n")
+    assert lint_source(src, "f.py") == []
+
+
+# --------------------------------------------------------------- DSQL702
+BOUNDARY_SRC = """\
+class Router:
+    def execute(self, sql):
+        return self._dispatch(sql)
+
+    def _dispatch(self, sql):
+        if not sql:
+            raise ValueError("empty sql"){mark}
+        return sql
+"""
+
+
+def test_bare_raise_escaping_a_boundary_is_flagged_with_chain():
+    findings = boundary_exception_findings(
+        {_ROUTER: BOUNDARY_SRC.format(mark="")})
+    assert rules_of(findings) == ["DSQL702"]
+    f = findings[0]
+    # anchored at the raise site, chain names the boundary and each hop
+    assert f.path == _ROUTER and f.line == 7
+    assert "ValueError" in f.message and "Router.execute" in f.message
+    assert "_dispatch" in f.message and "router.py:3" in f.message
+
+
+def test_caught_bare_raise_does_not_escape():
+    src = (
+        "class Router:\n"
+        "    def execute(self, sql):\n"
+        "        try:\n"
+        "            return self._dispatch(sql)\n"
+        "        except ValueError:\n"
+        "            return None\n"
+        "    def _dispatch(self, sql):\n"
+        "        raise ValueError('empty')\n")
+    assert boundary_exception_findings({_ROUTER: src}) == []
+
+
+def test_non_boundary_module_bare_raise_is_clean():
+    src = "def helper(x):\n    raise ValueError(x)\n"
+    assert boundary_exception_findings({"util/misc.py": src}) == []
+
+
+def test_taxonomy_dispatch_against_declared_flags_is_flagged():
+    src = (
+        "class QueryError(Exception):\n"
+        "    retryable = False\n"
+        "    degradable = False\n"
+        "class CompileError(QueryError):\n"
+        "    pass\n"
+        "def handle(run, retry):\n"
+        "    try:\n"
+        "        return run()\n"
+        "    except CompileError:\n"
+        "        return retry()\n")
+    findings = boundary_exception_findings({"serving/x.py": src})
+    assert rules_of(findings) == ["DSQL702"]
+    assert findings[0].line == 9
+    assert "CompileError" in findings[0].message
+    assert "retryable" in findings[0].message
+
+
+def test_flag_reading_handler_is_trusted():
+    src = (
+        "class QueryError(Exception):\n"
+        "    retryable = False\n"
+        "    degradable = False\n"
+        "class CompileError(QueryError):\n"
+        "    pass\n"
+        "def handle(run, retry, e=None):\n"
+        "    try:\n"
+        "        return run()\n"
+        "    except CompileError as exc:\n"
+        "        if exc.retryable:\n"
+        "            return retry()\n"
+        "        raise\n")
+    assert boundary_exception_findings({"serving/x.py": src}) == []
+
+
+# --------------------------------------------------------------- DSQL703
+def test_unregistered_config_key_is_flagged():
+    src = "def f(config):\n    return config.get('serving.bogus.key', 1)\n"
+    findings = lint_source(src, "f.py")
+    assert rules_of(findings) == ["DSQL703"]
+    assert findings[0].line == 2
+    assert "serving.bogus.key" in findings[0].message
+
+
+def test_documented_key_and_dynamic_key_are_clean():
+    src = (
+        "def f(config, name):\n"
+        "    a = config.get('sql.optimize', True)\n"
+        "    return a, config.get(name)\n")   # dynamic: no claim
+    assert lint_source(src, "f.py") == []
+
+
+def _config_source() -> str:
+    from dask_sql_tpu import config as config_module
+
+    return inspect.getsource(config_module)
+
+
+def test_dead_registry_key_reported_at_its_registry_line():
+    cfg_src = _config_source()
+    # a user file that mentions no key at all: 'sql.optimize' (a live,
+    # unannotated key) must be reported dead, anchored in config.py
+    findings = dead_config_key_findings(
+        {_CONFIG: cfg_src, "a.py": "x = 1\n"})
+    dead = [f for f in findings if "'sql.optimize'" in f.message]
+    assert dead and dead[0].path == _CONFIG and dead[0].line > 0
+
+    # the same key read somewhere is alive
+    alive = dead_config_key_findings(
+        {_CONFIG: cfg_src,
+         "a.py": "def f(config):\n    config.get('sql.optimize')\n"})
+    assert not any("'sql.optimize'" in f.message for f in alive)
+
+
+def test_fstring_family_read_keeps_prefixed_keys_alive():
+    cfg_src = _config_source()
+    reader = ('def rung_enabled(config, short):\n'
+              '    return config.get(f"parallel.spmd.{short}", True)\n')
+    findings = dead_config_key_findings({_CONFIG: cfg_src, "a.py": reader})
+    assert not any("parallel.spmd." in f.message for f in findings)
+
+
+def test_dead_key_pass_needs_the_registry_module_present():
+    assert dead_config_key_findings({"a.py": "x = 1\n"}) == []
+
+
+# ------------------------------------------------- suppression (PR19 form)
+_OFFENDERS = {
+    "DSQL701": (LEAK_SRC, 3),
+    "DSQL702": (BOUNDARY_SRC, 7),
+    "DSQL703": ("def f(config):\n"
+                "    config.get('serving.bogus.key'){mark}\n", 2),
+}
+
+
+def _findings(rule, src):
+    if rule == "DSQL702":
+        return boundary_exception_findings({_ROUTER: src})
+    return lint_source(src, "f.py")
+
+
+@pytest.mark.parametrize("rule", sorted(_OFFENDERS))
+def test_suppression_token_silences_exactly_its_own_rule(rule):
+    template, line = _OFFENDERS[rule]
+    token = _SUPPRESS[rule]
+
+    bare = _findings(rule, template.format(mark=""))
+    assert rule in rules_of(bare), bare
+    assert any(f.line == line for f in bare if f.rule == rule)
+
+    own = _findings(rule, template.format(mark=f"  # {token} — reason"))
+    assert rule not in rules_of(own), own
+
+    other_rule = next(r for r in sorted(_SUPPRESS) if r != rule)
+    other = _findings(
+        rule, template.format(mark=f"  # {_SUPPRESS[other_rule]}"))
+    assert rule in rules_of(other), other
+
+    decoy = _findings(rule, f"# {token}\n" + template.format(mark=""))
+    assert rule in rules_of(decoy), decoy
